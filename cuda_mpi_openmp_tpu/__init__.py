@@ -1,0 +1,21 @@
+"""Compatibility alias: ``cuda_mpi_openmp_tpu`` re-exports :mod:`tpulab`.
+
+The framework's import name is ``tpulab``; this alias mirrors the
+reference repository's name for discoverability.
+"""
+
+import sys
+
+import tpulab
+from tpulab import *  # noqa: F401,F403
+
+# Make ``import cuda_mpi_openmp_tpu.ops`` style submodule imports resolve
+# to the tpulab subpackages.
+for _sub in ("io", "ops", "labs", "parallel", "models", "harness", "runtime", "utils", "cli"):
+    try:
+        _mod = __import__(f"tpulab.{_sub}", fromlist=[_sub])
+        sys.modules[f"{__name__}.{_sub}"] = _mod
+    except ImportError:
+        pass
+
+__version__ = tpulab.__version__

@@ -1,0 +1,35 @@
+"""Test configuration.
+
+Forces the CPU backend with 8 virtual devices BEFORE jax initializes, so
+multi-device sharding/collective tests run without TPU hardware (the
+equivalent of the reference suite's golden-file tier, which runs against
+whatever device is present — see SURVEY.md section 4).
+"""
+
+import os
+
+# Hard override: the container environment pins JAX_PLATFORMS=axon (real
+# TPU tunnel); tests always run on the virtual 8-device CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pathlib
+
+import numpy as np
+import pytest
+
+REFERENCE_ROOT = pathlib.Path("/root/reference")
+
+
+@pytest.fixture(scope="session")
+def reference_root():
+    if not REFERENCE_ROOT.exists():
+        pytest.skip("reference snapshot not mounted")
+    return REFERENCE_ROOT
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

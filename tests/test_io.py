@@ -1,0 +1,135 @@
+"""IO layer tests: tri-format image codec, lab5 binary format, protocols."""
+
+import numpy as np
+import pytest
+
+from tpulab.io import (
+    bytes_to_hex,
+    hex_to_bytes,
+    load_image,
+    load_typed_array,
+    pack_image,
+    save_image,
+    save_typed_array,
+    unpack_image,
+)
+from tpulab.io import protocol
+from tpulab.utils import ImgData, coerce_cli_kwargs
+
+
+def random_rgba(rng, h, w):
+    return rng.integers(0, 256, size=(h, w, 4), dtype=np.uint8)
+
+
+class TestImageCodec:
+    def test_pack_unpack_roundtrip(self, rng):
+        img = random_rgba(rng, 5, 3)
+        assert np.array_equal(unpack_image(pack_image(img)), img)
+
+    def test_hex_roundtrip(self, rng):
+        img = random_rgba(rng, 2, 7)
+        blob = pack_image(img)
+        assert hex_to_bytes(bytes_to_hex(blob)) == blob
+
+    def test_hex_grouping(self):
+        img = np.zeros((1, 1, 4), np.uint8)
+        img[0, 0] = [1, 2, 3, 4]
+        # header: w=1, h=1 little-endian; one pixel group r,g,b,a
+        assert bytes_to_hex(pack_image(img)) == "01000000 01000000 01020304"
+
+    def test_file_roundtrip_all_formats(self, rng, tmp_path):
+        img = random_rgba(rng, 4, 6)
+        img[..., 3] = 255  # png path forces opaque alpha; keep formats comparable
+        for ext in (".data", ".txt", ".png"):
+            p = str(tmp_path / f"img{ext}")
+            save_image(p, img)
+            assert np.array_equal(load_image(p), img)
+
+    def test_png_import_forces_alpha(self, rng, tmp_path):
+        img = random_rgba(rng, 3, 3)
+        img[..., 3] = 7
+        p = str(tmp_path / "a.png")
+        save_image(p, img)
+        out = load_image(p)
+        assert (out[..., 3] == 255).all()
+        assert np.array_equal(out[..., :3], img[..., :3])
+
+    def test_reference_fixture_parses(self, reference_root):
+        img = load_image(str(reference_root / "lab2/data/test_01.txt"))
+        assert img.shape == (3, 3, 4)
+        assert img[0, 0, 0] == 0x01 and img[0, 0, 1] == 0x02 and img[0, 0, 2] == 0x03
+
+    def test_reference_data_files_parse(self, reference_root):
+        img = load_image(str(reference_root / "lab2/data/02.data"))
+        assert img.shape[2] == 4 and img.size > 0
+
+    def test_imgdata_materializes_siblings(self, rng, tmp_path):
+        img = random_rgba(rng, 3, 3)
+        p = str(tmp_path / "x.data")
+        save_image(p, img)
+        obj = ImgData(p)
+        assert (tmp_path / "x.txt").exists() and (tmp_path / "x.png").exists()
+        assert obj.width == 3 and obj.height == 3
+        assert hex_to_bytes(obj.hex) == obj.c_data_bytes
+
+
+class TestTypedArray:
+    def test_roundtrip(self, tmp_path, rng):
+        vals = rng.normal(size=11).astype(np.float32)
+        p = str(tmp_path / "float11")
+        save_typed_array(p, vals)
+        assert np.array_equal(load_typed_array(p), vals)
+
+    def test_reference_lab5_files(self, reference_root):
+        ints = load_typed_array(str(reference_root / "lab5/data/int10"))
+        floats = load_typed_array(str(reference_root / "lab5/data/float10"))
+        chars = load_typed_array(str(reference_root / "lab5/data/uchar10"))
+        assert list(ints) == [0, 9, 8, 7, 6, 5, 4, 3, 2, 1]
+        assert floats.dtype == np.float32 and floats.size == 10
+        assert list(chars) == [1, 2, 3, 1, 2, 3, 1, 2, 3, 4]
+
+
+class TestProtocol:
+    def test_lab1_roundtrip(self, rng):
+        a = rng.uniform(-1e100, 1e100, 16)
+        b = rng.uniform(-1e100, 1e100, 16)
+        text = protocol.format_lab1_input(a, b, launch=(256, 256))
+        parsed = protocol.parse_lab1(text, sweep=True)
+        assert parsed.launch == (256, 256)
+        np.testing.assert_allclose(parsed.a, a, rtol=1e-10)
+
+    def test_lab1_no_sweep(self):
+        parsed = protocol.parse_lab1("2\n1.0 2.0\n3.0 4.0")
+        assert parsed.launch is None
+        assert list(parsed.a) == [1.0, 2.0] and list(parsed.b) == [3.0, 4.0]
+
+    def test_lab2(self):
+        p = protocol.parse_lab2("32 32 16 16\nin.data\nout.data", sweep=True)
+        assert p.launch == (32, 32, 16, 16)
+        assert p.input_path == "in.data" and p.output_path == "out.data"
+
+    def test_lab3_grammar(self):
+        text = protocol.format_lab3_input(
+            "in.data", "out.data", [np.array([[1, 2], [1, 0]]), np.array([[0, 0]])]
+        )
+        p = protocol.parse_lab3(text)
+        assert len(p.classes) == 2
+        assert p.classes[0].points.tolist() == [[1, 2], [1, 0]]
+        assert p.classes[1].points.tolist() == [[0, 0]]
+
+    def test_hw2_roundtrip(self):
+        vals = np.array([3.5, -1.25, 0.5], dtype=np.float32)
+        parsed = protocol.parse_hw2(protocol.format_hw2_input(vals))
+        np.testing.assert_allclose(parsed, vals, rtol=1e-6)
+
+    def test_payload_formats(self):
+        assert protocol.format_vector_10e(np.array([1.0])) == "1.0000000000e+00 "
+        assert protocol.format_vector_6e(np.array([1.0])) == "1.000000e+00 \n"
+
+
+class TestArgCfg:
+    def test_coercion(self):
+        kw = coerce_cli_kwargs(
+            ["--seed", "7", "--atol", "1e-10", "--name", "abc", "--flag", "--ks", "[[1,2]]"]
+        )
+        assert kw == {"seed": 7, "atol": 1e-10, "name": "abc", "flag": True, "ks": [[1, 2]]}

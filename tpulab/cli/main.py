@@ -1,0 +1,56 @@
+"""``tpulab`` command-line entry point.
+
+Subcommands:
+    tpulab info              device introspection (gpu_info equivalent)
+    tpulab run <workload>    run a workload over the stdin/stdout protocol
+    tpulab bench             run the benchmark suite
+
+``python -m tpulab`` routes here as well.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(prog="tpulab", description=__doc__)
+    sub = parser.add_subparsers(dest="command")
+
+    sub.add_parser("info", help="print device information")
+
+    run_p = sub.add_parser("run", help="run a workload (stdin/stdout protocol)")
+    run_p.add_argument("workload", help="lab1|lab2|lab3|lab5|hw1|hw2|tpu_info")
+    run_p.add_argument("--to-plot", action="store_true", help="sweep mode: read launch config from stdin prefix")
+    run_p.add_argument("--backend", default=None, help="cpu|tpu|auto")
+
+    sub.add_parser("bench", help="run the benchmark suite")
+
+    args, extra = parser.parse_known_args(argv)
+
+    if args.command == "info":
+        from tpulab.runtime.device import format_device_info
+
+        print(format_device_info())
+        return 0
+
+    if args.command == "run":
+        from tpulab.labs import run_workload
+
+        return run_workload(
+            args.workload, sweep=args.to_plot, backend=args.backend, extra=extra
+        )
+
+    if args.command == "bench":
+        from tpulab.cli.bench import run_bench_cli
+
+        return run_bench_cli(extra)
+
+    parser.print_help()
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,24 @@
+from tpulab.io.imagefile import (
+    HEX_GROUP,
+    Image4,
+    bytes_to_hex,
+    hex_to_bytes,
+    load_image,
+    pack_image,
+    save_image,
+    unpack_image,
+)
+from tpulab.io.binfmt import load_typed_array, save_typed_array
+
+__all__ = [
+    "HEX_GROUP",
+    "Image4",
+    "bytes_to_hex",
+    "hex_to_bytes",
+    "load_image",
+    "pack_image",
+    "save_image",
+    "unpack_image",
+    "load_typed_array",
+    "save_typed_array",
+]

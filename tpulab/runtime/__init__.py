@@ -1,0 +1,17 @@
+from tpulab.runtime.device import cpu_device, default_device, device_info
+from tpulab.runtime.timing import (
+    TIMING_LINE_PATTERN,
+    format_timing_line,
+    measure_ms,
+    parse_timing_line,
+)
+
+__all__ = [
+    "TIMING_LINE_PATTERN",
+    "cpu_device",
+    "default_device",
+    "device_info",
+    "format_timing_line",
+    "measure_ms",
+    "parse_timing_line",
+]

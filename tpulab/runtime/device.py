@@ -1,0 +1,75 @@
+"""Device selection and introspection.
+
+TPU-native equivalent of the reference's ``gpu_info`` tool
+(reference ``gpu_info/src/main.cu:4-19`` prints compute capability, memory
+sizes, launch limits and SM count for CUDA device 0) and of the implicit
+"CUDA vs CPU" device split the harness sweeps over.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List
+
+import jax
+
+
+@functools.lru_cache(maxsize=None)
+def cpu_device(index: int = 0):
+    """The host CPU backend device (always present, used for f64 paths)."""
+    return jax.devices("cpu")[index]
+
+
+def default_device():
+    """The default accelerator device (TPU when attached, else CPU)."""
+    return jax.devices()[0]
+
+
+def backend_name() -> str:
+    return default_device().platform
+
+
+def resolve_device(backend: str | None):
+    """Map a ``--backend`` flag value to a concrete jax device.
+
+    ``None``/"auto" -> default device; "cpu" -> host; "tpu" -> accelerator.
+    """
+    if backend in (None, "auto", "default"):
+        return default_device()
+    return jax.devices(backend)[0]
+
+
+def device_info(device=None) -> Dict[str, Any]:
+    """Structured device description (the ``tpu_info`` payload)."""
+    d = device if device is not None else default_device()
+    info: Dict[str, Any] = {
+        "platform": d.platform,
+        "device_kind": getattr(d, "device_kind", "unknown"),
+        "id": d.id,
+        "process_index": getattr(d, "process_index", 0),
+        "num_devices": jax.device_count(),
+        "num_local_devices": jax.local_device_count(),
+        "num_processes": jax.process_count(),
+    }
+    coords = getattr(d, "coords", None)
+    if coords is not None:
+        info["coords"] = tuple(coords)
+    core = getattr(d, "core_on_chip", None)
+    if core is not None:
+        info["core_on_chip"] = core
+    try:
+        stats = d.memory_stats()
+    except Exception:  # backends without memory stats (e.g. CPU)
+        stats = None
+    if stats:
+        for key in ("bytes_limit", "bytes_in_use", "peak_bytes_in_use"):
+            if key in stats:
+                info[key] = stats[key]
+    return info
+
+
+def format_device_info(device=None) -> str:
+    """Human-readable multi-line report, one ``key: value`` pair per line."""
+    info = device_info(device)
+    lines: List[str] = [f"{k}: {v}" for k, v in info.items()]
+    return "\n".join(lines)

@@ -1,0 +1,68 @@
+"""Kernel timing with the reference suite's stdout contract.
+
+The reference brackets only the kernel with CUDA events and prints
+``"CUDA execution time: <T ms>"`` as the first stdout line
+(reference ``lab1/src/to_plot.cu:67-82``); the harness extracts the time
+with the regex ``r"execution time: <([\\d.]+) ms>"`` (reference
+``tester.py:16``).  The TPU equivalent of "kernel-only" timing is the
+steady-state wall time of an already-compiled jitted function around
+``block_until_ready`` — compile time excluded, host<->device staging
+excluded (inputs are committed to the device first), matching what the
+CUDA events measured.
+"""
+
+from __future__ import annotations
+
+import re
+import statistics
+import time
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+
+TIMING_LINE_PATTERN = re.compile(r"execution time: <([\d.]+) ms>")
+
+
+def format_timing_line(device_label: str, ms: float) -> str:
+    """First-stdout-line timing contract, e.g. ``TPU execution time: <0.123456 ms>``."""
+    return f"{device_label} execution time: <{ms:f} ms>"
+
+
+def parse_timing_line(text: str) -> Optional[float]:
+    """Extract the kernel time from program stdout (harness side)."""
+    match = TIMING_LINE_PATTERN.search(text)
+    return float(match.group(1)) if match else None
+
+
+def _block(out: Any) -> None:
+    jax.tree_util.tree_map(
+        lambda leaf: leaf.block_until_ready() if hasattr(leaf, "block_until_ready") else leaf,
+        out,
+    )
+
+
+def measure_ms(
+    fn: Callable,
+    args: Sequence[Any] = (),
+    *,
+    warmup: int = 2,
+    reps: int = 5,
+    reducer: Callable[[Sequence[float]], float] = statistics.median,
+) -> Tuple[float, Any]:
+    """Time ``fn(*args)`` steady-state; returns ``(ms, last_output)``.
+
+    ``warmup`` calls absorb compilation and autotuning; ``reps`` timed calls
+    are reduced (median by default) to a single number, mirroring the
+    reference harness's median-of-k aggregation (reference tester.py:329-340).
+    """
+    out = None
+    for _ in range(max(warmup, 0)):
+        out = fn(*args)
+    _block(out)
+    samples = []
+    for _ in range(max(reps, 1)):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        _block(out)
+        samples.append((time.perf_counter() - t0) * 1e3)
+    return reducer(samples), out

@@ -1,0 +1,11 @@
+from tpulab.utils.argcfg import coerce_cli_kwargs, coerce_value
+from tpulab.utils.imgdata import ImgData, get_size
+from tpulab.utils.download import download_file
+
+__all__ = [
+    "ImgData",
+    "coerce_cli_kwargs",
+    "coerce_value",
+    "download_file",
+    "get_size",
+]

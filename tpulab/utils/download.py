@@ -1,0 +1,33 @@
+"""Streaming file download (reference ``utils/download_files.py:5-35`` parity).
+
+Used only for pulling optional extra benchmark PNGs; in an air-gapped
+environment the function degrades to a no-op that reports the failure.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def download_file(url: str, save_dir: str, filename: Optional[str] = None) -> Optional[str]:
+    """Download ``url`` into ``save_dir``; returns the path or None on failure."""
+    os.makedirs(save_dir, exist_ok=True)
+    name = filename or url.rstrip("/").rsplit("/", 1)[-1]
+    dest = os.path.join(save_dir, name)
+    if os.path.exists(dest):
+        return dest
+    try:
+        import requests
+
+        with requests.get(url, stream=True, timeout=30) as resp:
+            resp.raise_for_status()
+            tmp = dest + ".part"
+            with open(tmp, "wb") as f:
+                for chunk in resp.iter_content(chunk_size=1 << 16):
+                    f.write(chunk)
+            os.replace(tmp, dest)
+        return dest
+    except Exception as exc:  # offline / DNS-blocked environments
+        print(f"[download_file] skipped {url}: {exc}")
+        return None

@@ -15,6 +15,7 @@ for _sub in ("io", "ops", "labs", "parallel", "models", "harness", "runtime", "u
     try:
         _mod = __import__(f"tpulab.{_sub}", fromlist=[_sub])
         sys.modules[f"{__name__}.{_sub}"] = _mod
+        globals()[_sub] = _mod
     except ImportError:
         pass
 

@@ -1,0 +1,87 @@
+"""lab1 elementwise op tests: f64 oracle, Pallas tile sweep, CLI contract."""
+
+import numpy as np
+import pytest
+
+from tpulab.io import protocol
+from tpulab.labs import lab1
+from tpulab.ops.elementwise import subtract, subtract_oracle
+from tpulab.ops.pallas.elementwise import launch_to_tile_rows, pallas_binary
+from tpulab.runtime.timing import parse_timing_line
+
+import jax.numpy as jnp
+
+
+class TestSubtract:
+    def test_f64_oracle_extreme_range(self, rng):
+        # reference input synthesis: uniform doubles in [-1e100, 1e100]
+        a = rng.uniform(-1e100, 1e100, 2048)
+        b = rng.uniform(-1e100, 1e100, 2048)
+        out = np.asarray(subtract(a, b))
+        assert out.dtype == np.float64
+        np.testing.assert_allclose(out, subtract_oracle(a, b), atol=1e-10)
+
+    def test_f32_path(self, rng):
+        a = rng.normal(size=1000).astype(np.float32)
+        b = rng.normal(size=1000).astype(np.float32)
+        out = np.asarray(subtract(a, b))
+        np.testing.assert_allclose(out, a - b, rtol=1e-6)
+
+    def test_pallas_kernel_matches_xla(self, rng):
+        for n in (1, 127, 128, 1000, 4096, 100_000):
+            a = jnp.asarray(rng.normal(size=n).astype(np.float32))
+            b = jnp.asarray(rng.normal(size=n).astype(np.float32))
+            out = pallas_binary(a, b, jnp.subtract, tile_rows=64, interpret=True)
+            np.testing.assert_array_equal(np.asarray(out), np.asarray(a - b))
+
+    def test_launch_mapping(self):
+        assert launch_to_tile_rows(None) == 512
+        assert launch_to_tile_rows((1, 32)) == 8      # degenerate -> min tile
+        assert launch_to_tile_rows((256, 256)) == 512
+        assert launch_to_tile_rows((1024, 1024)) == 2048  # clamped
+        assert launch_to_tile_rows((512, 512)) == 2048
+
+    def test_other_ops(self, rng):
+        a = rng.normal(size=64)
+        b = rng.normal(size=64)
+        np.testing.assert_allclose(np.asarray(lab1.compute(a, b, op="add")), a + b)
+        np.testing.assert_allclose(
+            np.asarray(lab1.compute(a, b, op="multiply")), a * b, rtol=1e-12
+        )
+
+
+class TestLab1Protocol:
+    def _roundtrip(self, a, b, **kw):
+        text = protocol.format_lab1_input(a, b, launch=kw.pop("launch", None))
+        out = lab1.run(text, warmup=0, reps=1, **kw)
+        lines = out.split("\n")
+        ms = parse_timing_line(lines[0])
+        assert ms is not None and ms >= 0
+        return np.array([float(tok) for tok in lines[1].split()])
+
+    def test_end_to_end_f64(self, rng):
+        a = rng.uniform(-1e100, 1e100, 300)
+        b = rng.uniform(-1e100, 1e100, 300)
+        result = self._roundtrip(a, b)
+        # the compute must match the oracle on what was actually sent over
+        # the wire (%.10e quantizes the inputs; cancellation can amplify
+        # that quantization, so the pre-serialization arrays are not the
+        # right ground truth — the parsed ones are)
+        sent = protocol.parse_lab1(protocol.format_lab1_input(a, b))
+        np.testing.assert_allclose(result, sent.a - sent.b, rtol=1e-9)
+
+    def test_sweep_prefix(self, rng):
+        a = rng.normal(size=64)
+        b = rng.normal(size=64)
+        text = protocol.format_lab1_input(a, b, launch=(256, 256))
+        out = lab1.run(text, sweep=True, warmup=0, reps=1)
+        assert parse_timing_line(out) is not None
+
+    def test_payload_format_is_10e(self):
+        out = lab1.run("1\n2.0\n0.5\n", warmup=0, reps=1)
+        payload = out.split("\n")[1]
+        assert payload == "1.5000000000e+00 "
+
+    def test_timing_line_first_and_parsable(self):
+        out = lab1.run("2\n1 2\n3 4\n", warmup=0, reps=1)
+        assert parse_timing_line(out.split("\n")[0]) is not None

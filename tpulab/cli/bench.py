@@ -1,0 +1,21 @@
+"""``tpulab bench`` — run the benchmark suite and print JSON results.
+
+Benchmarks mirror the reference's published medians (see BASELINE.md);
+the repo-root ``bench.py`` wraps the headline metric for the driver.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List
+
+
+def run_bench_cli(extra: List[str]) -> int:
+    from tpulab.utils.argcfg import coerce_cli_kwargs
+    from tpulab.bench import run_benchmarks
+
+    cfg = coerce_cli_kwargs(extra or [])
+    results = run_benchmarks(**cfg)
+    for row in results:
+        print(json.dumps(row))
+    return 0

@@ -1,0 +1,46 @@
+"""Workload entry points speaking the suite's stdin/stdout contract.
+
+Every workload reads whitespace-delimited parameters/payload from stdin,
+prints a ``"<DEVICE> execution time: <T ms>"`` line first, and emits its
+payload to stdout or an output file — the exact contract of the reference
+binaries (see tpulab.io.protocol), so the experiment harness can drive
+Python entry points and native binaries interchangeably.
+"""
+
+from __future__ import annotations
+
+import importlib
+import sys
+from typing import List, Optional
+
+WORKLOADS = ("lab1", "lab2", "lab3", "lab5", "hw1", "hw2", "tpu_info")
+
+
+def get_workload(name: str):
+    if name == "gpu_info":  # alias for the reference tool's name
+        name = "tpu_info"
+    if name not in WORKLOADS:
+        raise KeyError(f"unknown workload {name!r}; have {WORKLOADS}")
+    try:
+        return importlib.import_module(f"tpulab.labs.{name}")
+    except ModuleNotFoundError as exc:
+        raise NotImplementedError(f"workload {name!r} is not implemented yet") from exc
+
+
+def run_workload(
+    name: str,
+    sweep: bool = False,
+    backend: Optional[str] = None,
+    extra: Optional[List[str]] = None,
+    stdin_text: Optional[str] = None,
+) -> int:
+    """Run one workload over the stdin/stdout protocol; returns exit code."""
+    from tpulab.utils.argcfg import coerce_cli_kwargs
+
+    mod = get_workload(name)
+    cfg = coerce_cli_kwargs(extra or [])
+    text = stdin_text if stdin_text is not None else sys.stdin.read()
+    out = mod.run(text, sweep=sweep, backend=backend, **cfg)
+    sys.stdout.write(out)
+    sys.stdout.flush()
+    return 0

@@ -1,0 +1,59 @@
+"""lab1 — elementwise vector subtraction over the stdin protocol.
+
+Contract (reference ``lab1/src/to_plot.cu:33-88``): read optional
+``grid block`` sweep prefix, then ``n`` and two n-vectors of doubles from
+stdin; print the timing line first, then the result as ``%.10e``-formatted
+space-separated values.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpulab.io import protocol
+from tpulab.ops.elementwise import binary_op
+from tpulab.runtime.device import cpu_device, default_device
+from tpulab.runtime.timing import format_timing_line, measure_ms
+
+_DTYPES = {"float64": jnp.float64, "float32": jnp.float32, "bfloat16": jnp.bfloat16}
+
+
+def compute(a, b, *, op: str = "subtract", launch=None, backend=None):
+    return binary_op(op, a, b, launch=launch, backend=backend)
+
+
+def run(
+    text: str,
+    sweep: bool = False,
+    backend: Optional[str] = None,
+    *,
+    op: str = "subtract",
+    dtype: str = "float64",
+    warmup: int = 2,
+    reps: int = 5,
+    **_ignored,
+) -> str:
+    """Process one stdin payload; returns the full stdout content."""
+    inp = protocol.parse_lab1(text, sweep=sweep)
+    if dtype not in _DTYPES:
+        raise ValueError(f"unsupported dtype {dtype!r}; have {sorted(_DTYPES)}")
+    dt = _DTYPES[dtype]
+    # Commit inputs to their execution device BEFORE timing, so the timed
+    # region measures compute only (f64 lives on the CPU backend — TPUs
+    # have no native f64; see tpulab.ops.elementwise).
+    if dt == jnp.float64:
+        device = cpu_device() if backend in (None, "auto", "cpu") else jax.devices(backend)[0]
+    else:
+        device = default_device() if backend in (None, "auto") else jax.devices(backend)[0]
+    a = jax.device_put(jnp.asarray(inp.a, dtype=dt), device)
+    b = jax.device_put(jnp.asarray(inp.b, dtype=dt), device)
+
+    fn = jax.tree_util.Partial(compute, op=op, launch=inp.launch, backend=backend)
+    ms, out = measure_ms(fn, (a, b), warmup=warmup, reps=reps)
+
+    label = "TPU" if out.devices().pop().platform == "tpu" else "CPU"
+    payload = protocol.format_vector_10e(jax.device_get(out))
+    return format_timing_line(label, ms) + "\n" + payload

@@ -1,0 +1,3 @@
+from tpulab.ops.elementwise import add, binary_op, multiply, subtract
+
+__all__ = ["add", "binary_op", "multiply", "subtract"]

@@ -1,0 +1,96 @@
+"""Elementwise vector ops (the lab1 workload family).
+
+Reference semantics: double-precision elementwise subtraction over vectors
+whose values span [-1e100, 1e100] (reference ``lab1/src/main.cu:22-29``;
+input synthesis ``lab1/lab1_processor.py:30-36``).  TPUs have no native
+f64, so the dtype decides the execution path:
+
+* ``float64`` — exact-semantics path, jitted on the **CPU backend**
+  (XLA CPU does native f64; values at 1e100 overflow any 32-bit float).
+* ``float32`` / ``bfloat16`` — TPU fast path via the block-tiled Pallas
+  kernel (:mod:`tpulab.ops.pallas.elementwise`) or fused XLA.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpulab.ops.pallas.elementwise import launch_to_tile_rows, pallas_binary
+from tpulab.runtime.device import cpu_device, default_device
+
+_OPS = {
+    "subtract": jnp.subtract,
+    "add": jnp.add,
+    "multiply": jnp.multiply,
+    "minimum": jnp.minimum,
+    "maximum": jnp.maximum,
+}
+
+
+@functools.partial(jax.jit, static_argnames=("op",))
+def _xla_binary(a, b, op: str):
+    return _OPS[op](a, b)
+
+
+def binary_op(
+    name: str,
+    a,
+    b,
+    *,
+    launch: Optional[Tuple[int, int]] = None,
+    backend: Optional[str] = None,
+    use_pallas: Optional[bool] = None,
+) -> jax.Array:
+    """Elementwise ``name`` over two vectors with dtype-driven placement.
+
+    ``launch`` is the CUDA-style ``(grid, block)`` sweep parameter; it maps
+    to the Pallas tile height (see ``launch_to_tile_rows``).
+    """
+    if name not in _OPS:
+        raise ValueError(f"unknown op {name!r}; have {sorted(_OPS)}")
+    a = jnp.asarray(a)
+    b = jnp.asarray(b)
+    if a.dtype != b.dtype:
+        raise ValueError(f"dtype mismatch: {a.dtype} vs {b.dtype}")
+
+    if a.dtype == jnp.float64:
+        device = cpu_device() if backend in (None, "auto", "cpu") else jax.devices(backend)[0]
+        a = jax.device_put(a, device)
+        b = jax.device_put(b, device)
+        return _xla_binary(a, b, name)
+
+    device = default_device() if backend in (None, "auto") else jax.devices(backend)[0]
+    a = jax.device_put(a, device)
+    b = jax.device_put(b, device)
+    if use_pallas is None:
+        use_pallas = device.platform == "tpu"
+    if use_pallas and a.ndim == 1:
+        return pallas_binary(
+            a, b, _OPS[name], tile_rows=launch_to_tile_rows(launch),
+            interpret=device.platform != "tpu",
+        )
+    return _xla_binary(a, b, name)
+
+
+def subtract(a, b, **kw) -> jax.Array:
+    """``a - b`` (the lab1 kernel, reference lab1/src/main.cu:26)."""
+    return binary_op("subtract", a, b, **kw)
+
+
+def add(a, b, **kw) -> jax.Array:
+    return binary_op("add", a, b, **kw)
+
+
+def multiply(a, b, **kw) -> jax.Array:
+    return binary_op("multiply", a, b, **kw)
+
+
+def subtract_oracle(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """NumPy f64 ground truth (the reference harness's intended oracle,
+    lab1/lab1_processor.py:62-66)."""
+    return np.asarray(a, np.float64) - np.asarray(b, np.float64)

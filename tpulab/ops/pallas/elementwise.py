@@ -1,0 +1,90 @@
+"""Block-tiled elementwise Pallas TPU kernels.
+
+TPU-native counterpart of the reference's 1D grid-stride CUDA kernel
+(reference ``lab1/src/main.cu:22-29``): instead of a thread grid striding
+over elements, a 1D Pallas grid iterates over row-tiles of the vector
+reshaped to ``(rows, 128)`` lanes, so the VPU processes 8x128 vregs and
+the launch-geometry sweep becomes a tile-height sweep.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128
+MIN_ROWS = 8       # f32 sublane minimum
+MAX_ROWS = 2048    # 3 buffers x 2048 x 128 x 4B = 3 MB VMEM — comfortable
+
+
+def launch_to_tile_rows(launch: Tuple[int, int] | None) -> int:
+    """Map a CUDA-style ``(grid, block)`` launch config to a tile height.
+
+    The CUDA wave processes ``grid*block`` elements per stride iteration
+    (reference lab1/src/to_plot.cu:72 launches ``<<<grid, block>>>``); the
+    Pallas analog is a tile of ``grid*block`` elements == ``grid*block/128``
+    rows of 128 lanes, clamped to hardware-sane bounds.  Degenerate configs
+    like ``(1, 32)`` therefore map to deliberately tiny (minimum) tiles,
+    preserving the harness sweep's "bad config costs you" property.
+    """
+    if launch is None:
+        return 512
+    grid, block = launch
+    rows = max(1, (max(1, grid) * max(1, block)) // LANES)
+    rows = (rows + MIN_ROWS - 1) // MIN_ROWS * MIN_ROWS
+    return max(MIN_ROWS, min(MAX_ROWS, rows))
+
+
+def _ew_kernel(op: Callable, a_ref, b_ref, o_ref):
+    o_ref[:] = op(a_ref[:], b_ref[:])
+
+
+@functools.partial(jax.jit, static_argnames=("op", "tile_rows", "interpret"))
+def _ew_padded(a2d, b2d, op: Callable, tile_rows: int, interpret: bool):
+    rows = a2d.shape[0]
+    grid = pl.cdiv(rows, tile_rows)
+    spec = pl.BlockSpec((tile_rows, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        functools.partial(_ew_kernel, op),
+        out_shape=jax.ShapeDtypeStruct(a2d.shape, a2d.dtype),
+        grid=(grid,),
+        in_specs=[spec, spec],
+        out_specs=spec,
+        interpret=interpret,
+    )(a2d, b2d)
+
+
+def pallas_binary(
+    a: jax.Array,
+    b: jax.Array,
+    op: Callable = jnp.subtract,
+    *,
+    tile_rows: int = 512,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Apply a binary elementwise ``op`` over 1D arrays via a tiled kernel.
+
+    Arbitrary lengths are zero-padded up to a whole ``(rows, 128)`` layout;
+    the pad region's results are sliced away.  ``interpret`` defaults to
+    True off-TPU (Pallas TPU kernels have no compiled CPU lowering).
+    """
+    if a.shape != b.shape or a.ndim != 1:
+        raise ValueError(f"expected equal-shape 1D arrays, got {a.shape} vs {b.shape}")
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    n = a.shape[0]
+    rows_aligned = -(-max(1, -(-n // LANES)) // MIN_ROWS) * MIN_ROWS
+    # never let the tile exceed the (aligned) input — a small vector must
+    # not be padded up to a full large tile of dead work
+    tile_rows = max(MIN_ROWS, min(MAX_ROWS, int(tile_rows), rows_aligned))
+    rows = -(-rows_aligned // tile_rows) * tile_rows
+    padded = rows * LANES
+    a2d = jnp.pad(a, (0, padded - n)).reshape(rows, LANES)
+    b2d = jnp.pad(b, (0, padded - n)).reshape(rows, LANES)
+    out = _ew_padded(a2d, b2d, op, tile_rows, interpret)
+    return out.reshape(padded)[:n]

@@ -1,0 +1,106 @@
+"""hw1/hw2/lab5/tpu_info workload tests."""
+
+import subprocess
+
+import numpy as np
+import pytest
+
+from tpulab.io import load_typed_array, save_typed_array
+from tpulab.labs import hw1, hw2, lab5, tpu_info
+from tpulab.ops.quadratic import ANY, INCORRECT, NO_REAL, ONE_ROOT, TWO_ROOTS, solve_batch, solve_scalar
+from tpulab.runtime.timing import parse_timing_line
+
+import jax.numpy as jnp
+
+
+class TestHw1:
+    # cases mirroring every branch of reference hw1/src/main.c:8-32
+    CASES = [
+        ((0, 0, 0), "any"),
+        ((0, 0, 5), "incorrect"),
+        ((0, 2, -4), "2.000000"),
+        ((1, -3, 2), "2.000000 1.000000"),
+        ((1, 2, 1), "-1.000000"),
+        ((1, 0, 1), "imaginary"),
+    ]
+
+    @pytest.mark.parametrize("coeffs,expect", CASES)
+    def test_scalar_branches(self, coeffs, expect):
+        assert solve_scalar(*coeffs) == expect
+
+    def test_stdin_contract(self):
+        assert hw1.run("1 -3 2\n") == "2.000000 1.000000\n"
+
+    def test_timing_flag(self):
+        out = hw1.run("1 -3 2\n", timing=True)
+        lines = out.splitlines()
+        assert parse_timing_line(lines[0]) is not None
+        assert lines[1] == "2.000000 1.000000"
+
+    def test_batched_solver_agrees(self):
+        coeffs = np.array([c for c, _ in self.CASES], np.float32)
+        status, roots = solve_batch(jnp.asarray(coeffs))
+        status = np.asarray(status)
+        roots = np.asarray(roots)
+        assert list(status) == [ANY, INCORRECT, ONE_ROOT, TWO_ROOTS, ONE_ROOT, NO_REAL]
+        np.testing.assert_allclose(roots[2, 0], 2.0)
+        np.testing.assert_allclose(roots[3], [2.0, 1.0])
+        np.testing.assert_allclose(roots[4, 0], -1.0)
+
+
+class TestHw2:
+    def test_sort_contract(self):
+        out = hw2.run("4\n3.5 -1.0 2.25 0.0\n", warmup=0, reps=1)
+        assert out == "-1.000000e+00 0.000000e+00 2.250000e+00 3.500000e+00 \n"
+
+    def test_timing_flag(self, rng):
+        vals = rng.normal(size=100).astype(np.float32)
+        text = f"{len(vals)}\n" + " ".join(str(v) for v in vals) + "\n"
+        out = hw2.run(text, timing=True, warmup=0, reps=1)
+        lines = out.splitlines()
+        assert parse_timing_line(lines[0]) is not None
+        parsed = np.array([float(t) for t in lines[1].split()], np.float32)
+        np.testing.assert_allclose(parsed, np.sort(vals), rtol=1e-6)
+
+
+class TestLab5:
+    def test_sum_reference_fixture(self, reference_root):
+        out = lab5.run(str(reference_root / "lab5/data/int10") + "\n", warmup=0, reps=1)
+        lines = out.splitlines()
+        assert parse_timing_line(lines[0]) is not None
+        assert lines[1] == "45"  # 0+9+8+...+1
+
+    def test_float_reduction(self, reference_root):
+        out = lab5.run(
+            str(reference_root / "lab5/data/float10") + "\n",
+            task="max",
+            warmup=0,
+            reps=1,
+        )
+        assert out.splitlines()[1] == f"{9.0:.6e}"
+
+    def test_uchar_sum(self, reference_root):
+        out = lab5.run(
+            str(reference_root / "lab5/data/uchar10") + "\n", warmup=0, reps=1
+        )
+        assert out.splitlines()[1] == "22"  # 1+2+3+1+2+3+1+2+3+4
+
+    def test_sort_roundtrip(self, tmp_path, rng):
+        vals = rng.integers(-1000, 1000, size=37).astype(np.int32)
+        inp = str(tmp_path / "int37")
+        outp = str(tmp_path / "int37_sorted")
+        save_typed_array(inp, vals)
+        out = lab5.run(f"{inp}\n{outp}\n", task="sort", warmup=0, reps=1)
+        assert parse_timing_line(out) is not None
+        np.testing.assert_array_equal(load_typed_array(outp), np.sort(vals))
+
+    def test_unknown_task(self, reference_root):
+        with pytest.raises(ValueError):
+            lab5.run(str(reference_root / "lab5/data/int10") + "\n", task="median")
+
+
+class TestTpuInfo:
+    def test_reports_devices(self):
+        out = tpu_info.run("")
+        assert "Device 0:" in out and "platform: cpu" in out
+        assert "num_devices: 8" in out  # virtual CPU mesh from conftest

@@ -1,0 +1,111 @@
+"""lab2 Roberts tests: golden bit-exactness, C-semantics oracle, Pallas parity."""
+
+import numpy as np
+import pytest
+
+from tpulab.io import load_image, protocol, save_image
+from tpulab.labs import lab2
+from tpulab.ops.roberts import roberts_edges
+from tpulab.ops.pallas.stencil import launch_to_tile, roberts_pallas
+from tpulab.runtime.timing import parse_timing_line
+
+
+def roberts_oracle_c(pixels: np.ndarray) -> np.ndarray:
+    """Independent NumPy float32 re-statement of the C reference semantics
+    (lab2/src/main.c:14-59): clamp addressing, f32 luminance, sqrt,
+    clamp+truncate. Pure numpy — no jax — for triangulation."""
+    h, w = pixels.shape[:2]
+    rgb = pixels[..., :3].astype(np.float32)
+    y = (
+        np.float32(0.299) * rgb[..., 0]
+        + np.float32(0.587) * rgb[..., 1]
+        + np.float32(0.114) * rgb[..., 2]
+    )
+    ypad = np.pad(y, ((0, 1), (0, 1)), mode="edge")
+    y00 = ypad[:h, :w]
+    y10 = ypad[:h, 1 : w + 1]
+    y01 = ypad[1 : h + 1, :w]
+    y11 = ypad[1 : h + 1, 1 : w + 1]
+    gx = y11 - y00
+    gy = y10 - y01
+    g = np.sqrt(gx * gx + gy * gy, dtype=np.float32)
+    g = np.clip(g, np.float32(0.0), np.float32(255.0))
+    g8 = g.astype(np.uint8)  # C truncation
+    out = np.stack([g8, g8, g8, pixels[..., 3]], axis=-1)
+    return out
+
+
+class TestGolden:
+    @pytest.mark.parametrize("name", ["test_01", "test_02"])
+    def test_reference_goldens_bit_exact(self, reference_root, name):
+        img = load_image(str(reference_root / f"lab2/data/{name}.txt"))
+        expect = load_image(str(reference_root / f"lab2/data_out_gt/{name}.txt"))
+        out = np.asarray(roberts_edges(img))
+        np.testing.assert_array_equal(out, expect)
+
+    def test_lenna_note(self, reference_root):
+        # lab2/test_data/lenna_out.data predates the committed kernel (its
+        # pixels are not gray, the committed kernel always emits r==g==b),
+        # so it is NOT a golden. We instead pin lenna against the
+        # independent C-semantics numpy oracle, bit-exact.
+        img = load_image(str(reference_root / "lab2/test_data/lenna.data"))
+        out = np.asarray(roberts_edges(img))
+        np.testing.assert_array_equal(out, roberts_oracle_c(img))
+
+    def test_random_images_vs_oracle(self, rng):
+        for h, w in [(1, 1), (1, 5), (3, 3), (17, 31), (64, 129)]:
+            img = rng.integers(0, 256, size=(h, w, 4), dtype=np.uint8)
+            np.testing.assert_array_equal(
+                np.asarray(roberts_edges(img)), roberts_oracle_c(img)
+            )
+
+    def test_alpha_preserved(self, rng):
+        img = rng.integers(0, 256, size=(4, 4, 4), dtype=np.uint8)
+        out = np.asarray(roberts_edges(img))
+        np.testing.assert_array_equal(out[..., 3], img[..., 3])
+
+
+class TestPallasStencil:
+    def test_matches_jnp_bit_exact(self, rng):
+        for h, w in [(3, 3), (16, 130), (64, 257), (200, 100)]:
+            img = rng.integers(0, 256, size=(h, w, 4), dtype=np.uint8)
+            out_p = np.asarray(roberts_pallas(img, interpret=True))
+            out_j = np.asarray(roberts_edges(img))
+            np.testing.assert_array_equal(out_p, out_j)
+
+    def test_sweep_tile_config(self, rng):
+        img = rng.integers(0, 256, size=(40, 300, 4), dtype=np.uint8)
+        out = np.asarray(roberts_pallas(img, launch=(32, 32, 16, 16), interpret=True))
+        np.testing.assert_array_equal(out, roberts_oracle_c(img))
+
+    def test_launch_to_tile_mapping(self):
+        assert launch_to_tile(None, 2048, 2048) == (256, 512)
+        assert launch_to_tile((32, 32, 16, 16), 2048, 2048) == (256, 512)
+        assert launch_to_tile((2, 2, 16, 16), 2048, 2048) == (16, 128)
+        assert launch_to_tile((16, 16, 1024, 1024), 2048, 2048) == (128, 256)
+        # small image clamps the tile
+        assert launch_to_tile((32, 32, 16, 16), 3, 3) == (8, 128)
+
+
+class TestLab2Protocol:
+    def test_end_to_end(self, tmp_path, rng, reference_root):
+        src = str(reference_root / "lab2/data/test_01.txt")
+        img = load_image(src)
+        inp = str(tmp_path / "in.data")
+        out = str(tmp_path / "out.data")
+        save_image(inp, img)
+        text = protocol.format_lab2_input(inp, out)
+        stdout = lab2.run(text, warmup=0, reps=1)
+        assert parse_timing_line(stdout) is not None
+        expect = load_image(str(reference_root / "lab2/data_out_gt/test_01.txt"))
+        np.testing.assert_array_equal(load_image(out), expect)
+
+    def test_sweep_mode_prints_finished(self, tmp_path, rng):
+        img = rng.integers(0, 256, size=(3, 3, 4), dtype=np.uint8)
+        inp = str(tmp_path / "in.data")
+        out = str(tmp_path / "out.data")
+        save_image(inp, img)
+        text = protocol.format_lab2_input(inp, out, launch=(32, 32, 16, 16))
+        stdout = lab2.run(text, sweep=True, warmup=0, reps=1)
+        assert stdout.splitlines()[0].startswith("CPU execution time")
+        assert stdout.splitlines()[1] == "FINISHED!"

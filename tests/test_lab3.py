@@ -1,0 +1,145 @@
+"""lab3 Mahalanobis classifier tests: golden, statistics, Pallas parity."""
+
+import numpy as np
+import pytest
+
+from tpulab.io import load_image, protocol, save_image
+from tpulab.labs import lab3
+from tpulab.ops.mahalanobis import ClassStats, class_statistics, classify, classify_labels
+from tpulab.runtime.timing import parse_timing_line
+
+import jax.numpy as jnp
+
+# the reference harness's hard-coded class definition for the golden
+# fixture (lab3/lab3_processor.py MAP_TO_INIT_POINTS)
+GOLDEN_CLASSES = [
+    np.array([[1, 2], [1, 0], [2, 2], [2, 1]]),
+    np.array([[0, 0], [0, 1], [1, 1], [2, 0]]),
+]
+
+
+def classify_oracle(pixels, stats):
+    """Pure-NumPy f64 restatement of the classify kernel (main.cu:40-76)."""
+    h, w = pixels.shape[:2]
+    p = pixels[..., :3].astype(np.float64)
+    labels = np.zeros((h, w), np.uint8)
+    for y in range(h):
+        for x in range(w):
+            best, best_d = -1, np.inf
+            for c in range(len(stats.mean)):
+                d = p[y, x] - stats.mean[c]
+                t = d @ stats.inv_cov[c]
+                dist = float(t @ d)
+                if dist < best_d:
+                    best_d, best = dist, c
+            labels[y, x] = best
+    return labels
+
+
+class TestGolden:
+    def test_reference_golden_bit_exact(self, reference_root):
+        img = load_image(str(reference_root / "lab3/data/test_01_lab3.txt"))
+        expect = load_image(str(reference_root / "lab3/data_out_gt/test_01_lab3.txt"))
+        stats = class_statistics(img, GOLDEN_CLASSES)
+        out = np.asarray(classify(img, stats))
+        np.testing.assert_array_equal(out, expect)
+
+    def test_golden_with_f32_kernel(self, reference_root):
+        # the TPU fast path computes in f32; labels must agree on the golden
+        img = load_image(str(reference_root / "lab3/data/test_01_lab3.txt"))
+        expect = load_image(str(reference_root / "lab3/data_out_gt/test_01_lab3.txt"))
+        stats = class_statistics(img, GOLDEN_CLASSES)
+        labels = np.asarray(
+            classify_labels(img, jnp.asarray(stats.mean), jnp.asarray(stats.inv_cov))
+        )
+        np.testing.assert_array_equal(labels, expect[..., 3])
+
+
+class TestStatistics:
+    def test_mean_and_cov(self, rng):
+        img = rng.integers(0, 256, size=(8, 8, 4), dtype=np.uint8)
+        pts = np.array([[0, 0], [1, 1], [2, 2], [3, 3], [4, 4]])
+        stats = class_statistics(img, [pts])
+        samples = img[pts[:, 1], pts[:, 0], :3].astype(np.float64)
+        np.testing.assert_allclose(stats.mean[0], samples.mean(0))
+        cov = np.cov(samples.T, ddof=1)
+        np.testing.assert_allclose(stats.inv_cov[0], np.linalg.inv(cov), rtol=1e-8)
+
+    def test_single_point_class_degenerate(self, rng):
+        # /(np-1) with np==1 -> division by zero, preserved from main.cu:137
+        img = rng.integers(0, 256, size=(4, 4, 4), dtype=np.uint8)
+        stats = class_statistics(img, [np.array([[0, 0]])])
+        assert not np.isfinite(stats.inv_cov[0]).all()
+
+    def test_max_classes_enforced(self, rng):
+        img = rng.integers(0, 256, size=(4, 4, 4), dtype=np.uint8)
+        with pytest.raises(ValueError):
+            class_statistics(img, [np.array([[0, 0]])] * 33)
+
+
+class TestClassify:
+    def _random_case(self, rng, h=12, w=17, nc=3):
+        img = rng.integers(0, 256, size=(h, w, 4), dtype=np.uint8)
+        classes = []
+        for _ in range(nc):
+            pts = np.stack(
+                [rng.integers(0, w, size=5), rng.integers(0, h, size=5)], axis=1
+            )
+            classes.append(pts)
+        return img, class_statistics(img, classes)
+
+    def test_matches_oracle_f64(self, rng):
+        img, stats = self._random_case(rng)
+        out = np.asarray(classify(img, stats, compute_dtype=jnp.float64))
+        np.testing.assert_array_equal(out[..., 3], classify_oracle(img, stats))
+        np.testing.assert_array_equal(out[..., :3], img[..., :3])  # RGB preserved
+
+    def test_pallas_matches_jnp(self, rng):
+        from tpulab.ops.pallas.classify import classify_labels_pallas
+
+        img, stats = self._random_case(rng, h=33, w=70, nc=4)
+        mu = jnp.asarray(stats.mean, jnp.float32)
+        ic = jnp.asarray(stats.inv_cov, jnp.float32)
+        ref = np.asarray(classify_labels(img, mu, ic, compute_dtype=jnp.float32))
+        out = np.asarray(classify_labels_pallas(img, mu, ic, interpret=True))
+        np.testing.assert_array_equal(out, ref)
+
+    def test_pallas_sweep_configs(self, rng):
+        from tpulab.ops.pallas.classify import classify_labels_pallas, launch_to_rows
+
+        assert launch_to_rows(None) == 512
+        assert launch_to_rows((1, 32)) == 8
+        assert launch_to_rows((256, 256)) == 512
+        img, stats = self._random_case(rng, h=9, w=200, nc=2)
+        mu = jnp.asarray(stats.mean, jnp.float32)
+        ic = jnp.asarray(stats.inv_cov, jnp.float32)
+        ref = np.asarray(classify_labels(img, mu, ic, compute_dtype=jnp.float32))
+        for launch in [(1, 32), (16, 16), (256, 256)]:
+            out = np.asarray(
+                classify_labels_pallas(img, mu, ic, launch=launch, interpret=True)
+            )
+            np.testing.assert_array_equal(out, ref)
+
+
+class TestLab3Protocol:
+    def test_end_to_end_golden(self, tmp_path, reference_root):
+        img = load_image(str(reference_root / "lab3/data/test_01_lab3.txt"))
+        inp = str(tmp_path / "in.data")
+        outp = str(tmp_path / "out.data")
+        save_image(inp, img)
+        text = protocol.format_lab3_input(inp, outp, GOLDEN_CLASSES)
+        stdout = lab3.run(text, warmup=0, reps=1)
+        assert parse_timing_line(stdout) is not None
+        expect = load_image(str(reference_root / "lab3/data_out_gt/test_01_lab3.txt"))
+        np.testing.assert_array_equal(load_image(outp), expect)
+
+    def test_sweep_prefix(self, tmp_path, rng):
+        img = rng.integers(0, 256, size=(3, 3, 4), dtype=np.uint8)
+        inp = str(tmp_path / "in.data")
+        outp = str(tmp_path / "out.data")
+        save_image(inp, img)
+        text = protocol.format_lab3_input(
+            inp, outp, [np.array([[0, 0], [1, 1]])], launch=(256, 256)
+        )
+        stdout = lab3.run(text, sweep=True, warmup=0, reps=1)
+        assert parse_timing_line(stdout) is not None

@@ -8,39 +8,36 @@ steady-state median (compile excluded, inputs pre-committed).
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
-# Best-config CUDA medians from BASELINE.md to compare against.
+# Best-config CUDA medians from BASELINE.md to compare against.  Keys with
+# no published reference number are absent — vs_baseline is then null.
 CUDA_BASELINES_MS = {
-    "lab1_n1000": 0.14336,       # lab1 [512,512]
-    "lab1_n1m": 0.14336,         # no published large-n number; launch floor
+    "lab1_n1000": 0.14336,         # lab1 [512,512]
     "lab2_roberts_1024": 0.17866,  # lab2 large-tier best [[32,32],[16,16]]
 }
 
 
 def bench_lab1(n: int = 1000, dtype: str = "float64", reps: int = 20) -> Dict[str, Any]:
+    import jax
     import jax.numpy as jnp
 
-    from tpulab.labs import lab1 as lab1_mod
-    from tpulab.ops.elementwise import binary_op
+    from tpulab.ops.elementwise import make_binary_fn, resolve_binary_device
     from tpulab.runtime.timing import measure_ms
 
     rng = np.random.default_rng(0)
     a = rng.uniform(-1e3, 1e3, n)
     b = rng.uniform(-1e3, 1e3, n)
-    import jax
-
     dt = {"float64": jnp.float64, "float32": jnp.float32, "bfloat16": jnp.bfloat16}[dtype]
-    from tpulab.runtime.device import cpu_device, default_device
-
-    device = cpu_device() if dt == jnp.float64 else default_device()
+    device = resolve_binary_device(dt)
     aj = jax.device_put(jnp.asarray(a, dt), device)
     bj = jax.device_put(jnp.asarray(b, dt), device)
-    ms, _ = measure_ms(lambda x, y: binary_op("subtract", x, y), (aj, bj), warmup=3, reps=reps)
-    key = "lab1_n1000" if n == 1000 else "lab1_n1m"
-    base = CUDA_BASELINES_MS.get(key)
+    fn = make_binary_fn("subtract", dt, device=device)
+    ms, _ = measure_ms(fn, (aj, bj), warmup=3, reps=reps)
+    base = CUDA_BASELINES_MS.get("lab1_n1000") if n == 1000 and dtype == "float64" else None
     return {
         "metric": f"lab1_subtract_n{n}_{dtype}_median_ms",
         "value": round(ms, 6),
@@ -51,10 +48,16 @@ def bench_lab1(n: int = 1000, dtype: str = "float64", reps: int = 20) -> Dict[st
 
 
 def run_benchmarks(only: Optional[str] = None, **kw) -> List[Dict[str, Any]]:
-    """Run all registered benchmarks (or one, by substring match)."""
+    """Run all registered benchmarks (or one, by substring match).
+
+    Extra kwargs (``reps``, ``size``, ``nc``, ``use_pallas``, ...) are
+    forwarded to each benchmark that declares the parameter.
+    """
+    import inspect
+
     registry = {
-        "lab1_n1000": lambda: bench_lab1(1000),
-        "lab1_f32_1m": lambda: bench_lab1(1 << 20, dtype="float32"),
+        "lab1_n1000": functools.partial(bench_lab1, 1000),
+        "lab1_f32_1m": functools.partial(bench_lab1, 1 << 20, dtype="float32"),
     }
     try:
         from tpulab.bench_image import bench_lab2, bench_lab3  # lands with lab2/lab3
@@ -67,5 +70,8 @@ def run_benchmarks(only: Optional[str] = None, **kw) -> List[Dict[str, Any]]:
     for name, fn in registry.items():
         if only and only not in name:
             continue
-        rows.append(fn())
+        base_fn = fn.func if isinstance(fn, functools.partial) else fn
+        params = inspect.signature(base_fn).parameters
+        accepted = {k: v for k, v in kw.items() if k in params}
+        rows.append(fn(**accepted))
     return rows

@@ -1,0 +1,71 @@
+"""Image-workload benchmarks (lab2 Roberts, lab3 classify) at 1024x1024.
+
+The 1024x1024 tier is the BASELINE.json target class ("lab2 2D image
+filter 512x512 -> 1024x1024"); the CUDA comparison number is the large-tier
+best-config median 0.17866 ms (BASELINE.md).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+from tpulab.bench import CUDA_BASELINES_MS
+
+
+def _test_image(h: int = 1024, w: int = 1024) -> np.ndarray:
+    rng = np.random.default_rng(7)
+    return rng.integers(0, 256, size=(h, w, 4), dtype=np.uint8)
+
+
+def bench_lab2(size: int = 1024, reps: int = 30, use_pallas=None) -> Dict[str, Any]:
+    import jax
+    import jax.numpy as jnp
+
+    from tpulab.ops.roberts import roberts
+    from tpulab.runtime.device import default_device
+    from tpulab.runtime.timing import measure_ms
+
+    device = default_device()
+    x = jax.device_put(jnp.asarray(_test_image(size, size)), device)
+    ms, _ = measure_ms(
+        lambda img: roberts(img, use_pallas=use_pallas), (x,), warmup=3, reps=reps
+    )
+    base = CUDA_BASELINES_MS["lab2_roberts_1024"]
+    return {
+        "metric": f"lab2_roberts_{size}x{size}_median_ms",
+        "value": round(ms, 6),
+        "unit": "ms",
+        "vs_baseline": round(base / ms, 3),
+        "device": device.platform,
+    }
+
+
+def bench_lab3(size: int = 1024, nc: int = 8, reps: int = 30, use_pallas=None) -> Dict[str, Any]:
+    import jax
+    import jax.numpy as jnp
+
+    from tpulab.ops.mahalanobis import class_statistics, classify
+    from tpulab.runtime.device import default_device
+    from tpulab.runtime.timing import measure_ms
+
+    rng = np.random.default_rng(11)
+    img = _test_image(size, size)
+    classes = [
+        np.stack([rng.integers(0, size, 16), rng.integers(0, size, 16)], axis=1)
+        for _ in range(nc)
+    ]
+    stats = class_statistics(img, classes)
+    device = default_device()
+    x = jax.device_put(jnp.asarray(img), device)
+    ms, _ = measure_ms(
+        lambda i: classify(i, stats, use_pallas=use_pallas), (x,), warmup=3, reps=reps
+    )
+    return {
+        "metric": f"lab3_classify_{size}x{size}_nc{nc}_median_ms",
+        "value": round(ms, 6),
+        "unit": "ms",
+        "vs_baseline": None,  # no published lab3 baseline (BASELINE.md)
+        "device": device.platform,
+    }

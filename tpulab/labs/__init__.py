@@ -24,6 +24,8 @@ def get_workload(name: str):
     try:
         return importlib.import_module(f"tpulab.labs.{name}")
     except ModuleNotFoundError as exc:
+        if exc.name != f"tpulab.labs.{name}":
+            raise  # a real missing dependency inside the workload module
         raise NotImplementedError(f"workload {name!r} is not implemented yet") from exc
 
 

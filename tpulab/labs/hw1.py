@@ -1,0 +1,32 @@
+"""hw1 — quadratic-equation solver over the stdin protocol.
+
+Contract (reference ``hw1/src/main.c:4-35``): read ``a b c`` floats, print
+the roots as ``%.6f`` (or ``any``/``incorrect``/``imaginary``).  The
+reference prints no timing line; pass ``--timing`` to prepend one (the
+harness-driven extension).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from tpulab.io import protocol
+from tpulab.ops.quadratic import solve_scalar
+from tpulab.runtime.timing import format_timing_line, measure_ms
+
+
+def run(
+    text: str,
+    sweep: bool = False,
+    backend: Optional[str] = None,
+    *,
+    timing: bool = False,
+    warmup: int = 0,
+    reps: int = 1,
+    **_ignored,
+) -> str:
+    a, b, c = protocol.parse_hw1(text)
+    if timing:
+        ms, line = measure_ms(solve_scalar, (a, b, c), warmup=warmup, reps=max(reps, 1))
+        return format_timing_line("CPU", ms) + "\n" + line + "\n"
+    return solve_scalar(a, b, c) + "\n"

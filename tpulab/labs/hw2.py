@@ -1,0 +1,42 @@
+"""hw2 — ascending float sort over the stdin protocol.
+
+Contract (reference ``hw2/src/main.c:17-42``): read ``n`` then n floats,
+print the sorted values as ``%.6e`` space-separated plus newline.  The
+reference prints no timing line; ``--timing`` prepends one.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpulab.io import protocol
+from tpulab.ops.sortops import sort_op
+from tpulab.runtime.device import default_device
+from tpulab.runtime.timing import format_timing_line, measure_ms
+
+
+def run(
+    text: str,
+    sweep: bool = False,
+    backend: Optional[str] = None,
+    *,
+    timing: bool = False,
+    warmup: int = 2,
+    reps: int = 5,
+    **_ignored,
+) -> str:
+    values = protocol.parse_hw2(text)
+    device = default_device() if backend in (None, "auto") else jax.devices(backend)[0]
+    x = jax.device_put(jnp.asarray(values, jnp.float32), device)
+
+    if timing:
+        ms, out = measure_ms(sort_op, (x,), warmup=warmup, reps=reps)
+        label = "TPU" if device.platform == "tpu" else "CPU"
+        prefix = format_timing_line(label, ms) + "\n"
+    else:
+        out = sort_op(x)
+        prefix = ""
+    return prefix + protocol.format_vector_6e(jax.device_get(out))

@@ -14,8 +14,7 @@ import jax
 import jax.numpy as jnp
 
 from tpulab.io import protocol
-from tpulab.ops.elementwise import binary_op
-from tpulab.runtime.device import cpu_device, default_device
+from tpulab.ops.elementwise import binary_op, make_binary_fn, resolve_binary_device
 from tpulab.runtime.timing import format_timing_line, measure_ms
 
 _DTYPES = {"float64": jnp.float64, "float32": jnp.float32, "bfloat16": jnp.bfloat16}
@@ -41,17 +40,15 @@ def run(
     if dtype not in _DTYPES:
         raise ValueError(f"unsupported dtype {dtype!r}; have {sorted(_DTYPES)}")
     dt = _DTYPES[dtype]
-    # Commit inputs to their execution device BEFORE timing, so the timed
-    # region measures compute only (f64 lives on the CPU backend — TPUs
-    # have no native f64; see tpulab.ops.elementwise).
-    if dt == jnp.float64:
-        device = cpu_device() if backend in (None, "auto", "cpu") else jax.devices(backend)[0]
-    else:
-        device = default_device() if backend in (None, "auto") else jax.devices(backend)[0]
+    # Commit inputs to their execution device and resolve the jitted
+    # callable BEFORE timing, so the timed region measures compute only
+    # (the cudaEvent analog; f64 lives on the CPU backend — TPUs have no
+    # native f64, see tpulab.ops.elementwise).
+    device = resolve_binary_device(dt, backend)
     a = jax.device_put(jnp.asarray(inp.a, dtype=dt), device)
     b = jax.device_put(jnp.asarray(inp.b, dtype=dt), device)
 
-    fn = jax.tree_util.Partial(compute, op=op, launch=inp.launch, backend=backend)
+    fn = make_binary_fn(op, dt, launch=inp.launch, device=device)
     ms, out = measure_ms(fn, (a, b), warmup=warmup, reps=reps)
 
     label = "TPU" if out.devices().pop().platform == "tpu" else "CPU"

@@ -1,0 +1,49 @@
+"""lab2 — Roberts-cross edge detection over the stdin protocol.
+
+Contract (reference ``lab2/src/main.cu:54-126``, ``to_plot.cu``): read an
+optional ``bx by gx gy`` sweep prefix, then input/output file paths; load
+the binary RGBA image, run the stencil, write the output ``.data`` file;
+print the timing line (and ``FINISHED!`` in sweep mode, matching
+to_plot.cu:130).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpulab.io import load_image, save_image, protocol
+from tpulab.ops.roberts import roberts
+from tpulab.runtime.device import default_device
+from tpulab.runtime.timing import format_timing_line, measure_ms
+
+
+def run(
+    text: str,
+    sweep: bool = False,
+    backend: Optional[str] = None,
+    *,
+    use_pallas: Optional[bool] = None,
+    warmup: int = 2,
+    reps: int = 5,
+    **_ignored,
+) -> str:
+    inp = protocol.parse_lab2(text, sweep=sweep)
+    pixels = load_image(inp.input_path)
+
+    device = default_device() if backend in (None, "auto") else jax.devices(backend)[0]
+    x = jax.device_put(jnp.asarray(pixels, jnp.uint8), device)
+
+    def fn(img):
+        return roberts(img, launch=inp.launch, backend=backend, use_pallas=use_pallas)
+
+    ms, out = measure_ms(fn, (x,), warmup=warmup, reps=reps)
+    save_image(inp.output_path, jax.device_get(out))
+
+    label = "TPU" if device.platform == "tpu" else "CPU"
+    lines = [format_timing_line(label, ms)]
+    if sweep:
+        lines.append("FINISHED!")
+    return "\n".join(lines) + "\n"

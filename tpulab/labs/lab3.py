@@ -1,0 +1,51 @@
+"""lab3 — per-pixel Mahalanobis classification over the stdin protocol.
+
+Contract (reference ``lab3/src/main.cu:78-171``, ``to_plot.cu:75-81``):
+optional ``blocks threads`` sweep prefix; input/output paths; ``nc``
+classes each given as ``np`` sample-pixel ``(x, y)`` coordinate pairs.
+Host computes f64 class statistics, the device kernel writes the argmin
+class label into each pixel's alpha channel; output goes to the ``.data``
+file; the timing line is printed first.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from tpulab.io import load_image, protocol, save_image
+from tpulab.ops.mahalanobis import class_statistics, classify
+from tpulab.runtime.device import default_device
+from tpulab.runtime.timing import format_timing_line, measure_ms
+
+
+def run(
+    text: str,
+    sweep: bool = False,
+    backend: Optional[str] = None,
+    *,
+    use_pallas: Optional[bool] = None,
+    warmup: int = 2,
+    reps: int = 5,
+    **_ignored,
+) -> str:
+    inp = protocol.parse_lab3(text, sweep=sweep)
+    pixels = load_image(inp.input_path)
+    # host-side f64 statistics, exactly as the reference's host stage
+    stats = class_statistics(pixels, [c.points for c in inp.classes])
+
+    device = default_device() if backend in (None, "auto") else jax.devices(backend)[0]
+    x = jax.device_put(jnp.asarray(pixels, jnp.uint8), device)
+
+    def fn(img):
+        return classify(
+            img, stats, launch=inp.launch, backend=backend, use_pallas=use_pallas
+        )
+
+    ms, out = measure_ms(fn, (x,), warmup=warmup, reps=reps)
+    save_image(inp.output_path, jax.device_get(out))
+
+    label = "TPU" if device.platform == "tpu" else "CPU"
+    return format_timing_line(label, ms) + "\n"

@@ -1,0 +1,80 @@
+"""lab5 — reductions and sorting over the typed binary data format.
+
+The reference's lab5 has **data fixtures only** (``lab5/data/{int10,
+float10,uchar10}``: int32 count header + payload) and no committed source
+(SURVEY.md section 0) — the course trajectory points at a multi-device
+CUDA+MPI sort/reduction.  Documented contract chosen here:
+
+stdin: ``input_path [output_path]`` (+ optional ``tile`` sweep prefix int).
+Config ``--task sum|min|max|prod|sort`` (default ``sum``).  Reductions
+print the timing line then the scalar result; ``sort`` writes the sorted
+array to ``output_path`` in the same typed format and prints the timing
+line.  Multi-device execution (``psum`` tree reduction / sample sort over
+an ICI mesh) engages via ``--mesh N`` (see tpulab.parallel).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpulab.io import load_typed_array, save_typed_array
+from tpulab.io.protocol import TokenReader
+from tpulab.ops.reduction import reduce_op
+from tpulab.ops.sortops import sort_op
+from tpulab.runtime.device import default_device
+from tpulab.runtime.timing import format_timing_line, measure_ms
+
+
+def _format_scalar(value: np.ndarray) -> str:
+    if np.issubdtype(value.dtype, np.integer):
+        return str(int(value))
+    return f"{float(value):.6e}"
+
+
+def run(
+    text: str,
+    sweep: bool = False,
+    backend: Optional[str] = None,
+    *,
+    task: str = "sum",
+    mesh: int = 0,
+    warmup: int = 2,
+    reps: int = 5,
+    **_ignored,
+) -> str:
+    r = TokenReader(text)
+    if sweep:
+        r.read_int()  # tile-config slot, reserved
+    input_path = r.read_str()
+    values = load_typed_array(input_path)
+
+    device = default_device() if backend in (None, "auto") else jax.devices(backend)[0]
+    label = "TPU" if device.platform == "tpu" else "CPU"
+
+    if task == "sort":
+        output_path = r.read_str()
+        if mesh and jax.device_count() >= mesh > 1:
+            from tpulab.parallel.dsort import distributed_sort
+
+            fn = lambda v: distributed_sort(v, num_devices=mesh)
+        else:
+            fn = lambda v: sort_op(v, backend=backend)
+        x = jax.device_put(jnp.asarray(values), device)
+        ms, out = measure_ms(fn, (x,), warmup=warmup, reps=reps)
+        save_typed_array(output_path, np.asarray(jax.device_get(out), dtype=values.dtype))
+        return format_timing_line(label, ms) + "\n"
+
+    if mesh and jax.device_count() >= mesh > 1:
+        from tpulab.parallel.collectives import distributed_reduce
+
+        fn = lambda v: distributed_reduce(v, op=task, num_devices=mesh)
+    else:
+        fn = lambda v: reduce_op(v, op=task, backend=backend)
+    x = jax.device_put(jnp.asarray(values), device)
+    ms, out = measure_ms(fn, (x,), warmup=warmup, reps=reps)
+    result = np.asarray(jax.device_get(out))
+    return format_timing_line(label, ms) + "\n" + _format_scalar(result) + "\n"

@@ -1,0 +1,28 @@
+"""tpu_info — device introspection (the reference's ``gpu_info`` tool).
+
+Reference ``gpu_info/src/main.cu:4-19`` prints compute capability, memory
+sizes, launch limits and SM count for device 0; the TPU equivalent reports
+platform, chip kind, chip/core counts, mesh coordinates and HBM stats for
+every attached device.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from tpulab.runtime.device import format_device_info
+
+import jax
+
+
+def run(
+    text: str = "",
+    sweep: bool = False,
+    backend: Optional[str] = None,
+    **_ignored,
+) -> str:
+    devices = jax.devices(backend) if backend not in (None, "auto") else jax.devices()
+    blocks = []
+    for d in devices:
+        blocks.append(f"Device {d.id}:\n{format_device_info(d)}")
+    return "\n\n".join(blocks) + "\n"

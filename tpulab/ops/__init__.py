@@ -1,3 +1,23 @@
 from tpulab.ops.elementwise import add, binary_op, multiply, subtract
+from tpulab.ops.mahalanobis import ClassStats, class_statistics, classify, classify_labels
+from tpulab.ops.quadratic import solve_batch, solve_scalar
+from tpulab.ops.reduction import reduce_op
+from tpulab.ops.roberts import roberts, roberts_edges
+from tpulab.ops.sortops import sort_op
 
-__all__ = ["add", "binary_op", "multiply", "subtract"]
+__all__ = [
+    "ClassStats",
+    "add",
+    "binary_op",
+    "class_statistics",
+    "classify",
+    "classify_labels",
+    "multiply",
+    "reduce_op",
+    "roberts",
+    "roberts_edges",
+    "solve_batch",
+    "solve_scalar",
+    "sort_op",
+    "subtract",
+]

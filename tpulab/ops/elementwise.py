@@ -37,6 +37,47 @@ def _xla_binary(a, b, op: str):
     return _OPS[op](a, b)
 
 
+def resolve_binary_device(dtype, backend: Optional[str] = None):
+    """f64 -> CPU backend (no 32-bit representation of 1e100-range values);
+    other dtypes -> the default accelerator.  Mirrors the reference's CPU
+    binary ignoring launch geometry (tester.py:302-310 passes None sizes)."""
+    if dtype == jnp.float64:
+        return cpu_device() if backend in (None, "auto", "cpu") else jax.devices(backend)[0]
+    return default_device() if backend in (None, "auto") else jax.devices(backend)[0]
+
+
+def make_binary_fn(
+    name: str,
+    dtype,
+    *,
+    launch: Optional[Tuple[int, int]] = None,
+    device=None,
+    use_pallas: Optional[bool] = None,
+) -> Callable:
+    """Build the jitted elementwise callable for a fixed config.
+
+    The returned function assumes its inputs are already committed to
+    ``device`` — timing it measures compute only (the cudaEvent analog).
+    ``launch`` (the CUDA ``(grid, block)`` sweep axis) maps to the Pallas
+    tile height; it is inert on the f64/CPU path, exactly like the
+    reference CPU binary which takes no launch config.
+    """
+    if name not in _OPS:
+        raise ValueError(f"unknown op {name!r}; have {sorted(_OPS)}")
+    if device is None:
+        device = resolve_binary_device(dtype)
+    if use_pallas is None:
+        use_pallas = device.platform == "tpu" and dtype != jnp.float64
+    if use_pallas:
+        return functools.partial(
+            pallas_binary,
+            op=_OPS[name],
+            tile_rows=launch_to_tile_rows(launch),
+            interpret=device.platform != "tpu",
+        )
+    return functools.partial(_xla_binary, op=name)
+
+
 def binary_op(
     name: str,
     a,
@@ -46,35 +87,16 @@ def binary_op(
     backend: Optional[str] = None,
     use_pallas: Optional[bool] = None,
 ) -> jax.Array:
-    """Elementwise ``name`` over two vectors with dtype-driven placement.
-
-    ``launch`` is the CUDA-style ``(grid, block)`` sweep parameter; it maps
-    to the Pallas tile height (see ``launch_to_tile_rows``).
-    """
-    if name not in _OPS:
-        raise ValueError(f"unknown op {name!r}; have {sorted(_OPS)}")
+    """Elementwise ``name`` over two vectors with dtype-driven placement."""
     a = jnp.asarray(a)
     b = jnp.asarray(b)
     if a.dtype != b.dtype:
         raise ValueError(f"dtype mismatch: {a.dtype} vs {b.dtype}")
-
-    if a.dtype == jnp.float64:
-        device = cpu_device() if backend in (None, "auto", "cpu") else jax.devices(backend)[0]
-        a = jax.device_put(a, device)
-        b = jax.device_put(b, device)
-        return _xla_binary(a, b, name)
-
-    device = default_device() if backend in (None, "auto") else jax.devices(backend)[0]
+    device = resolve_binary_device(a.dtype, backend)
     a = jax.device_put(a, device)
     b = jax.device_put(b, device)
-    if use_pallas is None:
-        use_pallas = device.platform == "tpu"
-    if use_pallas and a.ndim == 1:
-        return pallas_binary(
-            a, b, _OPS[name], tile_rows=launch_to_tile_rows(launch),
-            interpret=device.platform != "tpu",
-        )
-    return _xla_binary(a, b, name)
+    fn = make_binary_fn(name, a.dtype, launch=launch, device=device, use_pallas=use_pallas)
+    return fn(a, b)
 
 
 def subtract(a, b, **kw) -> jax.Array:

@@ -63,7 +63,6 @@ def pallas_binary(
     a: jax.Array,
     b: jax.Array,
     op: Callable = jnp.subtract,
-    *,
     tile_rows: int = 512,
     interpret: bool | None = None,
 ) -> jax.Array:

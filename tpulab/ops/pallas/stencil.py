@@ -1,0 +1,114 @@
+"""Halo-DMA 2D stencil Pallas kernel (Roberts cross).
+
+TPU-native counterpart of the reference's 2D grid-stride texture kernel
+(reference ``lab2/src/main.cu:15-52``): the image plane is processed in
+``(TH, TW)`` VMEM tiles; each grid step DMAs a ``(TH+8, TW+128)``
+halo-extended slab from HBM (the clamp-addressed +1 neighborhood lives in
+the halo; 8/128 keep the slab sublane/lane aligned) and the VPU evaluates
+the shifted-difference stencil entirely in registers.
+
+The CUDA launch-config sweep ``(bx, by, gx, gy)`` maps to the tile shape:
+block size scales the tile, grid size is derived from the image — so the
+harness's kernel-size axis still produces a meaningful performance curve.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from tpulab.ops.roberts import luminance_f32, magnitude_to_u8
+
+SUBLANE = 8
+LANE = 128
+
+
+def _round_up(v: int, m: int) -> int:
+    return -(-v // m) * m
+
+
+def launch_to_tile(
+    launch: Optional[Tuple[int, int, int, int]], h: int, w: int
+) -> Tuple[int, int]:
+    """Map CUDA ``(bx, by, gx, gy)`` to a Pallas tile ``(TH, TW)``.
+
+    A CUDA block covers ``bx x by`` pixels per stride step; the Pallas tile
+    scales with the block (x8 rows / x16 lanes so sane CUDA configs land on
+    hardware-efficient tiles) and clamps to the aligned image bounds.
+    Degenerate configs (``2x2`` blocks) map to minimum tiles and stay
+    deliberately slow, preserving the sweep's cost signal.
+    """
+    if launch is None:
+        th, tw = 256, 512
+    else:
+        bx, by, _gx, _gy = launch
+        th = _round_up(max(1, by) * SUBLANE, SUBLANE)
+        tw = _round_up(max(1, bx) * 16, LANE)
+    th = max(SUBLANE, min(th, 512, _round_up(h, SUBLANE)))
+    tw = max(LANE, min(tw, 1024, _round_up(w, LANE)))
+    return th, tw
+
+
+def _stencil_kernel(y_hbm, out_ref, slab, sem, *, th: int, tw: int):
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    copy = pltpu.make_async_copy(
+        y_hbm.at[pl.ds(i * th, th + SUBLANE), pl.ds(j * tw, tw + LANE)],
+        slab,
+        sem,
+    )
+    copy.start()
+    copy.wait()
+    y00 = slab[0:th, 0:tw]
+    y10 = slab[0:th, 1 : tw + 1]
+    y01 = slab[1 : th + 1, 0:tw]
+    y11 = slab[1 : th + 1, 1 : tw + 1]
+    gx = y11 - y00
+    gy = y10 - y01
+    out_ref[:] = jnp.sqrt(gx * gx + gy * gy)
+
+
+@functools.partial(jax.jit, static_argnames=("th", "tw", "interpret"))
+def _gradient_pallas(ypad: jax.Array, th: int, tw: int, interpret: bool) -> jax.Array:
+    hp = ypad.shape[0] - SUBLANE
+    wp = ypad.shape[1] - LANE
+    grid = (hp // th, wp // tw)
+    kernel = functools.partial(_stencil_kernel, th=th, tw=tw)
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((hp, wp), jnp.float32),
+        grid=grid,
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec((th, tw), lambda i, j: (i, j), memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((th + SUBLANE, tw + LANE), jnp.float32),
+            pltpu.SemaphoreType.DMA,
+        ],
+        interpret=interpret,
+    )(ypad)
+
+
+def roberts_pallas(
+    pixels_u8: jax.Array,
+    *,
+    launch: Optional[Tuple[int, int, int, int]] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Roberts edges via the halo stencil kernel; bit-identical to
+    :func:`tpulab.ops.roberts.roberts_edges`."""
+    h, w = pixels_u8.shape[:2]
+    th, tw = launch_to_tile(launch, h, w)
+    y = luminance_f32(pixels_u8)
+    hp = _round_up(h, th)
+    wp = _round_up(w, tw)
+    # edge-replicate: +1 halo provides clamp addressing; the rest of the
+    # alignment pad replicates the border (values are discarded on crop)
+    ypad = jnp.pad(y, ((0, hp - h + SUBLANE), (0, wp - w + LANE)), mode="edge")
+    g = _gradient_pallas(ypad, th, tw, interpret)[:h, :w]
+    g8 = magnitude_to_u8(g)
+    return jnp.stack([g8, g8, g8, pixels_u8[..., 3]], axis=-1)

@@ -1,0 +1,77 @@
+"""Quadratic-equation solving (the hw1 workload).
+
+Scalar semantics follow the reference bit-for-bit in float32
+(reference ``hw1/src/main.c:4-35``): degenerate cases ``any`` (0=0),
+``incorrect`` (0x+0=c), linear root ``-c/b``; discriminant
+``D = b*b - 4*a*c`` with two/one/zero (``imaginary``) real roots.
+:func:`solve_batch` is the TPU-native generalization — a vmapped f32
+solver over arrays of coefficient triples.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def solve_scalar(a: float, b: float, c: float) -> str:
+    """Solve one equation; returns the exact stdout line of the reference."""
+    a = np.float32(a)
+    b = np.float32(b)
+    c = np.float32(c)
+    if a == 0:
+        if b == 0:
+            return "any" if c == 0 else "incorrect"
+        root = np.float32(-c) / b
+        return f"{root:.6f}"
+    d = b * b - np.float32(4) * a * c
+    if d > 0:
+        sq = np.float32(np.sqrt(d))
+        r1 = (-b + sq) / (np.float32(2) * a)
+        r2 = (-b - sq) / (np.float32(2) * a)
+        return f"{r1:.6f} {r2:.6f}"
+    if d == 0:
+        return f"{-b / (np.float32(2) * a):.6f}"
+    return "imaginary"
+
+
+# status codes for the batched solver
+TWO_ROOTS, ONE_ROOT, NO_REAL, ANY, INCORRECT = 0, 1, 2, 3, 4
+
+
+@jax.jit
+def solve_batch(coeffs: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Batched f32 solver: (n, 3) coefficients -> (status (n,), roots (n, 2)).
+
+    Branch-free formulation (everything under jit is traced once): statuses
+    encode the reference's five output cases; unused root slots are NaN.
+    """
+    coeffs = coeffs.astype(jnp.float32)
+    a, b, c = coeffs[:, 0], coeffs[:, 1], coeffs[:, 2]
+    d = b * b - 4.0 * a * c
+    sq = jnp.sqrt(jnp.maximum(d, 0.0))
+    two_a = 2.0 * a
+    r1 = (-b + sq) / two_a
+    r2 = (-b - sq) / two_a
+    lin = -c / b
+    nan = jnp.float32(jnp.nan)
+
+    status = jnp.select(
+        [
+            (a == 0) & (b == 0) & (c == 0),
+            (a == 0) & (b == 0),
+            (a == 0),
+            d > 0,
+            d == 0,
+        ],
+        [ANY, INCORRECT, ONE_ROOT, TWO_ROOTS, ONE_ROOT],
+        default=NO_REAL,
+    )
+    root1 = jnp.select(
+        [(a == 0) & (b != 0), (a != 0) & (d >= 0)], [lin, r1], default=nan
+    )
+    root2 = jnp.where((a != 0) & (d > 0), r2, nan)
+    return status, jnp.stack([root1, root2], axis=1)

@@ -1,0 +1,43 @@
+"""Reductions (the lab5 workload family).
+
+The lab5 source was never committed to the reference (only the
+``lab5/data`` fixtures exist — see SURVEY.md section 0); semantics here
+are the documented choice: sum / min / max / prod reductions over the
+typed binary arrays, accumulated in a wide dtype (int64 / float32).
+The multi-device tier (``jax.lax.psum`` over an ICI mesh — the idiomatic
+realization of the "CUDA+MPI reduction" the course trajectory pointed at)
+lives in :mod:`tpulab.parallel.collectives`.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+REDUCERS = {
+    "sum": jnp.sum,
+    "min": jnp.min,
+    "max": jnp.max,
+    "prod": jnp.prod,
+}
+
+
+@functools.partial(jax.jit, static_argnames=("op",))
+def _reduce(values: jax.Array, op: str) -> jax.Array:
+    x = values
+    if x.dtype in (jnp.uint8, jnp.int8, jnp.int16, jnp.int32):
+        x = x.astype(jnp.int64 if jax.config.jax_enable_x64 else jnp.int32)
+    return REDUCERS[op](x)
+
+
+def reduce_op(values, op: str = "sum", *, backend: Optional[str] = None) -> jax.Array:
+    if op not in REDUCERS:
+        raise ValueError(f"unknown reduction {op!r}; have {sorted(REDUCERS)}")
+    from tpulab.runtime.device import default_device
+
+    device = default_device() if backend in (None, "auto") else jax.devices(backend)[0]
+    x = jax.device_put(jnp.asarray(values), device)
+    return _reduce(x, op)

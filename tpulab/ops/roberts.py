@@ -1,0 +1,93 @@
+"""Roberts-cross edge detection (the lab2 workload).
+
+Semantics (reference ``lab2/src/main.cu:15-52`` and the CPU twin
+``lab2/src/main.c:14-59``):
+
+* neighbor fetches at ``(x+1, y)``/``(x, y+1)``/``(x+1, y+1)`` with
+  **clamp** addressing at the image border (CUDA texture clamp mode /
+  ``getPixel`` coordinate clamping),
+* f32 luminance ``Y = 0.299f*R + 0.587f*G + 0.114f*B``,
+* gradients ``Gx = Y11 - Y00``, ``Gy = Y10 - Y01``,
+* magnitude ``sqrt(Gx^2 + Gy^2)`` clamped to [0, 255] and **truncated**
+  (C cast) to uint8,
+* output gray RGBA with the *input* pixel's alpha preserved.
+
+The jnp path is a single fused XLA program; :func:`roberts_pallas` runs the
+stencil as a halo-DMA Pallas TPU kernel (tpulab.ops.pallas.stencil).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+_LUMA_R = jnp.float32(0.299)
+_LUMA_G = jnp.float32(0.587)
+_LUMA_B = jnp.float32(0.114)
+
+
+def luminance_f32(pixels_u8: jax.Array) -> jax.Array:
+    """Per-pixel f32 luminance with the reference's constants and
+    left-to-right accumulation order (lab2/src/main.cu:30-33)."""
+    rgb = pixels_u8[..., :3].astype(jnp.float32)
+    return _LUMA_R * rgb[..., 0] + _LUMA_G * rgb[..., 1] + _LUMA_B * rgb[..., 2]
+
+
+def _shift_clamped(y: jax.Array, dy: int, dx: int) -> jax.Array:
+    """``y[r+dy, c+dx]`` with clamp addressing (edge replication)."""
+    h, w = y.shape
+    ypad = jnp.pad(y, ((0, dy), (0, dx)), mode="edge")
+    return ypad[dy : dy + h, dx : dx + w]
+
+
+def gradient_magnitude(y: jax.Array) -> jax.Array:
+    """Roberts gradient magnitude over a luminance plane, f32."""
+    y00 = y
+    y10 = _shift_clamped(y, 0, 1)
+    y01 = _shift_clamped(y, 1, 0)
+    y11 = _shift_clamped(y, 1, 1)
+    gx = y11 - y00
+    gy = y10 - y01
+    return jnp.sqrt(gx * gx + gy * gy)
+
+
+def magnitude_to_u8(g: jax.Array) -> jax.Array:
+    """Clamp to [0,255] then C-style truncation to uint8
+    (lab2/src/main.cu:43-46)."""
+    g = jnp.minimum(jnp.maximum(g, jnp.float32(0.0)), jnp.float32(255.0))
+    return g.astype(jnp.uint8)
+
+
+@jax.jit
+def roberts_edges(pixels_u8: jax.Array) -> jax.Array:
+    """RGBA (h, w, 4) uint8 -> RGBA gray edge image, alpha preserved."""
+    g8 = magnitude_to_u8(gradient_magnitude(luminance_f32(pixels_u8)))
+    return jnp.stack([g8, g8, g8, pixels_u8[..., 3]], axis=-1)
+
+
+def roberts(
+    pixels_u8,
+    *,
+    launch: Optional[Tuple[int, int, int, int]] = None,
+    backend: Optional[str] = None,
+    use_pallas: Optional[bool] = None,
+) -> jax.Array:
+    """Full lab2 op with device placement and optional Pallas stencil path.
+
+    ``launch`` is the CUDA-style ``(bx, by, gx, gy)`` sweep config
+    (reference lab2/src/to_plot.cu:57-64); it maps to the Pallas tile shape.
+    """
+    from tpulab.runtime.device import default_device
+
+    device = default_device() if backend in (None, "auto") else jax.devices(backend)[0]
+    x = jax.device_put(jnp.asarray(pixels_u8, jnp.uint8), device)
+    if use_pallas is None:
+        use_pallas = device.platform == "tpu"
+    if use_pallas:
+        from tpulab.ops.pallas.stencil import roberts_pallas
+
+        return roberts_pallas(x, launch=launch, interpret=device.platform != "tpu")
+    return roberts_edges(x)

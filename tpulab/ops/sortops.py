@@ -1,0 +1,35 @@
+"""Sorting (the hw2 workload and lab5 sort tasks).
+
+The reference sorts ascending with a serial bubble sort
+(``hw2/src/main.c:4-15``); the TPU-native equivalent is ``jnp.sort``
+(XLA's vectorized bitonic/merge network on the VPU).  The distributed
+variant — a sampled-splitter sample sort over a device mesh — lives in
+:mod:`tpulab.parallel.dsort`.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def sort_ascending(values: jax.Array) -> jax.Array:
+    return jnp.sort(values)
+
+
+def sort_op(values, *, backend: Optional[str] = None) -> jax.Array:
+    """Device-placed ascending sort.
+
+    uint8 inputs are widened to int32 for the sort and narrowed back
+    (XLA sorts any dtype, but the narrow path keeps TPU layouts happy).
+    """
+    from tpulab.runtime.device import default_device
+
+    device = default_device() if backend in (None, "auto") else jax.devices(backend)[0]
+    x = jax.device_put(jnp.asarray(values), device)
+    if x.dtype == jnp.uint8:
+        return sort_ascending(x.astype(jnp.int32)).astype(jnp.uint8)
+    return sort_ascending(x)

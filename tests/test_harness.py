@@ -1,0 +1,199 @@
+"""Harness tests: sweep orchestration, verification gating, artifacts."""
+
+import asyncio
+import os
+import sys
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from tpulab.harness import InProcessTarget, SubprocessTarget, Tester, run_once
+from tpulab.harness.base import PreparedRun, WorkloadProcessor
+from tpulab.harness.processors import (
+    Hw1Processor,
+    Hw2Processor,
+    Lab1Processor,
+    Lab2Processor,
+    Lab3Processor,
+    Lab5Processor,
+)
+from tpulab.harness.run import infer_lab_from_path, main as harness_main
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def run_tester(tester, processor):
+    return asyncio.run(tester.run_experiments(processor))
+
+
+def make_tester(target, tmp_path, **kw):
+    kw.setdefault("log", lambda *a, **k: None)
+    return Tester(target, artifact_dir=str(tmp_path), **kw)
+
+
+class TestLab1Sweep:
+    def test_sweep_all_verified(self, tmp_path):
+        target = InProcessTarget(
+            name="lab1_tpu", device_label="TPU", workload="lab1", sweep=True,
+            config={"warmup": 0, "reps": 1},
+        )
+        cpu = InProcessTarget(
+            name="lab1_cpu", device_label="CPU", workload="lab1",
+            config={"warmup": 0, "reps": 1},
+        )
+        tester = make_tester(
+            target, tmp_path, cpu_target=cpu, k_times=2,
+            kernel_sizes=[[1, 32], [256, 256]],
+        )
+        proc = Lab1Processor(seed=1, size_min=64, size_max=128)
+        df = run_tester(tester, proc)
+        # 2 reps x 2 configs + 2 CPU reference runs
+        assert len(df) == 6
+        assert bool((df["verified"] == True).all())  # noqa: E712
+        assert (tmp_path / "stats_lab1_tpu.csv").exists()
+        assert (tmp_path / "runs_lab1_tpu.csv").exists()
+        stats = pd.read_csv(tmp_path / "stats_lab1_tpu.csv")
+        assert set(stats["device"]) == {"TPU", "CPU"}
+
+    def test_verification_gate_withholds_stats(self, tmp_path):
+        # add-op processor against a subtract-computing target -> all fail
+        target = InProcessTarget(
+            name="lab1_bad", workload="lab1", config={"warmup": 0, "reps": 1}
+        )
+        tester = make_tester(target, tmp_path, k_times=1)
+        proc = Lab1Processor(seed=2, size_min=32, size_max=64, op="add")
+        df = run_tester(tester, proc)
+        assert not bool((df["verified"] == True).all())  # noqa: E712
+        assert (tmp_path / "failed_lab1_bad.csv").exists()
+        assert not (tmp_path / "stats_lab1_bad.csv").exists()
+
+
+class TestImageProcessors:
+    def test_lab2_golden_sweep(self, tmp_path):
+        proc = Lab2Processor(
+            dir_to_data=os.path.join(REPO, "data/lab2/data"),
+            dir_to_data_out=str(tmp_path / "out"),
+            dir_to_data_out_gt=os.path.join(REPO, "data/lab2/data_out_gt"),
+            log=lambda *a: None,
+        )
+        target = InProcessTarget(
+            name="lab2_tpu", workload="lab2", sweep=True,
+            config={"warmup": 0, "reps": 1},
+        )
+        tester = make_tester(
+            target, tmp_path, k_times=2, kernel_sizes=[[[32, 32], [16, 16]]]
+        )
+        df = run_tester(tester, proc)
+        assert bool((df["verified"] == True).all())  # noqa: E712
+        assert (tmp_path / "stats_lab2_tpu.csv").exists()
+
+    def test_lab2_detects_corruption(self, tmp_path):
+        # a target that writes a corrupted image must fail verification
+        class CorruptTarget(InProcessTarget):
+            async def execute(self, stdin_text):
+                out = await super().execute(stdin_text)
+                out_path = stdin_text.splitlines()[1]
+                blob = bytearray(open(out_path, "rb").read())
+                blob[8] ^= 0xFF
+                open(out_path, "wb").write(bytes(blob))
+                return out
+
+        proc = Lab2Processor(
+            dir_to_data=os.path.join(REPO, "data/lab2/data"),
+            dir_to_data_out=str(tmp_path / "out"),
+            dir_to_data_out_gt=os.path.join(REPO, "data/lab2/data_out_gt"),
+            verbose_diff=False,
+            log=lambda *a: None,
+        )
+        target = CorruptTarget(
+            name="lab2_corrupt", workload="lab2", config={"warmup": 0, "reps": 1}
+        )
+        tester = make_tester(target, tmp_path, k_times=1)
+        df = run_tester(tester, proc)
+        assert not bool(df["verified"].any())
+        assert (tmp_path / "failed_lab2_corrupt.csv").exists()
+
+    def test_lab3_golden_sweep(self, tmp_path):
+        proc = Lab3Processor(
+            dir_to_data=os.path.join(REPO, "data/lab3/data"),
+            dir_to_data_out=str(tmp_path / "out"),
+            dir_to_data_out_gt=os.path.join(REPO, "data/lab3/data_out_gt"),
+            log=lambda *a: None,
+        )
+        target = InProcessTarget(
+            name="lab3_tpu", workload="lab3", config={"warmup": 0, "reps": 1}
+        )
+        tester = make_tester(target, tmp_path, k_times=2)
+        df = run_tester(tester, proc)
+        assert bool((df["verified"] == True).all())  # noqa: E712
+
+
+class TestSmallProcessors:
+    @pytest.mark.parametrize(
+        "proc_cls,workload,cfg",
+        [
+            (Hw1Processor, "hw1", {"timing": True}),
+            (Hw2Processor, "hw2", {"timing": True, "warmup": 0, "reps": 1}),
+            (Lab5Processor, "lab5", {"warmup": 0, "reps": 1}),
+        ],
+    )
+    def test_roundtrip_verified(self, tmp_path, proc_cls, workload, cfg):
+        proc = (
+            proc_cls(workdir=str(tmp_path / "work"))
+            if proc_cls is Lab5Processor
+            else proc_cls()
+        )
+        target = InProcessTarget(name=workload, workload=workload, config=cfg)
+        tester = make_tester(target, tmp_path, k_times=3)
+        df = run_tester(tester, proc)
+        assert bool((df["verified"] == True).all())  # noqa: E712
+
+    def test_lab5_sort_task(self, tmp_path):
+        proc = Lab5Processor(task="sort", workdir=str(tmp_path / "work"))
+        target = InProcessTarget(
+            name="lab5_sort", workload="lab5",
+            config={"task": "sort", "warmup": 0, "reps": 1},
+        )
+        tester = make_tester(target, tmp_path, k_times=2)
+        df = run_tester(tester, proc)
+        assert bool((df["verified"] == True).all())  # noqa: E712
+
+
+class TestSubprocessTarget:
+    def test_error_capture(self, tmp_path):
+        target = SubprocessTarget(name="false", argv=["/bin/false"])
+        proc = Lab1Processor(seed=3, size_min=8, size_max=16)
+        record = asyncio.run(run_once(target, proc, None))
+        assert record.verified is False
+        assert "exited 1" in record.error
+
+    def test_real_subprocess_contract(self, tmp_path):
+        env_argv = [
+            sys.executable, "-m", "tpulab", "run", "lab1",
+            "--warmup", "0", "--reps", "1",
+        ]
+        target = SubprocessTarget(name="tpulab_sub", argv=env_argv)
+        proc = Lab1Processor(seed=4, size_min=8, size_max=16)
+        record = asyncio.run(run_once(target, proc, None))
+        assert record.error is None, record.error
+        assert record.verified is True
+        assert record.time_kernel_ms is not None
+
+
+class TestRunCli:
+    def test_infer_lab_from_path(self):
+        assert infer_lab_from_path("/x/lab2/src/to_plot_exe") == "lab2"
+
+    def test_cli_end_to_end(self, tmp_path, capsys):
+        rc = harness_main(
+            [
+                "--lab", "lab1", "--k-times", "1",
+                "--kernel-sizes", "[[1, 32]]",
+                "--artifact-dir", str(tmp_path),
+                "--size_min", "16", "--size_max", "32",
+                "--warmup", "0", "--reps", "1",
+            ]
+        )
+        assert rc == 0
+        assert (tmp_path / "stats_tpulab_lab1.csv").exists()

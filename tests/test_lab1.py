@@ -41,6 +41,11 @@ class TestSubtract:
         assert launch_to_tile_rows((1024, 1024)) == 2048  # clamped
         assert launch_to_tile_rows((512, 512)) == 2048
 
+    def test_2d_arrays_fall_back_to_xla(self, rng):
+        a = rng.normal(size=(8, 16)).astype(np.float32)
+        b = rng.normal(size=(8, 16)).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(subtract(a, b)), a - b, rtol=1e-6)
+
     def test_other_ops(self, rng):
         a = rng.normal(size=64)
         b = rng.normal(size=64)

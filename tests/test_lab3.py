@@ -88,6 +88,17 @@ class TestClassify:
             classes.append(pts)
         return img, class_statistics(img, classes)
 
+    def test_degenerate_class_never_wins(self, rng):
+        # a single-point class has NaN inv_cov; its NaN distances must lose
+        # to any finite distance (C strict-< rejects NaN, main.cu:68-71)
+        img = rng.integers(0, 256, size=(6, 6, 4), dtype=np.uint8)
+        degenerate = np.array([[0, 0]])
+        normal = np.stack([rng.integers(0, 6, 5), rng.integers(0, 6, 5)], axis=1)
+        stats = class_statistics(img, [degenerate, normal])
+        assert not np.isfinite(stats.inv_cov[0]).all()
+        out = np.asarray(classify(img, stats))
+        assert (out[..., 3] == 1).all()  # the normal class wins everywhere
+
     def test_matches_oracle_f64(self, rng):
         img, stats = self._random_case(rng)
         out = np.asarray(classify(img, stats, compute_dtype=jnp.float64))

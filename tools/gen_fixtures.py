@@ -1,0 +1,105 @@
+"""Generate the framework's committed test/benchmark fixtures.
+
+Creates deterministic tiny images under ``data/lab2/data`` and
+``data/lab3/data`` plus golden outputs under the sibling ``data_out_gt``
+dirs.  Goldens are produced by the framework's own CPU f64/f32 reference
+paths, which are bit-exact against the reference suite's committed
+goldens (tests/test_lab2.py, tests/test_lab3.py prove that equivalence);
+the pixel content is original to this repo.
+
+Run from the repo root:  python tools/gen_fixtures.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+os.environ["JAX_PLATFORMS"] = "cpu"  # hard override (container pins axon)
+
+from tpulab.io import save_image  # noqa: E402
+from tpulab.harness.processors.lab3 import PINNED_CLASS_POINTS  # noqa: E402
+from tpulab.ops.mahalanobis import class_statistics, classify  # noqa: E402
+from tpulab.ops.roberts import roberts_edges  # noqa: E402
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+LAB3_CLASS_POINTS = {k: v for k, v in PINNED_CLASS_POINTS.items() if k != "test_01_lab3"}
+
+
+def lab2_images(rng):
+    imgs = {}
+    imgs["grad_3x3"] = np.stack(
+        [
+            np.tile(np.arange(3, dtype=np.uint8)[None, :] * 40, (3, 1)),
+            np.tile(np.arange(3, dtype=np.uint8)[:, None] * 60, (1, 3)),
+            np.full((3, 3), 128, np.uint8),
+            np.full((3, 3), 255, np.uint8),
+        ],
+        axis=-1,
+    )
+    imgs["spot_1x5"] = np.zeros((1, 5, 4), np.uint8)
+    imgs["spot_1x5"][0, 2] = [200, 100, 50, 3]
+    imgs["noise_4x4"] = rng.integers(0, 256, size=(4, 4, 4), dtype=np.uint8)
+    imgs["rings_16x16"] = np.zeros((16, 16, 4), np.uint8)
+    yy, xx = np.mgrid[0:16, 0:16]
+    r = np.sqrt((yy - 7.5) ** 2 + (xx - 7.5) ** 2)
+    imgs["rings_16x16"][..., 0] = ((np.sin(r * 1.7) * 0.5 + 0.5) * 255).astype(np.uint8)
+    imgs["rings_16x16"][..., 1] = ((np.cos(r) * 0.5 + 0.5) * 255).astype(np.uint8)
+    imgs["rings_16x16"][..., 2] = (r * 16).astype(np.uint8)
+    imgs["rings_16x16"][..., 3] = 255
+    return imgs
+
+
+def lab3_images(rng):
+    imgs = {}
+    checker = np.zeros((6, 6, 4), np.uint8)
+    checker[..., 0] = np.where((np.indices((6, 6)).sum(0) % 2) == 0, 220, 30)
+    checker[..., 1] = np.where((np.indices((6, 6)).sum(0) % 2) == 0, 40, 200)
+    checker[..., 2] = 128
+    # per-pixel noise: a pure two-color checker gives every class a
+    # rank-deficient covariance (NaN inverse -> all labels 255); noise
+    # keeps the class statistics full-rank and the golden meaningful
+    noise = rng.integers(0, 24, size=(6, 6, 3), dtype=np.uint8)
+    checker[..., :3] = np.clip(checker[..., :3].astype(int) + noise, 0, 255).astype(np.uint8)
+    checker[..., 3] = 255
+    imgs["checker_6x6"] = checker
+    blobs = rng.integers(0, 80, size=(8, 8, 4), dtype=np.uint8)
+    blobs[:2, :2, 0] += 170
+    blobs[6:, 6:, 1] += 170
+    blobs[:2, 6:, 2] += 170
+    blobs[..., 3] = 255
+    imgs["blobs_8x8"] = blobs
+    return imgs
+
+
+def main() -> None:
+    rng = np.random.default_rng(20240713)
+
+    d2 = os.path.join(ROOT, "data/lab2/data")
+    g2 = os.path.join(ROOT, "data/lab2/data_out_gt")
+    os.makedirs(d2, exist_ok=True)
+    os.makedirs(g2, exist_ok=True)
+    for name, img in lab2_images(rng).items():
+        ext = ".txt" if img.size <= 16 * 16 * 4 else ".data"
+        save_image(os.path.join(d2, name + ext), img)
+        save_image(os.path.join(g2, name + ext), np.asarray(roberts_edges(img)))
+        print(f"lab2 fixture {name}{ext} + golden")
+
+    d3 = os.path.join(ROOT, "data/lab3/data")
+    g3 = os.path.join(ROOT, "data/lab3/data_out_gt")
+    os.makedirs(d3, exist_ok=True)
+    os.makedirs(g3, exist_ok=True)
+    for name, img in lab3_images(rng).items():
+        save_image(os.path.join(d3, name + ".txt"), img)
+        stats = class_statistics(img, LAB3_CLASS_POINTS[name])
+        out = np.asarray(classify(img, stats, backend="cpu"))
+        save_image(os.path.join(g3, name + ".txt"), out)
+        print(f"lab3 fixture {name}.txt + golden ({len(LAB3_CLASS_POINTS[name])} classes)")
+
+
+if __name__ == "__main__":
+    main()

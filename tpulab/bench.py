@@ -71,7 +71,8 @@ def run_benchmarks(only: Optional[str] = None, **kw) -> List[Dict[str, Any]]:
         if only and only not in name:
             continue
         base_fn = fn.func if isinstance(fn, functools.partial) else fn
-        params = inspect.signature(base_fn).parameters
-        accepted = {k: v for k, v in kw.items() if k in params}
+        params = list(inspect.signature(base_fn).parameters)
+        bound = set(params[: len(fn.args)]) if isinstance(fn, functools.partial) else set()
+        accepted = {k: v for k, v in kw.items() if k in params and k not in bound}
         rows.append(fn(**accepted))
     return rows

@@ -1,0 +1,13 @@
+from tpulab.harness.base import RunRecord, WorkloadProcessor
+from tpulab.harness.runner import InProcessTarget, SubprocessTarget, Target, run_once
+from tpulab.harness.tester import Tester
+
+__all__ = [
+    "InProcessTarget",
+    "RunRecord",
+    "SubprocessTarget",
+    "Target",
+    "Tester",
+    "WorkloadProcessor",
+    "run_once",
+]

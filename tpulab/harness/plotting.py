@@ -1,0 +1,68 @@
+"""Benchmark bar charts (the reference's ``median_execution_time.png``).
+
+Grouped median kernel times by (device, kernel_size) with a sample-count
+and metadata legend — the reference chart layout (tester.py:325-407).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import pandas as pd
+
+
+def plot_median_times(
+    df: pd.DataFrame,
+    out_path: str,
+    metadata_columns: Optional[List[str]] = None,
+    title: str = "Median kernel execution time",
+) -> str:
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    ok = df[df["time_kernel_ms"].notna()]
+    med = (
+        ok.groupby(["device", "kernel_size"])["time_kernel_ms"]
+        .agg(["median", "count"])
+        .reset_index()
+    )
+    labels = [f"{d}\n{k}" for d, k in zip(med["device"], med["kernel_size"])]
+    colors = ["tab:orange" if d == "CPU" else "tab:blue" for d in med["device"]]
+
+    fig, ax = plt.subplots(figsize=(max(6, 1.2 * len(labels)), 4.5))
+    bars = ax.bar(range(len(med)), med["median"], color=colors)
+    ax.set_xticks(range(len(med)))
+    ax.set_xticklabels(labels, fontsize=8)
+    ax.set_ylabel("median kernel time, ms")
+    ax.set_title(title)
+    ax.set_yscale("log")
+    for rect, (m, n) in zip(bars, zip(med["median"], med["count"])):
+        ax.annotate(
+            f"{m:.5f}\nn={n}",
+            (rect.get_x() + rect.get_width() / 2, rect.get_height()),
+            ha="center",
+            va="bottom",
+            fontsize=7,
+        )
+    legend_lines = []
+    for col in metadata_columns or []:
+        if col in df.columns:
+            vals = sorted(set(str(v) for v in df[col].dropna().unique()))[:6]
+            legend_lines.append(f"{col}: {', '.join(vals)}")
+    if legend_lines:
+        ax.text(
+            0.99,
+            0.98,
+            "\n".join(legend_lines),
+            transform=ax.transAxes,
+            ha="right",
+            va="top",
+            fontsize=7,
+            bbox=dict(boxstyle="round", alpha=0.15),
+        )
+    fig.tight_layout()
+    fig.savefig(out_path, dpi=120)
+    plt.close(fig)
+    return out_path

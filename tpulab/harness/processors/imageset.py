@@ -1,0 +1,108 @@
+"""Shared image-dataset machinery for the lab2/lab3 processors.
+
+Reference behavior (lab2/lab2_processor.py:36-118): scan a data directory
+for images, load goldens from a ``data_out_gt`` directory matched by
+filename stem with extension priority ``.txt`` > ``.data`` > ``.png``,
+recreate the ``data_out`` directory per run, iterate the dataset
+round-robin under an asyncio lock, and key per-run output files by the
+``device_info`` string so concurrent configs never collide.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+from typing import Dict, List, Optional, Tuple
+
+from tpulab.utils.imgdata import ImgData, _is_protected
+
+IMAGE_EXTS = (".txt", ".data", ".png")  # golden lookup priority
+
+
+def scan_images(directory: str) -> List[str]:
+    """Unique image stems in ``directory``, one path per stem by priority."""
+    by_stem: Dict[str, str] = {}
+    if not os.path.isdir(directory):
+        return []
+    for name in sorted(os.listdir(directory)):
+        stem, ext = os.path.splitext(name)
+        if ext.lower() not in IMAGE_EXTS:
+            continue
+        cur = by_stem.get(stem)
+        if cur is None or IMAGE_EXTS.index(ext.lower()) < IMAGE_EXTS.index(
+            os.path.splitext(cur)[1].lower()
+        ):
+            by_stem[stem] = os.path.join(directory, name)
+    return [by_stem[s] for s in sorted(by_stem)]
+
+
+def find_golden(golden_dir: str, stem: str) -> Optional[str]:
+    for ext in IMAGE_EXTS:
+        p = os.path.join(golden_dir, stem + ext)
+        if os.path.exists(p):
+            return p
+    return None
+
+
+def safe_run_dir(base_out: str, device_info: str) -> str:
+    sub = re.sub(r"[^A-Za-z0-9_.-]+", "_", device_info) or "run"
+    path = os.path.join(base_out, sub)
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+class ImageDataset:
+    """Round-robin dataset of images with optional goldens."""
+
+    def __init__(
+        self,
+        dir_to_data: str,
+        dir_to_data_out: Optional[str] = None,
+        dir_to_data_out_gt: Optional[str] = None,
+        reset_out: bool = True,
+    ):
+        self.dir_to_data = dir_to_data
+        self.dir_to_data_out = dir_to_data_out or os.path.join(dir_to_data, "..", "data_out")
+        self.dir_to_data_out_gt = dir_to_data_out_gt or os.path.join(
+            dir_to_data, "..", "data_out_gt"
+        )
+        self.paths = scan_images(dir_to_data)
+        if not self.paths:
+            raise FileNotFoundError(f"no images found in {dir_to_data!r}")
+        self._idx = 0
+        if reset_out and not _is_protected(self.dir_to_data_out):
+            shutil.rmtree(self.dir_to_data_out, ignore_errors=True)
+        os.makedirs(self.dir_to_data_out, exist_ok=True)
+
+    def next_item(self) -> Tuple[str, Optional[str]]:
+        """(input path, golden path or None), round-robin.
+
+        Call while holding the processor lock."""
+        path = self.paths[self._idx % len(self.paths)]
+        self._idx += 1
+        stem = os.path.splitext(os.path.basename(path))[0]
+        golden = find_golden(self.dir_to_data_out_gt, stem)
+        return path, golden
+
+    def out_path_for(self, input_path: str, device_info: str) -> str:
+        stem = os.path.splitext(os.path.basename(input_path))[0]
+        return os.path.join(
+            safe_run_dir(self.dir_to_data_out, device_info), stem + ".data"
+        )
+
+    @staticmethod
+    def input_as_data_file(path: str) -> str:
+        """Ensure a ``.data`` sibling exists (binaries consume ``.data``);
+        returns the ``.data`` path."""
+        if path.lower().endswith(".data"):
+            return path
+        img = ImgData(path)  # eagerly materializes siblings next to source
+        sibling = os.path.join(img.dir2save, img.data_name + ".data")
+        if os.path.exists(sibling):
+            return sibling
+        # protected (read-only) source dir: materialize into data_out instead
+        raise PermissionError(
+            f"cannot materialize .data next to protected source {path!r}; "
+            "copy the fixture into a writable data dir first"
+        )

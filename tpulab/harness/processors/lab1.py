@@ -1,0 +1,73 @@
+"""lab1 processor: synthetic double vectors + NumPy oracle verification.
+
+Reference behavior (lab1/lab1_processor.py): vectors of size ~U[1024, 3072)
+with values U[-1e100, 1e100], serialized at precision 10; the intended
+oracle ``allclose(result, a - b)`` was committed commented-out
+(lab1_processor.py:62-66) — here it is **active**, computed against the
+round-tripped (serialized-then-parsed) inputs so serialization
+quantization is not misattributed to the kernel.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from tpulab.harness.base import PreparedRun, WorkloadProcessor
+from tpulab.io import protocol
+
+
+class Lab1Processor(WorkloadProcessor):
+    kernel_size_style = "flat"  # [grid, block] ints
+
+    def __init__(
+        self,
+        seed: int = 42,
+        size_min: int = 1024,
+        size_max: int = 3072,
+        value_range: float = 1e100,
+        rtol: float = 1e-9,
+        op: str = "subtract",
+        **_ignored,
+    ):
+        super().__init__(seed=seed)
+        self.size_min = size_min
+        self.size_max = size_max
+        self.value_range = value_range
+        self.rtol = rtol
+        self.op = op
+        self._np_op = {
+            "subtract": np.subtract,
+            "add": np.add,
+            "multiply": np.multiply,
+        }[op]
+
+    def get_attrs(self):
+        return {
+            "seed": self.seed,
+            "op": self.op,
+            "value_range": self.value_range,
+        }
+
+    async def pre_process(self, device_info: str = "", **kwargs) -> PreparedRun:
+        async with self._lock:
+            n = int(self.rng.integers(self.size_min, self.size_max))
+            a = self.rng.uniform(-self.value_range, self.value_range, n)
+            b = self.rng.uniform(-self.value_range, self.value_range, n)
+        text = protocol.format_lab1_input(a, b)
+        sent = protocol.parse_lab1(text)  # the oracle sees what the target sees
+        return PreparedRun(
+            stdin_text=text,
+            verify_ctx=self._np_op(sent.a, sent.b),
+            metadata={"n": n},
+        )
+
+    async def load_result(self, stdout_payload: str, prepared: PreparedRun) -> Any:
+        return np.array([float(t) for t in stdout_payload.split()], np.float64)
+
+    async def verify(self, result: Any, prepared: PreparedRun) -> bool:
+        expect = prepared.verify_ctx
+        return result.shape == expect.shape and bool(
+            np.allclose(result, expect, rtol=self.rtol, atol=1e-10)
+        )

@@ -1,0 +1,122 @@
+"""Harness CLI — the reference's ``run_test.py`` surface, TPU-native.
+
+Examples::
+
+    # in-process TPU target + CPU reference A/B, lab2 sweep
+    python -m tpulab.harness.run --lab lab2 --k-times 5 \
+        --kernel-sizes '[[[32,32],[16,16]],[[16,16],[32,32]]]' --cpu-ref
+
+    # drive an external binary speaking the stdin contract (the
+    # reference's nvcc-built to_plot binaries work unchanged)
+    python -m tpulab.harness.run --binary-path ./lab2/src/to_plot_exe \
+        --k-times 20
+
+Lab resolution from a binary path follows the reference convention
+``labN/src/<exe>`` (run_test.py:58-60); unknown ``--key value`` flags are
+coerced and forwarded to the processor constructor (arg_parsing.py
+behavior).
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+from typing import List, Optional
+
+from tpulab.harness.processors import MAP_PROCESSORS
+from tpulab.harness.runner import InProcessTarget, SubprocessTarget
+from tpulab.harness.tester import Tester
+from tpulab.utils.argcfg import coerce_cli_kwargs
+
+
+def infer_lab_from_path(binary_path: str) -> str:
+    """``.../labN/src/exe`` -> ``labN`` (reference run_test.py:58-60)."""
+    return os.path.basename(os.path.dirname(os.path.dirname(os.path.abspath(binary_path))))
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--lab", help="workload name (lab1|lab2|lab3|lab5|hw1|hw2)")
+    p.add_argument("--binary-path", "--binary_path_cuda", dest="binary_path",
+                   help="external binary speaking the stdin contract")
+    p.add_argument("--binary-path-cpu", "--binary_path_cpu", dest="binary_path_cpu",
+                   help="external CPU reference binary")
+    p.add_argument("--cpu-ref", action="store_true",
+                   help="run the in-process CPU backend as the A/B reference")
+    p.add_argument("--k-times", "--k_times", type=int, default=20)
+    p.add_argument("--kernel-sizes", "--kernel_sizes", default=None,
+                   help="JSON list of per-lab launch configs")
+    p.add_argument("--metadata-columns2plot", "--metadata_columns2plot", default="[]")
+    p.add_argument("--artifact-dir", default=None)
+    p.add_argument("--backend", default=None)
+    args, unknown = p.parse_known_args(argv)
+    cfg = coerce_cli_kwargs(unknown)
+
+    lab = args.lab or (infer_lab_from_path(args.binary_path) if args.binary_path else None)
+    if lab not in MAP_PROCESSORS:
+        p.error(f"cannot resolve workload: --lab or a labN/src/<exe> path required "
+                f"(got {lab!r}; known: {sorted(MAP_PROCESSORS)})")
+
+    processor = MAP_PROCESSORS[lab](**cfg)
+
+    kernel_sizes = json.loads(args.kernel_sizes) if args.kernel_sizes else [None]
+    sweep = args.kernel_sizes is not None
+
+    if args.binary_path:
+        target = SubprocessTarget(
+            name=os.path.basename(args.binary_path),
+            device_label="BIN",
+            argv=[args.binary_path],
+        )
+        artifact_dir = args.artifact_dir or os.path.dirname(os.path.abspath(args.binary_path))
+    else:
+        run_cfg = {k: cfg[k] for k in ("use_pallas", "warmup", "reps", "timing") if k in cfg}
+        if lab in ("hw1", "hw2"):
+            run_cfg.setdefault("timing", True)
+        if lab == "lab5" and "task" in cfg:
+            run_cfg["task"] = cfg["task"]
+        target = InProcessTarget(
+            name=f"tpulab_{lab}",
+            device_label="TPU",
+            workload=lab,
+            sweep=sweep,
+            backend=args.backend,
+            config=run_cfg,
+        )
+        artifact_dir = args.artifact_dir or "."
+
+    cpu_target = None
+    if args.binary_path_cpu:
+        cpu_target = SubprocessTarget(
+            name=os.path.basename(args.binary_path_cpu),
+            device_label="CPU",
+            argv=[args.binary_path_cpu],
+        )
+    elif args.cpu_ref:
+        base_cfg = dict(getattr(target, "config", {}) or {})
+        cpu_target = InProcessTarget(
+            name=f"tpulab_{lab}_cpu",
+            device_label="CPU",
+            workload=lab,
+            sweep=False,
+            backend="cpu",
+            config=base_cfg,
+        )
+
+    tester = Tester(
+        target,
+        cpu_target=cpu_target,
+        k_times=args.k_times,
+        kernel_sizes=kernel_sizes,
+        artifact_dir=artifact_dir,
+        metadata_columns2plot=json.loads(args.metadata_columns2plot),
+    )
+    df = asyncio.run(tester.run_experiments(processor))
+    return 0 if bool((df["verified"] == True).all()) else 1  # noqa: E712
+
+
+if __name__ == "__main__":
+    sys.exit(main())

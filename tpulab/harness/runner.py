@@ -1,0 +1,107 @@
+"""Run targets: subprocess binaries and in-process workload modules.
+
+The subprocess target preserves the reference's process-per-run contract
+(reference ``tester.py:94-166``: spawn binary, feed stdin, parse the
+timing line from stdout line 1).  The in-process target runs a
+:mod:`tpulab.labs` workload directly — same stdin/stdout text contract,
+but the JAX runtime and compilation cache stay warm across runs (the
+SURVEY.md "subprocess-per-run vs JAX startup" hard part).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+import traceback
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from tpulab.harness.base import PreparedRun, RunRecord, WorkloadProcessor
+from tpulab.runtime.timing import parse_timing_line
+
+
+@dataclass
+class Target:
+    """Something that can execute one stdin->stdout run."""
+
+    name: str = "target"
+    device_label: str = "TPU"
+
+    async def execute(self, stdin_text: str) -> str:  # pragma: no cover - abstract
+        raise NotImplementedError
+
+
+@dataclass
+class SubprocessTarget(Target):
+    """Spawn ``argv`` per run, exactly like the reference harness spawns
+    the nvcc-built binaries (tester.py:126-132)."""
+
+    argv: List[str] = field(default_factory=list)
+
+    async def execute(self, stdin_text: str) -> str:
+        proc = await asyncio.create_subprocess_exec(
+            *self.argv,
+            stdin=asyncio.subprocess.PIPE,
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.PIPE,
+        )
+        stdout, stderr = await proc.communicate(stdin_text.encode())
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"{self.argv[0]} exited {proc.returncode}: {stderr.decode(errors='replace')[-2000:]}"
+            )
+        return stdout.decode(errors="replace")
+
+
+@dataclass
+class InProcessTarget(Target):
+    """Run a tpulab workload module in this process (warm JAX runtime)."""
+
+    workload: str = "lab1"
+    sweep: bool = False
+    backend: Optional[str] = None
+    config: Dict[str, Any] = field(default_factory=dict)
+
+    async def execute(self, stdin_text: str) -> str:
+        from tpulab.labs import get_workload
+
+        mod = get_workload(self.workload)
+        return await asyncio.to_thread(
+            mod.run, stdin_text, sweep=self.sweep, backend=self.backend, **self.config
+        )
+
+
+async def run_once(
+    target: Target,
+    processor: WorkloadProcessor,
+    kernel_size=None,
+    device_info: str = "",
+) -> RunRecord:
+    """Execute one run end-to-end: pre_process -> target -> parse -> verify.
+
+    Failures of any stage are captured into the record (the reference's
+    blanket except -> failed-row behavior, tester.py:144-166), never raised.
+    """
+    record = RunRecord(
+        bin_name=target.name,
+        device=target.device_label,
+        kernel_size=str(kernel_size),
+    )
+    t0 = time.perf_counter()
+    prepared: Optional[PreparedRun] = None
+    try:
+        prepared = await processor.pre_process(device_info=device_info)
+        record.metadata.update(prepared.metadata)
+        stdin_text = processor.serialize_kernel_size(kernel_size) + prepared.stdin_text
+        stdout = await target.execute(stdin_text)
+        first, _, payload = stdout.partition("\n")
+        record.time_kernel_ms = parse_timing_line(first)
+        if record.time_kernel_ms is None:
+            payload = stdout  # no timing line (reference hw binaries)
+        result = await processor.load_result(payload, prepared)
+        record.verified = await processor.verify(result, prepared)
+    except Exception:
+        record.error = traceback.format_exc(limit=8)
+        record.verified = False
+    record.time_wall_ms = (time.perf_counter() - t0) * 1e3
+    return record

@@ -1,0 +1,130 @@
+"""Experiment orchestrator: sweeps, A/B devices, verification gating, stats.
+
+The L4 layer of the suite (the reference's ``BaseTester``,
+``tester.py:169-323``): run a target over the full ``k_times x
+kernel_sizes`` grid, run the reference target (CPU) once per repetition
+with no launch config, gate aggregation on all runs verifying, write
+``stats_*.csv`` / ``failed_*.csv`` artifacts, and render the median
+bar chart.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import pandas as pd
+
+from tpulab.harness.base import RunRecord, WorkloadProcessor
+from tpulab.harness.runner import Target, run_once
+
+STAT_COLUMNS = ["mean", "median", "min", "max", "std"]
+
+
+def summarize(df: pd.DataFrame) -> pd.DataFrame:
+    """Per-(device, kernel_size) timing stats over verified runs."""
+    g = df.groupby(["device", "kernel_size"])["time_kernel_ms"]
+    stats = g.agg(["mean", "median", "min", "max", "std", "count"])
+    return stats.reset_index()
+
+
+class Tester:
+    """Sweep runner.
+
+    Parameters mirror the reference CLI surface (run_test.py:19-51):
+    ``k_times`` repetitions x ``kernel_sizes`` launch configs for the
+    accelerated target; the optional CPU-reference target runs ``k_times``
+    times with no launch config (tester.py:302-310).
+    """
+
+    __test__ = False  # not a pytest collectible despite the name
+
+    def __init__(
+        self,
+        target: Target,
+        *,
+        cpu_target: Optional[Target] = None,
+        k_times: int = 20,
+        kernel_sizes: Optional[Sequence] = None,
+        artifact_dir: str = ".",
+        metadata_columns2plot: Optional[List[str]] = None,
+        max_concurrency: int = 1,
+        log=print,
+    ):
+        self.target = target
+        self.cpu_target = cpu_target
+        self.k_times = k_times
+        self.kernel_sizes = list(kernel_sizes) if kernel_sizes else [None]
+        self.artifact_dir = artifact_dir
+        self.metadata_columns2plot = metadata_columns2plot or []
+        self.max_concurrency = max(1, max_concurrency)
+        self.log = log
+
+    async def run_target_sweep(
+        self, target: Target, processor: WorkloadProcessor, kernel_sizes: Sequence
+    ) -> List[RunRecord]:
+        sem = asyncio.Semaphore(self.max_concurrency)
+        records: List[RunRecord] = []
+
+        async def one(ks):
+            device_info = f"{target.name}__{ks}"
+            async with sem:
+                return await run_once(target, processor, ks, device_info=device_info)
+
+        tasks = [
+            asyncio.create_task(one(ks))
+            for _ in range(self.k_times)
+            for ks in kernel_sizes
+        ]
+        for t in tasks:
+            records.append(await t)
+        return records
+
+    async def run_experiments(self, processor: WorkloadProcessor) -> pd.DataFrame:
+        """Full experiment: accelerated sweep + CPU reference pass.
+
+        Returns the combined run table; artifacts land in artifact_dir.
+        """
+        t_start = time.perf_counter()
+        self.log(f"[Experiments] target={self.target.name} k_times={self.k_times} "
+                 f"kernel_sizes={self.kernel_sizes}")
+        jobs = [self.run_target_sweep(self.target, processor, self.kernel_sizes)]
+        if self.cpu_target is not None:
+            jobs.append(self.run_target_sweep(self.cpu_target, processor, [None]))
+        all_records: List[RunRecord] = []
+        for recs in await asyncio.gather(*jobs):
+            all_records.extend(recs)
+
+        attrs = processor.get_attrs()
+        for r in all_records:
+            r.metadata.update(attrs)
+        df = pd.DataFrame([r.as_row() for r in all_records])
+
+        failed = df[(df["verified"] != True) | df["error"].notna()]  # noqa: E712
+        os.makedirs(self.artifact_dir, exist_ok=True)
+        if len(failed):
+            path = os.path.join(self.artifact_dir, f"failed_{self.target.name}.csv")
+            failed.to_csv(path, index=False)
+            self.log(f"[Experiments] {len(failed)}/{len(df)} runs failed verification "
+                     f"-> {path}; stats withheld (all-verify gate)")
+        else:
+            stats = summarize(df)
+            path = os.path.join(self.artifact_dir, f"stats_{self.target.name}.csv")
+            stats.to_csv(path, index=False)
+            self.log(f"[Experiments] stats -> {path}")
+            self.log(stats.to_string(index=False))
+            try:
+                from tpulab.harness.plotting import plot_median_times
+
+                png = os.path.join(self.artifact_dir, "median_execution_time.png")
+                plot_median_times(df, png, metadata_columns=self.metadata_columns2plot)
+                self.log(f"[Experiments] chart -> {png}")
+            except Exception as exc:  # plotting is best-effort (headless etc.)
+                self.log(f"[Experiments] plot skipped: {exc}")
+        raw_path = os.path.join(self.artifact_dir, f"runs_{self.target.name}.csv")
+        df.to_csv(raw_path, index=False)
+        self.log(f"[Experiments] total {time.perf_counter() - t_start:.2f}s, "
+                 f"{len(df)} runs -> {raw_path}")
+        return df

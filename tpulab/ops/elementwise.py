@@ -53,6 +53,7 @@ def make_binary_fn(
     launch: Optional[Tuple[int, int]] = None,
     device=None,
     use_pallas: Optional[bool] = None,
+    rank: int = 1,
 ) -> Callable:
     """Build the jitted elementwise callable for a fixed config.
 
@@ -60,14 +61,15 @@ def make_binary_fn(
     ``device`` — timing it measures compute only (the cudaEvent analog).
     ``launch`` (the CUDA ``(grid, block)`` sweep axis) maps to the Pallas
     tile height; it is inert on the f64/CPU path, exactly like the
-    reference CPU binary which takes no launch config.
+    reference CPU binary which takes no launch config.  The Pallas kernel
+    handles 1D vectors (the lab1 shape); other ranks use fused XLA.
     """
     if name not in _OPS:
         raise ValueError(f"unknown op {name!r}; have {sorted(_OPS)}")
     if device is None:
         device = resolve_binary_device(dtype)
     if use_pallas is None:
-        use_pallas = device.platform == "tpu" and dtype != jnp.float64
+        use_pallas = device.platform == "tpu" and dtype != jnp.float64 and rank == 1
     if use_pallas:
         return functools.partial(
             pallas_binary,
@@ -95,7 +97,9 @@ def binary_op(
     device = resolve_binary_device(a.dtype, backend)
     a = jax.device_put(a, device)
     b = jax.device_put(b, device)
-    fn = make_binary_fn(name, a.dtype, launch=launch, device=device, use_pallas=use_pallas)
+    fn = make_binary_fn(
+        name, a.dtype, launch=launch, device=device, use_pallas=use_pallas, rank=a.ndim
+    )
     return fn(a, b)
 
 

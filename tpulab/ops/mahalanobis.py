@@ -81,8 +81,10 @@ def classify_labels(
 
     Vectorized over classes: ``d = p - mu_c``, ``dist = sum((d @ S_c^-1) * d)``
     — the same contraction order as the reference kernel's ``temp``/``dist``
-    loops (main.cu:56-66).  ``jnp.argmin`` keeps the first minimal class,
-    matching the strict-< update.
+    loops (main.cu:56-66).  The argmin is a strict-< fold over classes (NOT
+    ``jnp.argmin``): NaN distances — a degenerate single-point class — must
+    never win, exactly as the C ``dist < best_d`` comparison rejects NaN
+    (main.cu:68-71).
     """
     p = pixels_u8[..., :3].astype(compute_dtype)           # (h, w, 3)
     mu = mean.astype(compute_dtype)                        # (nc, 3)
@@ -90,7 +92,16 @@ def classify_labels(
     d = p[:, :, None, :] - mu[None, None, :, :]            # (h, w, nc, 3)
     t = jnp.einsum("hwcj,cji->hwci", d, ic)                # temp_i (main.cu:57-61)
     dist = jnp.sum(t * d, axis=-1)                         # (h, w, nc)
-    return jnp.argmin(dist, axis=-1).astype(jnp.uint8)
+
+    nc = dist.shape[-1]
+    best = jnp.full(p.shape[:2], -1, jnp.int32)
+    min_dist = jnp.full(p.shape[:2], jnp.inf, dist.dtype)
+    for c in range(nc):  # static unroll, nc <= MAX_CLASSES
+        dc = dist[..., c]
+        upd = dc < min_dist
+        best = jnp.where(upd, jnp.int32(c), best)
+        min_dist = jnp.where(upd, dc, min_dist)
+    return best.astype(jnp.uint8)
 
 
 def classify(

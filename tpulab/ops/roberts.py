@@ -23,10 +23,13 @@ from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-_LUMA_R = jnp.float32(0.299)
-_LUMA_G = jnp.float32(0.587)
-_LUMA_B = jnp.float32(0.114)
+# numpy scalars (not jnp): importing this module must not initialize a
+# jax backend
+_LUMA_R = np.float32(0.299)
+_LUMA_G = np.float32(0.587)
+_LUMA_B = np.float32(0.114)
 
 
 def luminance_f32(pixels_u8: jax.Array) -> jax.Array:

@@ -11,9 +11,21 @@ import os
 # Hard override: the container environment pins JAX_PLATFORMS=axon (real
 # TPU tunnel); tests always run on the virtual 8-device CPU mesh.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Blank (not unset) so child processes — subprocess-target tests spawn
+# `python -m tpulab` — skip the sitecustomize axon TPU claim: a test run
+# killed mid-claim wedges the relay for every later python startup.
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The container's sitecustomize registers the axon PJRT plugin at
+# interpreter startup and calls jax.config.update("jax_platforms",
+# "axon,cpu"), which takes precedence over the env var — override the
+# config itself before any backend initializes.
+import jax
+
+jax.config.update("jax_platforms", "cpu")
 
 import pathlib
 

@@ -18,7 +18,15 @@ import sys
 import numpy as np
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-os.environ["JAX_PLATFORMS"] = "cpu"  # hard override (container pins axon)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+# The env var alone is NOT enough: the container's sitecustomize calls
+# jax.config.update("jax_platforms", "axon,cpu") at interpreter startup,
+# which outranks it — goldens would silently be computed on the TPU f32
+# path.  Override the config itself before any backend initializes.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 from tpulab.io import save_image  # noqa: E402
 from tpulab.harness.processors.lab3 import PINNED_CLASS_POINTS  # noqa: E402

@@ -72,7 +72,11 @@ def run_benchmarks(only: Optional[str] = None, **kw) -> List[Dict[str, Any]]:
             continue
         base_fn = fn.func if isinstance(fn, functools.partial) else fn
         params = list(inspect.signature(base_fn).parameters)
-        bound = set(params[: len(fn.args)]) if isinstance(fn, functools.partial) else set()
+        bound = (
+            set(params[: len(fn.args)]) | set(fn.keywords)
+            if isinstance(fn, functools.partial)
+            else set()
+        )
         accepted = {k: v for k, v in kw.items() if k in params and k not in bound}
         rows.append(fn(**accepted))
     return rows

@@ -38,6 +38,9 @@ class RunRecord:
     time_wall_ms: Optional[float] = None
     verified: Optional[bool] = None
     error: Optional[str] = None
+    #: backend self-reported in the timing line (may differ from the
+    #: target's nominal ``device`` label, e.g. f64 paths run on CPU)
+    device_reported: Optional[str] = None
     metadata: Dict[str, Any] = field(default_factory=dict)
 
     def as_row(self) -> Dict[str, Any]:
@@ -49,6 +52,7 @@ class RunRecord:
             "time_wall_ms": self.time_wall_ms,
             "verified": self.verified,
             "error": self.error,
+            "device_reported": self.device_reported,
         }
         row.update(self.metadata)
         return row
@@ -62,10 +66,6 @@ class WorkloadProcessor(abc.ABC):
     (the reference seeds global numpy state, tester.py:60-62; a local
     generator is the non-global equivalent).
     """
-
-    #: how this workload's kernel_sizes entries serialize to stdin prefix
-    #: lines — "flat" ints (lab1/lab3) or "pairs" [[bx,by],[gx,gy]] (lab2)
-    kernel_size_style: str = "flat"
 
     def __init__(self, seed: int = 42):
         self.seed = seed

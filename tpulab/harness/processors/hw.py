@@ -15,7 +15,6 @@ class Hw1Processor(WorkloadProcessor):
     """Random coefficient triples (including degenerate a=0/b=0 cases);
     oracle = the scalar f32 solver's exact output line."""
 
-    kernel_size_style = "flat"
 
     def __init__(self, seed: int = 42, coeff_range: float = 100.0, **_ignored):
         super().__init__(seed=seed)
@@ -51,7 +50,6 @@ class Hw1Processor(WorkloadProcessor):
 class Hw2Processor(WorkloadProcessor):
     """Random float vectors; oracle = NumPy ascending sort at %.6e."""
 
-    kernel_size_style = "flat"
 
     def __init__(
         self,

@@ -15,6 +15,7 @@ import re
 import shutil
 from typing import Dict, List, Optional, Tuple
 
+from tpulab.io.imagefile import save_image
 from tpulab.utils.imgdata import ImgData, _is_protected
 
 IMAGE_EXTS = (".txt", ".data", ".png")  # golden lookup priority
@@ -71,6 +72,7 @@ class ImageDataset:
         if not self.paths:
             raise FileNotFoundError(f"no images found in {dir_to_data!r}")
         self._idx = 0
+        self._load_cache: Dict[str, Tuple[str, ImgData]] = {}
         if reset_out and not _is_protected(self.dir_to_data_out):
             shutil.rmtree(self.dir_to_data_out, ignore_errors=True)
         os.makedirs(self.dir_to_data_out, exist_ok=True)
@@ -91,18 +93,55 @@ class ImageDataset:
             safe_run_dir(self.dir_to_data_out, device_info), stem + ".data"
         )
 
-    @staticmethod
-    def input_as_data_file(path: str) -> str:
+    def input_as_data_file(self, path: str) -> Tuple[str, ImgData]:
         """Ensure a ``.data`` sibling exists (binaries consume ``.data``);
-        returns the ``.data`` path."""
+        returns ``(data_path, loaded image)``.  Loads are cached per path
+        so repeated sweep runs don't re-parse the same fixture, and
+        protected (read-only) source dirs fall back to materializing the
+        ``.data`` copy under ``dir_to_data_out/_materialized``."""
+        cached = self._load_cache.get(path)
+        if cached is not None:
+            return cached
         if path.lower().endswith(".data"):
-            return path
-        img = ImgData(path)  # eagerly materializes siblings next to source
-        sibling = os.path.join(img.dir2save, img.data_name + ".data")
-        if os.path.exists(sibling):
-            return sibling
-        # protected (read-only) source dir: materialize into data_out instead
-        raise PermissionError(
-            f"cannot materialize .data next to protected source {path!r}; "
-            "copy the fixture into a writable data dir first"
-        )
+            result = path, ImgData(path, materialize=False)
+        else:
+            img = ImgData(path)  # materializes missing siblings next to source
+            sibling = os.path.join(img.dir2save, img.data_name + ".data")
+            if not os.path.exists(sibling):
+                # read-only source dir: materialize into data_out instead
+                mat_dir = os.path.join(self.dir_to_data_out, "_materialized")
+                os.makedirs(mat_dir, exist_ok=True)
+                sibling = os.path.join(mat_dir, img.data_name + ".data")
+                if not os.path.exists(sibling):
+                    # tmp keeps the .data suffix (save_image dispatches on it)
+                    tmp = os.path.join(
+                        mat_dir, f".{img.data_name}.tmp{os.getpid()}.data"
+                    )
+                    save_image(tmp, img.pixels)
+                    os.replace(tmp, sibling)
+            result = sibling, img
+        self._load_cache[path] = result
+        return result
+
+    def verify_golden(
+        self,
+        result: ImgData,
+        golden_path: Optional[str],
+        in_path: str,
+        log=print,
+        verbose_diff: bool = True,
+    ) -> bool:
+        """Exact-bytes comparison against the golden image; golden-less
+        images are benchmark-only and pass automatically (reference
+        lab2_processor.py:136-139, :142-160)."""
+        if golden_path is None:
+            return True
+        expect = ImgData(golden_path, materialize=False)
+        ok = result.c_data_bytes == expect.c_data_bytes
+        if not ok and verbose_diff:
+            log(
+                f"[verify_result] mismatch for {in_path}\n"
+                f"  actual:   {result.hex[:160]}...\n"
+                f"  expected: {expect.hex[:160]}..."
+            )
+        return ok

@@ -19,7 +19,6 @@ from tpulab.io import protocol
 
 
 class Lab1Processor(WorkloadProcessor):
-    kernel_size_style = "flat"  # [grid, block] ints
 
     def __init__(
         self,

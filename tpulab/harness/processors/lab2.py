@@ -20,7 +20,6 @@ DEFAULT_DATA_DIR = os.path.join(os.path.dirname(__file__), "../../../data/lab2/d
 
 
 class Lab2Processor(WorkloadProcessor):
-    kernel_size_style = "pairs"  # [[bx,by],[gx,gy]]
 
     def __init__(
         self,
@@ -47,9 +46,8 @@ class Lab2Processor(WorkloadProcessor):
     async def pre_process(self, device_info: str = "", **kwargs) -> PreparedRun:
         async with self._lock:
             in_path, golden = self.dataset.next_item()
-        in_data = self.dataset.input_as_data_file(in_path)
+        in_data, img = self.dataset.input_as_data_file(in_path)
         out_path = self.dataset.out_path_for(in_path, device_info)
-        img = ImgData(in_data, materialize=False)
         return PreparedRun(
             stdin_text=f"{in_data}\n{out_path}\n",
             verify_ctx={"golden": golden, "out_path": out_path, "in_path": in_data},
@@ -64,15 +62,10 @@ class Lab2Processor(WorkloadProcessor):
         return ImgData(prepared.verify_ctx["out_path"], materialize=False)
 
     async def verify(self, result: Any, prepared: PreparedRun) -> bool:
-        golden = prepared.verify_ctx["golden"]
-        if golden is None:
-            return True  # benchmark-only image
-        expect = ImgData(golden, materialize=False)
-        ok = result.c_data_bytes == expect.c_data_bytes
-        if not ok and self.verbose_diff:
-            self.log(
-                f"[verify_result] mismatch for {prepared.verify_ctx['in_path']}\n"
-                f"  actual:   {result.hex[:160]}...\n"
-                f"  expected: {expect.hex[:160]}..."
-            )
-        return ok
+        return self.dataset.verify_golden(
+            result,
+            prepared.verify_ctx["golden"],
+            prepared.verify_ctx["in_path"],
+            log=self.log,
+            verbose_diff=self.verbose_diff,
+        )

@@ -44,7 +44,6 @@ PINNED_CLASS_POINTS: Dict[str, List[np.ndarray]] = {
 
 
 class Lab3Processor(WorkloadProcessor):
-    kernel_size_style = "flat"  # [blocks, threads]
 
     def __init__(
         self,
@@ -55,6 +54,7 @@ class Lab3Processor(WorkloadProcessor):
         count_classes: int = 2,
         count_pts: int = 4,
         pinned_points: Optional[Dict[str, List[np.ndarray]]] = None,
+        verbose_diff: bool = True,
         log=print,
         **_ignored,
     ):
@@ -71,6 +71,7 @@ class Lab3Processor(WorkloadProcessor):
         self.pinned_points = dict(PINNED_CLASS_POINTS)
         if pinned_points:
             self.pinned_points.update(pinned_points)
+        self.verbose_diff = verbose_diff
         self.log = log
 
     def get_attrs(self):
@@ -93,9 +94,8 @@ class Lab3Processor(WorkloadProcessor):
     async def pre_process(self, device_info: str = "", **kwargs) -> PreparedRun:
         async with self._lock:
             in_path, golden = self.dataset.next_item()
-        in_data = self.dataset.input_as_data_file(in_path)
+        in_data, img = self.dataset.input_as_data_file(in_path)
         out_path = self.dataset.out_path_for(in_path, device_info)
-        img = ImgData(in_data, materialize=False)
         stem = os.path.splitext(os.path.basename(in_path))[0]
         async with self._lock:
             classes = self._points_for(stem, img.width, img.height)
@@ -114,15 +114,10 @@ class Lab3Processor(WorkloadProcessor):
         return ImgData(prepared.verify_ctx["out_path"], materialize=False)
 
     async def verify(self, result: Any, prepared: PreparedRun) -> bool:
-        golden = prepared.verify_ctx["golden"]
-        if golden is None:
-            return True
-        expect = ImgData(golden, materialize=False)
-        ok = result.c_data_bytes == expect.c_data_bytes
-        if not ok:
-            self.log(
-                f"[verify_result] lab3 mismatch for {prepared.verify_ctx['in_path']}\n"
-                f"  actual:   {result.hex[:160]}...\n"
-                f"  expected: {expect.hex[:160]}..."
-            )
-        return ok
+        return self.dataset.verify_golden(
+            result,
+            prepared.verify_ctx["golden"],
+            prepared.verify_ctx["in_path"],
+            log=self.log,
+            verbose_diff=self.verbose_diff,
+        )

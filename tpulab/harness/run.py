@@ -50,7 +50,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--kernel-sizes", "--kernel_sizes", default=None,
                    help="JSON list of per-lab launch configs")
     p.add_argument("--metadata-columns2plot", "--metadata_columns2plot", default="[]")
-    p.add_argument("--artifact-dir", default=None)
+    p.add_argument("--artifact-dir", "--artifact_dir", dest="artifact_dir", default=None)
     p.add_argument("--backend", default=None)
     args, unknown = p.parse_known_args(argv)
     cfg = coerce_cli_kwargs(unknown)
@@ -73,11 +73,14 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
         artifact_dir = args.artifact_dir or os.path.dirname(os.path.abspath(args.binary_path))
     else:
-        run_cfg = {k: cfg[k] for k in ("use_pallas", "warmup", "reps", "timing") if k in cfg}
+        # Workload-run knobs shared between the processor oracle and the
+        # in-process target.  Every labs.*.run() swallows unknown kwargs,
+        # but keep this an explicit list so processor-only synthesis
+        # kwargs (seed, size_min, ...) never leak into the compute path.
+        run_keys = ("use_pallas", "warmup", "reps", "timing", "op", "dtype", "task", "mesh")
+        run_cfg = {k: cfg[k] for k in run_keys if k in cfg}
         if lab in ("hw1", "hw2"):
             run_cfg.setdefault("timing", True)
-        if lab == "lab5" and "task" in cfg:
-            run_cfg["task"] = cfg["task"]
         target = InProcessTarget(
             name=f"tpulab_{lab}",
             device_label="TPU",

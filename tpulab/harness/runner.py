@@ -17,7 +17,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
 from tpulab.harness.base import PreparedRun, RunRecord, WorkloadProcessor
-from tpulab.runtime.timing import parse_timing_line
+from tpulab.runtime.timing import parse_timing_device, parse_timing_line
 
 
 @dataclass
@@ -27,7 +27,7 @@ class Target:
     name: str = "target"
     device_label: str = "TPU"
 
-    async def execute(self, stdin_text: str) -> str:  # pragma: no cover - abstract
+    async def execute(self, stdin_text: str, sweep: bool | None = None) -> str:  # pragma: no cover - abstract
         raise NotImplementedError
 
 
@@ -38,7 +38,8 @@ class SubprocessTarget(Target):
 
     argv: List[str] = field(default_factory=list)
 
-    async def execute(self, stdin_text: str) -> str:
+    async def execute(self, stdin_text: str, sweep: bool | None = None) -> str:
+        del sweep  # binaries learn the config from the stdin prefix itself
         proc = await asyncio.create_subprocess_exec(
             *self.argv,
             stdin=asyncio.subprocess.PIPE,
@@ -62,12 +63,16 @@ class InProcessTarget(Target):
     backend: Optional[str] = None
     config: Dict[str, Any] = field(default_factory=dict)
 
-    async def execute(self, stdin_text: str) -> str:
+    async def execute(self, stdin_text: str, sweep: bool | None = None) -> str:
         from tpulab.labs import get_workload
 
         mod = get_workload(self.workload)
+        # Per-run override: a None kernel_sizes entry serializes to no
+        # prefix lines, so the workload must not parse one even when the
+        # overall experiment is a sweep (and vice versa).
+        effective_sweep = self.sweep if sweep is None else sweep
         return await asyncio.to_thread(
-            mod.run, stdin_text, sweep=self.sweep, backend=self.backend, **self.config
+            mod.run, stdin_text, sweep=effective_sweep, backend=self.backend, **self.config
         )
 
 
@@ -92,12 +97,18 @@ async def run_once(
     try:
         prepared = await processor.pre_process(device_info=device_info)
         record.metadata.update(prepared.metadata)
-        stdin_text = processor.serialize_kernel_size(kernel_size) + prepared.stdin_text
-        stdout = await target.execute(stdin_text)
+        prefix = processor.serialize_kernel_size(kernel_size)
+        stdout = await target.execute(prefix + prepared.stdin_text, sweep=bool(prefix))
         first, _, payload = stdout.partition("\n")
         record.time_kernel_ms = parse_timing_line(first)
         if record.time_kernel_ms is None:
             payload = stdout  # no timing line (reference hw binaries)
+        else:
+            # The nominal target label groups the A/B sweeps; the timing
+            # line's device word records which backend actually executed
+            # (the f64 paths run on the CPU backend even under a "TPU"
+            # target) so charts/stats can expose misattribution.
+            record.device_reported = parse_timing_device(first)
         result = await processor.load_result(payload, prepared)
         record.verified = await processor.verify(result, prepared)
     except Exception:

@@ -12,6 +12,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from tpulab.io import protocol
 from tpulab.ops.elementwise import binary_op, make_binary_fn, resolve_binary_device
@@ -45,8 +46,14 @@ def run(
     # (the cudaEvent analog; f64 lives on the CPU backend — TPUs have no
     # native f64, see tpulab.ops.elementwise).
     device = resolve_binary_device(dt, backend)
-    a = jax.device_put(jnp.asarray(inp.a, dtype=dt), device)
-    b = jax.device_put(jnp.asarray(inp.b, dtype=dt), device)
+    # Cast in NumPy, then device_put the host buffer straight to the
+    # resolved device: jnp.asarray would materialize on the default
+    # (TPU) device first, where f64 silently degrades to f32.
+    np_dt = np.dtype(dtype) if dtype != "bfloat16" else np.float32
+    a = jax.device_put(np.asarray(inp.a, dtype=np_dt), device)
+    b = jax.device_put(np.asarray(inp.b, dtype=np_dt), device)
+    if dtype == "bfloat16":
+        a, b = a.astype(dt), b.astype(dt)
 
     fn = make_binary_fn(op, dt, launch=inp.launch, device=device)
     ms, out = measure_ms(fn, (a, b), warmup=warmup, reps=reps)

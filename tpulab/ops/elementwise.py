@@ -90,8 +90,12 @@ def binary_op(
     use_pallas: Optional[bool] = None,
 ) -> jax.Array:
     """Elementwise ``name`` over two vectors with dtype-driven placement."""
-    a = jnp.asarray(a)
-    b = jnp.asarray(b)
+    # Stage host inputs through NumPy, not jnp.asarray: jnp materializes
+    # on the *default* device first, and a TPU default device silently
+    # stores f64 as f32 (1e100-range values become inf) before device_put
+    # can move them to the CPU backend.
+    a = a if isinstance(a, jax.Array) else np.asarray(a)
+    b = b if isinstance(b, jax.Array) else np.asarray(b)
     if a.dtype != b.dtype:
         raise ValueError(f"dtype mismatch: {a.dtype} vs {b.dtype}")
     device = resolve_binary_device(a.dtype, backend)

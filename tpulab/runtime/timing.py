@@ -21,6 +21,7 @@ from typing import Any, Callable, Optional, Sequence, Tuple
 import jax
 
 TIMING_LINE_PATTERN = re.compile(r"execution time: <([\d.]+) ms>")
+DEVICE_WORD_PATTERN = re.compile(r"^\s*(\w+) execution time:")
 
 
 def format_timing_line(device_label: str, ms: float) -> str:
@@ -32,6 +33,14 @@ def parse_timing_line(text: str) -> Optional[float]:
     """Extract the kernel time from program stdout (harness side)."""
     match = TIMING_LINE_PATTERN.search(text)
     return float(match.group(1)) if match else None
+
+
+def parse_timing_device(text: str) -> Optional[str]:
+    """Device word from the timing line (``TPU``/``CPU``/``CUDA``) — the
+    executing backend's self-report, which can differ from the target's
+    nominal label (e.g. the lab1 f64 path runs on the CPU backend)."""
+    match = DEVICE_WORD_PATTERN.match(text)
+    return match.group(1) if match else None
 
 
 def _block(out: Any) -> None:

@@ -73,14 +73,29 @@ class ImgData(Image4):
             self._materialize_siblings()
 
     def _materialize_siblings(self) -> None:
+        # Only fill in *missing* siblings, and write each atomically
+        # (temp + rename): concurrent harness runs read these files while
+        # another run's pre_process may be materializing them.
         for ext in (".data", ".txt", ".png"):
             if ext == self.ext.lower():
                 continue
             sib = os.path.join(self.dir2save, self.data_name + ext)
+            if os.path.exists(sib):
+                continue
+            tmp = os.path.join(
+                self.dir2save, f".{self.data_name}.tmp{os.getpid()}{ext}"
+            )
             try:
-                save_image(sib, self.pixels)
+                save_image(tmp, self.pixels)
+                os.replace(tmp, sib)
             except OSError:
                 pass  # read-only directories: skip the cache write
+            finally:
+                if os.path.exists(tmp):
+                    try:
+                        os.remove(tmp)
+                    except OSError:
+                        pass
 
     @classmethod
     def from_pixels(cls, pixels: np.ndarray) -> "Image4":

@@ -91,8 +91,8 @@ class TestImageProcessors:
     def test_lab2_detects_corruption(self, tmp_path):
         # a target that writes a corrupted image must fail verification
         class CorruptTarget(InProcessTarget):
-            async def execute(self, stdin_text):
-                out = await super().execute(stdin_text)
+            async def execute(self, stdin_text, sweep=None):
+                out = await super().execute(stdin_text, sweep=sweep)
                 out_path = stdin_text.splitlines()[1]
                 blob = bytearray(open(out_path, "rb").read())
                 blob[8] ^= 0xFF
@@ -197,3 +197,28 @@ class TestRunCli:
         )
         assert rc == 0
         assert (tmp_path / "stats_tpulab_lab1.csv").exists()
+
+    def test_cli_lab1_narrow_dtypes(self, tmp_path):
+        # regression: --dtype must reach both the workload and the oracle
+        for dtype in ("float32", "bfloat16"):
+            rc = harness_main(
+                [
+                    "--lab", "lab1", "--k-times", "1",
+                    "--artifact-dir", str(tmp_path / dtype),
+                    "--size_min", "16", "--size_max", "32",
+                    "--dtype", dtype, "--warmup", "0", "--reps", "1",
+                ]
+            )
+            assert rc == 0, dtype
+
+    def test_cli_lab5_mesh(self, tmp_path):
+        # regression: --mesh N routes through the distributed collectives
+        for task in ("sum", "sort"):
+            rc = harness_main(
+                [
+                    "--lab", "lab5", "--k-times", "1", "--task", task,
+                    "--mesh", "8", "--artifact-dir", str(tmp_path / task),
+                    "--warmup", "0", "--reps", "1",
+                ]
+            )
+            assert rc == 0, task

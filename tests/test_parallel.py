@@ -1,0 +1,143 @@
+"""Multi-device tier tests on the virtual 8-device CPU mesh.
+
+The distributed implementations must agree with their single-device
+twins (and NumPy oracles) exactly — the same bar the golden-file tier
+sets for the lab kernels (SURVEY.md section 4).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpulab.ops.mahalanobis import ClassStats, class_statistics, classify
+from tpulab.ops.roberts import roberts_edges
+from tpulab.parallel import (
+    all_gather_op,
+    best_factorization,
+    classify_sharded,
+    distributed_mean,
+    distributed_reduce,
+    distributed_sort,
+    make_mesh,
+    reduce_scatter_op,
+    roberts_sharded,
+)
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    return make_mesh({"x": 8})
+
+
+@pytest.fixture(scope="module")
+def mesh4():
+    return make_mesh({"x": 4})
+
+
+def test_mesh_factorization():
+    assert best_factorization(8, ("dp", "tp")) == {"dp": 2, "tp": 4}
+    assert best_factorization(8, ("x",)) == {"x": 8}
+    sizes = best_factorization(12, ("a", "b", "c"))
+    assert sizes["a"] * sizes["b"] * sizes["c"] == 12
+    assert best_factorization(1, ("dp", "tp")) == {"dp": 1, "tp": 1}
+
+
+def test_make_mesh_shapes():
+    m = make_mesh({"dp": 2, "tp": 4})
+    assert m.shape == {"dp": 2, "tp": 4}
+    m1 = make_mesh(n_devices=8, axes=("x",))
+    assert m1.shape["x"] == 8
+
+
+class TestDistributedReduce:
+    @pytest.mark.parametrize("op", ["sum", "min", "max", "prod"])
+    def test_int_ops_match_numpy(self, mesh8, op, rng):
+        vals = rng.integers(1, 5, size=37).astype(np.int32)
+        got = distributed_reduce(vals, op, mesh=mesh8)
+        want = {"sum": np.sum, "min": np.min, "max": np.max, "prod": np.prod}[op](
+            vals.astype(np.int64)
+        )
+        assert int(got) == int(want)
+
+    @pytest.mark.parametrize("op", ["sum", "min", "max"])
+    def test_float_ops(self, mesh8, op, rng):
+        vals = rng.normal(size=64).astype(np.float32)
+        got = distributed_reduce(vals, op, mesh=mesh8)
+        want = {"sum": np.sum, "min": np.min, "max": np.max}[op](vals)
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-5)
+
+    def test_matches_single_device_reduce(self, mesh8):
+        # the lab5 fixture pattern: descending 0,9,8,...,1 (SURVEY.md 2.3)
+        vals = np.array([0, 9, 8, 7, 6, 5, 4, 3, 2, 1], np.int32)
+        from tpulab.ops.reduction import reduce_op
+
+        assert int(distributed_reduce(vals, "sum", mesh=mesh8)) == int(
+            reduce_op(vals, "sum", backend="cpu")
+        )
+
+    def test_mean(self, mesh8, rng):
+        vals = rng.normal(size=19)
+        got = distributed_mean(vals, mesh=mesh8)
+        np.testing.assert_allclose(float(got), vals.mean(), rtol=1e-12)
+
+
+class TestGatherScatter:
+    def test_all_gather_identity(self, mesh4, rng):
+        vals = rng.normal(size=16).astype(np.float32)
+        got = np.asarray(all_gather_op(vals, mesh=mesh4))
+        np.testing.assert_array_equal(got, vals)
+
+    def test_reduce_scatter_is_column_sum(self, mesh4, rng):
+        mat = rng.normal(size=(4, 8)).astype(np.float32)
+        got = np.asarray(reduce_scatter_op(mat, mesh=mesh4))
+        np.testing.assert_allclose(got, mat.sum(axis=0), rtol=1e-5)
+
+
+class TestHaloStencil:
+    @pytest.mark.parametrize("shape", [(16, 16), (37, 23), (5, 9), (8, 128)])
+    def test_matches_single_device(self, mesh8, rng, shape):
+        img = rng.integers(0, 256, size=(*shape, 4)).astype(np.uint8)
+        want = np.asarray(roberts_edges(jnp.asarray(img)))
+        got = roberts_sharded(img, mesh=mesh8)
+        np.testing.assert_array_equal(got, want)
+
+    def test_height_smaller_than_mesh(self, mesh8, rng):
+        img = rng.integers(0, 256, size=(3, 7, 4)).astype(np.uint8)
+        want = np.asarray(roberts_edges(jnp.asarray(img)))
+        np.testing.assert_array_equal(roberts_sharded(img, mesh=mesh8), want)
+
+
+class TestDistributedSort:
+    @pytest.mark.parametrize("n", [10, 64, 1000, 1021])
+    def test_float(self, mesh8, rng, n):
+        vals = rng.normal(size=n).astype(np.float32)
+        np.testing.assert_array_equal(distributed_sort(vals, mesh=mesh8), np.sort(vals))
+
+    def test_int_with_duplicates(self, mesh8, rng):
+        vals = rng.integers(0, 10, size=200).astype(np.int32)
+        np.testing.assert_array_equal(distributed_sort(vals, mesh=mesh8), np.sort(vals))
+
+    def test_uint8_lab5_fixture_pattern(self, mesh8):
+        vals = np.array([1, 2, 3, 1, 2, 3, 1, 2, 3, 4], np.uint8)  # lab5/data/uchar10
+        got = distributed_sort(vals, mesh=mesh8)
+        assert got.dtype == np.uint8
+        np.testing.assert_array_equal(got, np.sort(vals))
+
+    def test_already_sorted_and_reversed(self, mesh4):
+        vals = np.arange(100, dtype=np.float64)
+        np.testing.assert_array_equal(distributed_sort(vals, mesh=mesh4), vals)
+        np.testing.assert_array_equal(distributed_sort(vals[::-1], mesh=mesh4), vals)
+
+
+class TestShardedClassify:
+    def test_matches_single_device(self, mesh8, rng):
+        img = rng.integers(0, 256, size=(32, 16, 4)).astype(np.uint8)
+        classes = [
+            np.array([[0, 0], [1, 0], [2, 1], [3, 2]]),
+            np.array([[10, 20], [11, 21], [12, 22], [13, 23]]),
+        ]
+        stats = class_statistics(img, classes)
+        want = np.asarray(classify(img, stats, backend="cpu", compute_dtype=jnp.float32))
+        got = classify_sharded(img, stats, mesh=mesh8, compute_dtype=jnp.float32)
+        np.testing.assert_array_equal(got, want)

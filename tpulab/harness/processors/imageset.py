@@ -27,6 +27,8 @@ def scan_images(directory: str) -> List[str]:
     if not os.path.isdir(directory):
         return []
     for name in sorted(os.listdir(directory)):
+        if name.startswith("."):
+            continue  # dotfiles, incl. orphaned ImgData atomic-write temps
         stem, ext = os.path.splitext(name)
         if ext.lower() not in IMAGE_EXTS:
             continue

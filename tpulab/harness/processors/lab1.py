@@ -28,11 +28,18 @@ class Lab1Processor(WorkloadProcessor):
         value_range: float = 1e100,
         rtol: float = 1e-9,
         op: str = "subtract",
+        dtype: str = "float64",
         **_ignored,
     ):
         super().__init__(seed=seed)
         self.size_min = size_min
         self.size_max = size_max
+        self.dtype = dtype
+        # the reference's [-1e100, 1e100] range (lab1_processor.py:30-36)
+        # overflows narrow compute dtypes to inf; keep synthesis inside
+        # the representable range so a-b stays finite
+        if dtype != "float64" and value_range > 1e30:
+            value_range = 1e30
         self.value_range = value_range
         self.rtol = rtol
         self.op = op
@@ -58,9 +65,27 @@ class Lab1Processor(WorkloadProcessor):
         sent = protocol.parse_lab1(text)  # the oracle sees what the target sees
         return PreparedRun(
             stdin_text=text,
-            verify_ctx=self._np_op(sent.a, sent.b),
+            verify_ctx=self._oracle(sent.a, sent.b),
             metadata={"n": n},
         )
+
+    def _oracle(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        """Mirror the workload's compute dtype exactly (labs/lab1.py:52-55):
+        inputs are rounded to the compute dtype before the op, and for
+        bfloat16 the f32 op result is rounded back to bf16 — the f32 op on
+        bf16-rounded inputs is exact, so rounding after equals computing
+        in bf16."""
+        if self.dtype == "float64":
+            return self._np_op(a, b)
+        a32, b32 = a.astype(np.float32), b.astype(np.float32)
+        if self.dtype == "bfloat16":
+            import ml_dtypes
+
+            a32 = a32.astype(ml_dtypes.bfloat16).astype(np.float32)
+            b32 = b32.astype(ml_dtypes.bfloat16).astype(np.float32)
+            out = self._np_op(a32, b32).astype(ml_dtypes.bfloat16)
+            return out.astype(np.float64)
+        return self._np_op(a32, b32).astype(np.float64)
 
     async def load_result(self, stdout_payload: str, prepared: PreparedRun) -> Any:
         return np.array([float(t) for t in stdout_payload.split()], np.float64)

@@ -77,18 +77,22 @@ class Lab5Processor(WorkloadProcessor):
                 self.task
             ]
             if values.dtype == np.float32:
-                wide = values
+                expect = oracle(values)
             else:
                 # Match the device accumulator dtype (ops.reduction._reduce
-                # widens integers to int64 only under x64); NumPy int
-                # reductions wrap with the same C semantics, so the oracle
-                # stays bit-identical either way.
+                # widens integers to int64 only under x64; with x64 off it
+                # accumulates — and wraps — in int32).  NumPy promotes int32
+                # reductions to platform int64, so the wrap must be forced
+                # with an explicit accumulator dtype.
                 import jax
 
-                wide = values.astype(
-                    np.int64 if jax.config.jax_enable_x64 else np.int32
-                )
-            ctx = {"out_path": None, "expect": oracle(wide)}
+                if jax.config.jax_enable_x64:
+                    expect = oracle(values.astype(np.int64))
+                elif self.task in ("sum", "prod"):
+                    expect = oracle(values.astype(np.int32), dtype=np.int32)
+                else:  # min/max cannot overflow
+                    expect = oracle(values.astype(np.int32))
+            ctx = {"out_path": None, "expect": expect}
         return PreparedRun(stdin_text=text, verify_ctx=ctx, metadata={"n": n})
 
     async def load_result(self, stdout_payload: str, prepared: PreparedRun) -> Any:

@@ -87,8 +87,12 @@ def format_lab1_input(a: Sequence[float], b: Sequence[float], launch=None) -> st
 
 
 def format_vector_10e(values: np.ndarray) -> str:
-    """lab1 stdout payload: ``%.10e `` per element (trailing space, no newline)."""
-    return "".join(f"{v:.10e} " for v in np.asarray(values).ravel())
+    """lab1 stdout payload: ``%.10e `` per element (trailing space, no newline).
+
+    Widened to f64 for formatting: ml_dtypes scalars (bfloat16) don't
+    implement the ``e`` format code, and the widening is value-exact.
+    """
+    return "".join(f"{v:.10e} " for v in np.asarray(values, dtype=np.float64).ravel())
 
 
 # ----------------------------------------------------------------------------- lab2

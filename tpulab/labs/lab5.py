@@ -55,12 +55,17 @@ def run(
     device = default_device() if backend in (None, "auto") else jax.devices(backend)[0]
     label = "TPU" if device.platform == "tpu" else "CPU"
 
+    # the distributed tier builds its mesh from the *requested* backend's
+    # devices (a backend='cpu' A/B reference must not land on the TPU mesh)
+    mesh_backend = None if backend in (None, "auto") else backend
+    n_avail = len(jax.devices(mesh_backend)) if mesh_backend else jax.device_count()
+
     if task == "sort":
         output_path = r.read_str()
-        if mesh and jax.device_count() >= mesh > 1:
+        if mesh and n_avail >= mesh > 1:
             from tpulab.parallel.dsort import distributed_sort
 
-            fn = lambda v: distributed_sort(v, num_devices=mesh)
+            fn = lambda v: distributed_sort(v, num_devices=mesh, backend=mesh_backend)
         else:
             fn = lambda v: sort_op(v, backend=backend)
         x = jax.device_put(jnp.asarray(values), device)
@@ -68,10 +73,10 @@ def run(
         save_typed_array(output_path, np.asarray(jax.device_get(out), dtype=values.dtype))
         return format_timing_line(label, ms) + "\n"
 
-    if mesh and jax.device_count() >= mesh > 1:
+    if mesh and n_avail >= mesh > 1:
         from tpulab.parallel.collectives import distributed_reduce
 
-        fn = lambda v: distributed_reduce(v, op=task, num_devices=mesh)
+        fn = lambda v: distributed_reduce(v, op=task, num_devices=mesh, backend=mesh_backend)
     else:
         fn = lambda v: reduce_op(v, op=task, backend=backend)
     x = jax.device_put(jnp.asarray(values), device)

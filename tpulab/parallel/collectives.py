@@ -1,0 +1,157 @@
+"""Collective ops over a device mesh (the absent-MPI layer, built right).
+
+The reference's lab5 fixtures (``lab5/data/{int10,float10,uchar10}``) are
+inputs for a multi-device reduction whose source was never committed
+(SURVEY.md section 0, 2.3).  Here the reduction is what an MPI_Allreduce
+would have been, expressed the TPU way: shard the array over a 1-D mesh
+axis, reduce locally on each device (VPU), then a single ``lax.psum``
+over ICI.  All entry points also accept a 1-device mesh, so the same
+code path serves single-chip runs.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpulab.parallel.mesh import make_mesh
+
+_LOCAL_REDUCERS = {
+    "sum": jnp.sum,
+    "min": jnp.min,
+    "max": jnp.max,
+    "prod": jnp.prod,
+}
+_PSUM_COMBINE = {
+    "sum": lambda x, ax: jax.lax.psum(x, ax),
+    "min": lambda x, ax: jax.lax.pmin(x, ax),
+    "max": lambda x, ax: jax.lax.pmax(x, ax),
+    # no lax.pprod: gather the per-device partials and multiply; the pmax
+    # is a semantic no-op (every device holds the same product) that marks
+    # the value replicated for shard_map's out_specs=P() check
+    "prod": lambda x, ax: jax.lax.pmax(jnp.prod(jax.lax.all_gather(x, ax)), ax),
+}
+
+
+def _pad_to_multiple(x: jax.Array, m: int, fill) -> jax.Array:
+    n = x.shape[0]
+    pad = (-n) % m
+    if pad == 0:
+        return x
+    return jnp.concatenate([x, jnp.full((pad,), fill, x.dtype)])
+
+
+_IDENTITY = {"sum": 0, "prod": 1, "min": None, "max": None}  # None -> edge value
+
+
+def _identity_fill(op: str, dtype):
+    if _IDENTITY[op] is not None:
+        return np.asarray(_IDENTITY[op], dtype)
+    info = jnp.finfo(dtype) if jnp.issubdtype(dtype, jnp.floating) else jnp.iinfo(dtype)
+    return np.asarray(info.max if op == "min" else info.min, dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("op", "mesh", "axis"))
+def _dist_reduce(x: jax.Array, *, op: str, mesh: Mesh, axis: str) -> jax.Array:
+    local = _LOCAL_REDUCERS[op]
+    combine = _PSUM_COMBINE[op]
+
+    def body(shard):
+        return combine(local(shard), axis)
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=P(axis), out_specs=P())
+    return fn(x)
+
+
+def distributed_reduce(
+    values,
+    op: str = "sum",
+    *,
+    mesh: Optional[Mesh] = None,
+    axis: str = "x",
+    num_devices: Optional[int] = None,
+    backend: Optional[str] = None,
+) -> jax.Array:
+    """All-reduce a 1-D array sharded over ``mesh[axis]``.
+
+    ``num_devices`` / ``backend`` shape the auto-built mesh (first N
+    devices of that backend; both ignored when ``mesh`` is given).
+    Narrow integer inputs are widened (int64 under x64, else int32)
+    before reduction, matching :func:`tpulab.ops.reduction.reduce_op`, so
+    single-device and distributed results agree bit-for-bit.
+    """
+    if op not in _LOCAL_REDUCERS:
+        raise ValueError(f"unknown reduction {op!r}; have {sorted(_LOCAL_REDUCERS)}")
+    mesh = mesh or make_mesh(n_devices=num_devices, axes=(axis,), backend=backend)
+    x = jnp.asarray(values)
+    if x.dtype in (jnp.uint8, jnp.int8, jnp.int16, jnp.int32):
+        x = x.astype(jnp.int64 if jax.config.jax_enable_x64 else jnp.int32)
+    nshards = mesh.shape[axis]
+    x = _pad_to_multiple(x, nshards, _identity_fill(op, x.dtype))
+    x = jax.device_put(x, NamedSharding(mesh, P(axis)))
+    return _dist_reduce(x, op=op, mesh=mesh, axis=axis)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "axis"))
+def _dist_mean(x: jax.Array, n_true: jax.Array, *, mesh: Mesh, axis: str) -> jax.Array:
+    def body(shard, n):
+        return jax.lax.psum(jnp.sum(shard), axis) / n
+
+    return jax.shard_map(body, mesh=mesh, in_specs=(P(axis), P()), out_specs=P())(x, n_true)
+
+
+def distributed_mean(
+    values,
+    *,
+    mesh: Optional[Mesh] = None,
+    axis: str = "x",
+    num_devices: Optional[int] = None,
+) -> jax.Array:
+    """Mean via psum of padded-with-zero shards divided by the true count."""
+    mesh = mesh or make_mesh(n_devices=num_devices, axes=(axis,))
+    x = jnp.asarray(values)
+    if not jnp.issubdtype(x.dtype, jnp.floating):
+        x = x.astype(jnp.float64 if jax.config.jax_enable_x64 else jnp.float32)
+    n_true = jnp.asarray(x.shape[0], x.dtype)
+    x = _pad_to_multiple(x, mesh.shape[axis], np.asarray(0, x.dtype))
+    x = jax.device_put(x, NamedSharding(mesh, P(axis)))
+    return _dist_mean(x, n_true, mesh=mesh, axis=axis)
+
+
+def all_gather_op(values, *, mesh: Optional[Mesh] = None, axis: str = "x") -> jax.Array:
+    """Gather a sharded 1-D array to every device (replicated output)."""
+    mesh = mesh or make_mesh(axes=(axis,))
+    x = jnp.asarray(values)
+    if x.shape[0] % mesh.shape[axis]:
+        raise ValueError(f"length {x.shape[0]} not divisible by mesh axis {mesh.shape[axis]}")
+    x = jax.device_put(x, NamedSharding(mesh, P(axis)))
+
+    def body(shard):
+        return jax.lax.all_gather(shard, axis, tiled=True)
+
+    # check_vma=False: the VMA tracker conservatively types all_gather
+    # output as axis-varying even though every device holds the same
+    # gathered array; the output really is replicated.
+    sm = jax.shard_map(body, mesh=mesh, in_specs=P(axis), out_specs=P(), check_vma=False)
+    return jax.jit(sm)(x)
+
+
+def reduce_scatter_op(matrix, *, mesh: Optional[Mesh] = None, axis: str = "x") -> jax.Array:
+    """Row-wise psum_scatter: input (k, n) sharded over rows; output is the
+    column-sum scattered so each device owns n/k of the result."""
+    mesh = mesh or make_mesh(axes=(axis,))
+    x = jnp.asarray(matrix)
+    k = mesh.shape[axis]
+    if x.shape[0] != k or x.shape[1] % k:
+        raise ValueError(f"expected ({k}, m*{k}) matrix, got {x.shape}")
+    x = jax.device_put(x, NamedSharding(mesh, P(axis, None)))
+
+    def body(shard):  # shard: (1, n)
+        return jax.lax.psum_scatter(shard[0], axis, scatter_dimension=0, tiled=True)
+
+    return jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P(axis, None), out_specs=P(axis)))(x)

@@ -1,0 +1,89 @@
+"""Halo-exchange stencil over a device mesh (lab2 Roberts at scale).
+
+This is the "MPI domain-decomposed stencil" configuration from the
+reference's intended trajectory (BASELINE.json configs; no MPI source
+exists to copy — SURVEY.md section 0), built the TPU way: the image is
+row-sharded over a 1-D mesh axis, each device computes luminance locally,
+and the one-row halo the Roberts cross needs (``y[r+1, *]``) moves
+between neighbors with a single ``lax.ppermute`` over ICI — the idiomatic
+halo exchange.  The bottom device falls back to its own last row,
+reproducing the reference's clamp addressing at the global border
+(reference ``lab2/src/main.c:14-21``).
+
+Output is bit-identical to the single-device path
+(:func:`tpulab.ops.roberts.roberts_edges`): same f32 luminance, same
+truncation-after-clamp, alpha preserved.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpulab.ops.roberts import luminance_f32, magnitude_to_u8
+from tpulab.parallel.mesh import make_mesh
+
+
+def _local_roberts(img_u8: jax.Array, halo_row_y: jax.Array) -> jax.Array:
+    """Roberts edges for a row-shard given the luminance of the first row
+    of the shard *below* (``halo_row_y``, shape (w,))."""
+    y = luminance_f32(img_u8)                       # (h, w) f32
+    ypad = jnp.concatenate([y, halo_row_y[None, :]], axis=0)  # (h+1, w)
+    # column clamp (x+1 at the right border replicates the edge column)
+    ypadc = jnp.pad(ypad, ((0, 0), (0, 1)), mode="edge")      # (h+1, w+1)
+    h, w = y.shape
+    y00 = ypadc[:h, :w]
+    y10 = ypadc[:h, 1 : w + 1]
+    y01 = ypadc[1 : h + 1, :w]
+    y11 = ypadc[1 : h + 1, 1 : w + 1]
+    g = jnp.sqrt((y11 - y00) ** 2 + (y10 - y01) ** 2)
+    g8 = magnitude_to_u8(g)
+    return jnp.stack([g8, g8, g8, img_u8[..., 3]], axis=-1)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "axis"))
+def _halo_roberts(img: jax.Array, *, mesh: Mesh, axis: str) -> jax.Array:
+    p = mesh.shape[axis]
+
+    def body(shard):  # (h/p, w, 4) uint8
+        y = luminance_f32(shard)
+        # send my first luminance row to the device above me
+        halo = jax.lax.ppermute(y[0], axis, perm=[(i, i - 1) for i in range(1, p)])
+        # bottom device got nothing (zeros): clamp to its own last row
+        idx = jax.lax.axis_index(axis)
+        halo = jnp.where(idx == p - 1, y[-1], halo)
+        return _local_roberts(shard, halo)
+
+    spec = P(axis, None, None)
+    return jax.shard_map(body, mesh=mesh, in_specs=spec, out_specs=spec)(img)
+
+
+def roberts_sharded(
+    pixels_u8,
+    *,
+    mesh: Optional[Mesh] = None,
+    axis: str = "x",
+) -> np.ndarray:
+    """Distributed Roberts cross over a row-sharded RGBA image.
+
+    Rows are edge-padded up to a multiple of the mesh axis size (the pad
+    rows see clamp semantics and are sliced away), so any image height
+    works on any mesh.
+    """
+    mesh = mesh or make_mesh(axes=(axis,))
+    img = jnp.asarray(pixels_u8, jnp.uint8)
+    if img.ndim != 3 or img.shape[-1] != 4:
+        raise ValueError(f"expected (h, w, 4) RGBA, got {img.shape}")
+    h = img.shape[0]
+    p = mesh.shape[axis]
+    pad = (-h) % p
+    if pad:
+        img = jnp.concatenate([img, jnp.repeat(img[-1:], pad, axis=0)], axis=0)
+    img = jax.device_put(img, NamedSharding(mesh, P(axis, None, None)))
+    out = _halo_roberts(img, mesh=mesh, axis=axis)
+    return np.asarray(out)[:h]

@@ -1,0 +1,104 @@
+"""Device-mesh bring-up.
+
+TPU-native replacement for the communicator-bootstrap an MPI backend
+would provide (the reference has none to copy — SURVEY.md section 0):
+a :class:`jax.sharding.Mesh` over the available devices, with axis
+sizes factored automatically so the same code runs on a v4-8 slice, a
+pod, or the 8-virtual-device CPU mesh the tests use.
+
+Axis conventions used across the framework:
+
+==========  ====================================================
+``dp``      data parallelism (batch dimension)
+``sp``      sequence/context parallelism (ring attention axis)
+``tp``      tensor parallelism (matmul column/row sharding)
+``pp``      pipeline parallelism (layer stages)
+``x``       generic 1-D axis for the lab workloads (reduction,
+            halo stencil, distributed sort)
+==========  ====================================================
+
+Expert parallelism (``ep``) reuses the ``(dp, sp)`` submesh —
+DeepSpeed-MoE style — so experts shard over the data axes without
+spending a dedicated mesh dimension (see tpulab.models.labformer).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def mesh_devices(n: Optional[int] = None, *, backend: Optional[str] = None):
+    """The first ``n`` devices of ``backend`` (all, if ``n`` is None)."""
+    devs = jax.devices(backend) if backend else jax.devices()
+    if n is None:
+        return devs
+    if len(devs) < n:
+        raise ValueError(f"need {n} devices, have {len(devs)} ({devs[0].platform})")
+    return devs[:n]
+
+
+def _prime_factors(n: int) -> list:
+    out, d = [], 2
+    while d * d <= n:
+        while n % d == 0:
+            out.append(d)
+            n //= d
+        d += 1
+    if n > 1:
+        out.append(n)
+    return out
+
+
+def best_factorization(n: int, axes: Sequence[str]) -> Dict[str, int]:
+    """Factor ``n`` devices over ``axes`` as evenly as possible.
+
+    Later axes are filled first (they are the innermost / most
+    bandwidth-hungry by convention: ``('dp','sp','tp')`` gives ``tp``
+    the largest factor), so collectives that matter most ride the
+    densest ICI links.  Every axis gets size >= 1; sizes multiply to n.
+    """
+    sizes = {a: 1 for a in axes}
+    order = list(axes)[::-1]  # innermost first
+    for p in sorted(_prime_factors(n), reverse=True):
+        tgt = min(order, key=lambda a: sizes[a])
+        sizes[tgt] *= p
+    assert math.prod(sizes.values()) == n
+    return sizes
+
+
+def make_mesh(
+    axis_sizes: Optional[Dict[str, int]] = None,
+    *,
+    n_devices: Optional[int] = None,
+    axes: Tuple[str, ...] = ("x",),
+    backend: Optional[str] = None,
+) -> Mesh:
+    """Build a Mesh either from explicit ``{axis: size}`` or by factoring
+    ``n_devices`` (default: all available) over ``axes``.
+
+    >>> make_mesh({"dp": 2, "tp": 4})          # explicit
+    >>> make_mesh(n_devices=8, axes=("x",))    # 8-way 1D mesh
+    """
+    if axis_sizes:
+        names = tuple(axis_sizes)
+        shape = tuple(axis_sizes[a] for a in names)
+        n = math.prod(shape)
+        devs = mesh_devices(n, backend=backend)
+    else:
+        devs = mesh_devices(n_devices, backend=backend)
+        sizes = best_factorization(len(devs), axes)
+        names = tuple(axes)
+        shape = tuple(sizes[a] for a in names)
+    arr = np.asarray(devs).reshape(shape)
+    return Mesh(arr, names)
+
+
+def cpu_test_mesh(axis_sizes: Dict[str, int]) -> Mesh:
+    """Mesh over virtual CPU devices (test tier; requires
+    ``--xla_force_host_platform_device_count``)."""
+    return make_mesh(axis_sizes, backend="cpu")

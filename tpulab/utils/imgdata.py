@@ -73,15 +73,23 @@ class ImgData(Image4):
             self._materialize_siblings()
 
     def _materialize_siblings(self) -> None:
-        # Only fill in *missing* siblings, and write each atomically
-        # (temp + rename): concurrent harness runs read these files while
-        # another run's pre_process may be materializing them.
+        # Fill in missing-or-stale siblings (stale = older than the source,
+        # so editing a fixture refreshes its converted caches), and write
+        # each atomically (temp + rename): concurrent harness runs read
+        # these files while another run's pre_process may be materializing.
+        try:
+            src_mtime = os.path.getmtime(self.path)
+        except OSError:
+            src_mtime = 0.0
         for ext in (".data", ".txt", ".png"):
             if ext == self.ext.lower():
                 continue
             sib = os.path.join(self.dir2save, self.data_name + ext)
-            if os.path.exists(sib):
-                continue
+            try:
+                if os.path.getmtime(sib) >= src_mtime:
+                    continue
+            except OSError:
+                pass  # missing sibling: materialize it
             tmp = os.path.join(
                 self.dir2save, f".{self.data_name}.tmp{os.getpid()}{ext}"
             )

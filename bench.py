@@ -1,0 +1,50 @@
+#!/usr/bin/env python
+"""Driver benchmark entry: prints ONE JSON line for the headline metric.
+
+Headline = lab2 Roberts-cross edge detector at 1024x1024 (the BASELINE.json
+target class), steady-state median kernel ms, compared against the
+reference's best CUDA config median of 0.17866 ms on an RTX A6000
+(reference lab2/KoryakovDA_LR2.pdf chart 3; BASELINE.md).
+``vs_baseline`` > 1 means the TPU path is faster than the CUDA baseline.
+
+Usage: ``python bench.py [--all] [--only SUBSTR] [--reps N]``
+(``--all`` prints every registered benchmark as extra JSON lines AFTER the
+headline line; the driver only reads line one.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--all", action="store_true", help="print every benchmark")
+    ap.add_argument("--only", default=None, help="substring filter (with --all)")
+    ap.add_argument("--reps", type=int, default=30)
+    args = ap.parse_args(argv)
+
+    from tpulab.bench_image import bench_lab2
+
+    row = bench_lab2(size=1024, reps=args.reps)
+    headline = {
+        "metric": row["metric"],
+        "value": row["value"],
+        "unit": row["unit"],
+        "vs_baseline": row["vs_baseline"],
+    }
+    print(json.dumps(headline), flush=True)
+
+    if args.all:
+        from tpulab.bench import run_benchmarks
+
+        for extra in run_benchmarks(only=args.only, reps=args.reps):
+            if extra["metric"] != row["metric"]:
+                print(json.dumps(extra), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

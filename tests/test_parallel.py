@@ -129,6 +129,22 @@ class TestDistributedSort:
         np.testing.assert_array_equal(distributed_sort(vals, mesh=mesh4), vals)
         np.testing.assert_array_equal(distributed_sort(vals[::-1], mesh=mesh4), vals)
 
+    def test_inf_and_negatives(self, mesh8):
+        # +inf must survive: a naive finfo.max padding sentinel sorts
+        # below +inf and the count-based trim would drop the real infs
+        vals = np.array([1.0, np.inf, -3.0, 2.0, -np.inf, 0.0] * 5, np.float32)
+        np.testing.assert_array_equal(distributed_sort(vals, mesh=mesh8), np.sort(vals))
+
+    def test_nan_sorts_last(self, mesh8, rng):
+        vals = rng.normal(size=37).astype(np.float32)
+        vals[[3, 17, 30]] = np.nan
+        got = distributed_sort(vals, mesh=mesh8)
+        np.testing.assert_array_equal(got, np.sort(vals))  # NaNs last, like np.sort
+
+    def test_finfo_max_values_survive(self, mesh8):
+        vals = np.array([np.finfo(np.float32).max, 0.0, -1.0] * 4, np.float32)
+        np.testing.assert_array_equal(distributed_sort(vals, mesh=mesh8), np.sort(vals))
+
 
 class TestShardedClassify:
     def test_matches_single_device(self, mesh8, rng):

@@ -53,6 +53,7 @@ class Lab1Processor(WorkloadProcessor):
         return {
             "seed": self.seed,
             "op": self.op,
+            "dtype": self.dtype,
             "value_range": self.value_range,
         }
 

@@ -60,26 +60,43 @@ def run(
     mesh_backend = None if backend in (None, "auto") else backend
     n_avail = len(jax.devices(mesh_backend)) if mesh_backend else jax.device_count()
 
+    # staging (mesh build, widening, padding, H2D shard placement) happens
+    # once, outside the timed fn — the timing contract measures the
+    # collective compute only, mirroring kernel-only CUDA events
+    # (tpulab/runtime/timing.py; SURVEY.md section 5.1)
     if task == "sort":
         output_path = r.read_str()
         if mesh and n_avail >= mesh > 1:
-            from tpulab.parallel.dsort import distributed_sort
+            from tpulab.parallel.dsort import finish_sort, sample_sort_staged, stage_sort
+            from tpulab.parallel.mesh import make_mesh
 
-            fn = lambda v: distributed_sort(v, num_devices=mesh, backend=mesh_backend)
+            m = make_mesh(n_devices=mesh, axes=("x",), backend=mesh_backend)
+            staged, meta = stage_sort(values, mesh=m)
+            ms, (rows, counts) = measure_ms(
+                lambda v: sample_sort_staged(v, mesh=m, axis="x"),
+                (staged,),
+                warmup=warmup,
+                reps=reps,
+            )
+            out = finish_sort(rows, counts, meta)
         else:
-            fn = lambda v: sort_op(v, backend=backend)
-        x = jax.device_put(jnp.asarray(values), device)
-        ms, out = measure_ms(fn, (x,), warmup=warmup, reps=reps)
+            x = jax.device_put(jnp.asarray(values), device)
+            ms, out = measure_ms(
+                lambda v: sort_op(v, backend=backend), (x,), warmup=warmup, reps=reps
+            )
         save_typed_array(output_path, np.asarray(jax.device_get(out), dtype=values.dtype))
         return format_timing_line(label, ms) + "\n"
 
     if mesh and n_avail >= mesh > 1:
-        from tpulab.parallel.collectives import distributed_reduce
+        from tpulab.parallel.collectives import reduce_staged, stage_reduce
+        from tpulab.parallel.mesh import make_mesh
 
-        fn = lambda v: distributed_reduce(v, op=task, num_devices=mesh, backend=mesh_backend)
+        m = make_mesh(n_devices=mesh, axes=("x",), backend=mesh_backend)
+        x = stage_reduce(values, task, mesh=m)
+        fn = lambda v: reduce_staged(v, op=task, mesh=m, axis="x")
     else:
+        x = jax.device_put(jnp.asarray(values), device)
         fn = lambda v: reduce_op(v, op=task, backend=backend)
-    x = jax.device_put(jnp.asarray(values), device)
     ms, out = measure_ms(fn, (x,), warmup=warmup, reps=reps)
     result = np.asarray(jax.device_get(out))
     return format_timing_line(label, ms) + "\n" + _format_scalar(result) + "\n"

@@ -57,7 +57,9 @@ def _identity_fill(op: str, dtype):
 
 
 @functools.partial(jax.jit, static_argnames=("op", "mesh", "axis"))
-def _dist_reduce(x: jax.Array, *, op: str, mesh: Mesh, axis: str) -> jax.Array:
+def reduce_staged(x: jax.Array, *, op: str, mesh: Mesh, axis: str) -> jax.Array:
+    """All-reduce of an already-staged (widened/padded/sharded) array —
+    the timeable collective compute; stage with :func:`stage_reduce`."""
     local = _LOCAL_REDUCERS[op]
     combine = _PSUM_COMBINE[op]
 
@@ -66,6 +68,18 @@ def _dist_reduce(x: jax.Array, *, op: str, mesh: Mesh, axis: str) -> jax.Array:
 
     fn = jax.shard_map(body, mesh=mesh, in_specs=P(axis), out_specs=P())
     return fn(x)
+
+
+_dist_reduce = reduce_staged
+
+
+def stage_reduce(values, op: str = "sum", *, mesh: Mesh, axis: str = "x") -> jax.Array:
+    """Widen/pad/shard ``values`` for :func:`reduce_staged`."""
+    x = jnp.asarray(values)
+    if x.dtype in (jnp.uint8, jnp.int8, jnp.int16, jnp.int32):
+        x = x.astype(jnp.int64 if jax.config.jax_enable_x64 else jnp.int32)
+    x = _pad_to_multiple(x, mesh.shape[axis], _identity_fill(op, x.dtype))
+    return jax.device_put(x, NamedSharding(mesh, P(axis)))
 
 
 def distributed_reduce(
@@ -88,13 +102,8 @@ def distributed_reduce(
     if op not in _LOCAL_REDUCERS:
         raise ValueError(f"unknown reduction {op!r}; have {sorted(_LOCAL_REDUCERS)}")
     mesh = mesh or make_mesh(n_devices=num_devices, axes=(axis,), backend=backend)
-    x = jnp.asarray(values)
-    if x.dtype in (jnp.uint8, jnp.int8, jnp.int16, jnp.int32):
-        x = x.astype(jnp.int64 if jax.config.jax_enable_x64 else jnp.int32)
-    nshards = mesh.shape[axis]
-    x = _pad_to_multiple(x, nshards, _identity_fill(op, x.dtype))
-    x = jax.device_put(x, NamedSharding(mesh, P(axis)))
-    return _dist_reduce(x, op=op, mesh=mesh, axis=axis)
+    x = stage_reduce(values, op, mesh=mesh, axis=axis)
+    return reduce_staged(x, op=op, mesh=mesh, axis=axis)
 
 
 @functools.partial(jax.jit, static_argnames=("mesh", "axis"))
@@ -123,6 +132,19 @@ def distributed_mean(
     return _dist_mean(x, n_true, mesh=mesh, axis=axis)
 
 
+@functools.partial(jax.jit, static_argnames=("mesh", "axis"))
+def _all_gather(x: jax.Array, *, mesh: Mesh, axis: str) -> jax.Array:
+    def body(shard):
+        return jax.lax.all_gather(shard, axis, tiled=True)
+
+    # check_vma=False: the VMA tracker conservatively types all_gather
+    # output as axis-varying even though every device holds the same
+    # gathered array; the output really is replicated.
+    return jax.shard_map(
+        body, mesh=mesh, in_specs=P(axis), out_specs=P(), check_vma=False
+    )(x)
+
+
 def all_gather_op(values, *, mesh: Optional[Mesh] = None, axis: str = "x") -> jax.Array:
     """Gather a sharded 1-D array to every device (replicated output)."""
     mesh = mesh or make_mesh(axes=(axis,))
@@ -130,15 +152,15 @@ def all_gather_op(values, *, mesh: Optional[Mesh] = None, axis: str = "x") -> ja
     if x.shape[0] % mesh.shape[axis]:
         raise ValueError(f"length {x.shape[0]} not divisible by mesh axis {mesh.shape[axis]}")
     x = jax.device_put(x, NamedSharding(mesh, P(axis)))
+    return _all_gather(x, mesh=mesh, axis=axis)
 
-    def body(shard):
-        return jax.lax.all_gather(shard, axis, tiled=True)
 
-    # check_vma=False: the VMA tracker conservatively types all_gather
-    # output as axis-varying even though every device holds the same
-    # gathered array; the output really is replicated.
-    sm = jax.shard_map(body, mesh=mesh, in_specs=P(axis), out_specs=P(), check_vma=False)
-    return jax.jit(sm)(x)
+@functools.partial(jax.jit, static_argnames=("mesh", "axis"))
+def _reduce_scatter(x: jax.Array, *, mesh: Mesh, axis: str) -> jax.Array:
+    def body(shard):  # shard: (1, n)
+        return jax.lax.psum_scatter(shard[0], axis, scatter_dimension=0, tiled=True)
+
+    return jax.shard_map(body, mesh=mesh, in_specs=P(axis, None), out_specs=P(axis))(x)
 
 
 def reduce_scatter_op(matrix, *, mesh: Optional[Mesh] = None, axis: str = "x") -> jax.Array:
@@ -150,8 +172,4 @@ def reduce_scatter_op(matrix, *, mesh: Optional[Mesh] = None, axis: str = "x") -
     if x.shape[0] != k or x.shape[1] % k:
         raise ValueError(f"expected ({k}, m*{k}) matrix, got {x.shape}")
     x = jax.device_put(x, NamedSharding(mesh, P(axis, None)))
-
-    def body(shard):  # shard: (1, n)
-        return jax.lax.psum_scatter(shard[0], axis, scatter_dimension=0, tiled=True)
-
-    return jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P(axis, None), out_specs=P(axis)))(x)
+    return _reduce_scatter(x, mesh=mesh, axis=axis)

@@ -14,15 +14,19 @@ XLA collectives:
 4. each device sorts its received bucket; concatenating buckets in
    device order is the sorted array.
 
-Buckets are padded to the shard size with the dtype's maximum value so
-shapes stay static under jit; true element counts travel through the
-same all_to_all, and the host-side concatenation drops the padding.
+Buckets are padded to the shard size with a sentinel so shapes stay
+static under jit; true element counts travel through the same
+all_to_all, and the host-side concatenation drops the padding.  Floats
+are sorted as their IEEE-754 total-order unsigned-integer keys, so the
+unsigned-max sentinel strictly dominates every real value **including
++inf and NaN** (NaNs are canonicalized to the positive quiet NaN first,
+matching ``np.sort``'s NaNs-last order).
 """
 
 from __future__ import annotations
 
 import functools
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -30,6 +34,28 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpulab.parallel.mesh import make_mesh
+
+_KEY_DTYPE = {jnp.dtype(jnp.float32): jnp.uint32, jnp.dtype(jnp.float64): jnp.uint64}
+
+
+def _encode_keys(x: jax.Array) -> jax.Array:
+    """Monotone bijection float -> unsigned int (IEEE total order)."""
+    udtype = _KEY_DTYPE[x.dtype]
+    nbits = jnp.iinfo(udtype).bits
+    x = jnp.where(jnp.isnan(x), jnp.asarray(jnp.nan, x.dtype), x)
+    u = jax.lax.bitcast_convert_type(x, udtype)
+    topbit = np.asarray(1, udtype) << (nbits - 1)
+    allones = np.asarray(~np.asarray(0, udtype), udtype)
+    return u ^ jnp.where(u >> (nbits - 1) == 1, allones, topbit)
+
+
+def _decode_keys(k: jax.Array, fdtype) -> jax.Array:
+    udtype = _KEY_DTYPE[jnp.dtype(fdtype)]
+    nbits = jnp.iinfo(udtype).bits
+    topbit = np.asarray(1, udtype) << (nbits - 1)
+    allones = np.asarray(~np.asarray(0, udtype), udtype)
+    u = k ^ jnp.where(k >> (nbits - 1) == 1, topbit, allones)
+    return jax.lax.bitcast_convert_type(u, fdtype)
 
 
 def _sentinel(dtype) -> np.ndarray:
@@ -39,7 +65,12 @@ def _sentinel(dtype) -> np.ndarray:
 
 
 @functools.partial(jax.jit, static_argnames=("mesh", "axis"))
-def _sample_sort(x: jax.Array, *, mesh: Mesh, axis: str):
+def sample_sort_staged(x: jax.Array, *, mesh: Mesh, axis: str):
+    """The collective compute: sorted bucket rows + true counts per device.
+
+    ``x`` must already be staged by :func:`stage_sort` (key-encoded,
+    padded, sharded over ``mesh[axis]``).
+    """
     p = mesh.shape[axis]
     fill = _sentinel(x.dtype)
 
@@ -67,6 +98,37 @@ def _sample_sort(x: jax.Array, *, mesh: Mesh, axis: str):
     )(x)
 
 
+def stage_sort(values, *, mesh: Mesh, axis: str = "x") -> Tuple[jax.Array, dict]:
+    """Encode/pad/shard ``values`` for :func:`sample_sort_staged`.
+
+    Returns ``(staged_array, meta)``; pass ``meta`` to
+    :func:`finish_sort`.  Separated from the compute so benchmarks can
+    time the collective alone (the reference times kernels, not H2D —
+    SURVEY.md section 5.1).
+    """
+    x = jnp.asarray(values)
+    if x.ndim != 1:
+        raise ValueError(f"expected 1-D array, got shape {x.shape}")
+    meta = {"n": x.shape[0], "dtype": x.dtype, "p": mesh.shape[axis]}
+    if x.dtype == jnp.uint8:
+        x = x.astype(jnp.int32)
+    elif jnp.issubdtype(x.dtype, jnp.floating):
+        x = _encode_keys(x)
+    pad = (-x.shape[0]) % mesh.shape[axis]
+    if pad:
+        x = jnp.concatenate([x, jnp.full((pad,), _sentinel(x.dtype), x.dtype)])
+    return jax.device_put(x, NamedSharding(mesh, P(axis))), meta
+
+
+def finish_sort(rows, counts, meta: dict) -> np.ndarray:
+    """Trim bucket padding, decode keys, restore the input dtype."""
+    rows, counts = np.asarray(rows), np.asarray(counts)
+    out = np.concatenate([rows[i, : counts[i]] for i in range(meta["p"])])[: meta["n"]]
+    if jnp.issubdtype(meta["dtype"], jnp.floating):
+        out = np.asarray(_decode_keys(jnp.asarray(out), meta["dtype"]))
+    return out.astype(meta["dtype"])
+
+
 def distributed_sort(
     values,
     *,
@@ -81,21 +143,6 @@ def distributed_sort(
     devices of that backend; both ignored when ``mesh`` is given).
     """
     mesh = mesh or make_mesh(n_devices=num_devices, axes=(axis,), backend=backend)
-    x = jnp.asarray(values)
-    if x.ndim != 1:
-        raise ValueError(f"expected 1-D array, got shape {x.shape}")
-    widened = x.dtype == jnp.uint8
-    if widened:
-        x = x.astype(jnp.int32)
-    n = x.shape[0]
-    p = mesh.shape[axis]
-    pad = (-n) % p
-    if pad:
-        x = jnp.concatenate([x, jnp.full((pad,), _sentinel(x.dtype), x.dtype)])
-    x = jax.device_put(x, NamedSharding(mesh, P(axis)))
-    rows, counts = _sample_sort(x, mesh=mesh, axis=axis)
-    rows, counts = np.asarray(rows), np.asarray(counts)
-    out = np.concatenate([rows[i, : counts[i]] for i in range(p)])[:n]
-    if widened:
-        out = out.astype(np.uint8)
-    return out
+    staged, meta = stage_sort(values, mesh=mesh, axis=axis)
+    rows, counts = sample_sort_staged(staged, mesh=mesh, axis=axis)
+    return finish_sort(rows, counts, meta)

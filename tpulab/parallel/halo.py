@@ -25,24 +25,21 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tpulab.ops.roberts import luminance_f32, magnitude_to_u8
+from tpulab.ops.roberts import gradient_magnitude, luminance_f32, magnitude_to_u8
 from tpulab.parallel.mesh import make_mesh
 
 
 def _local_roberts(img_u8: jax.Array, halo_row_y: jax.Array) -> jax.Array:
     """Roberts edges for a row-shard given the luminance of the first row
-    of the shard *below* (``halo_row_y``, shape (w,))."""
-    y = luminance_f32(img_u8)                       # (h, w) f32
+    of the shard *below* (``halo_row_y``, shape (w,)).
+
+    Reuses the single-device :func:`gradient_magnitude` on the
+    halo-extended luminance plane — its bottom-row clamp only affects the
+    appended halo row, which is sliced away, so the shard math is the
+    exact same code path as the single-device kernel."""
+    y = luminance_f32(img_u8)                                 # (h, w) f32
     ypad = jnp.concatenate([y, halo_row_y[None, :]], axis=0)  # (h+1, w)
-    # column clamp (x+1 at the right border replicates the edge column)
-    ypadc = jnp.pad(ypad, ((0, 0), (0, 1)), mode="edge")      # (h+1, w+1)
-    h, w = y.shape
-    y00 = ypadc[:h, :w]
-    y10 = ypadc[:h, 1 : w + 1]
-    y01 = ypadc[1 : h + 1, :w]
-    y11 = ypadc[1 : h + 1, 1 : w + 1]
-    g = jnp.sqrt((y11 - y00) ** 2 + (y10 - y01) ** 2)
-    g8 = magnitude_to_u8(g)
+    g8 = magnitude_to_u8(gradient_magnitude(ypad)[: y.shape[0]])
     return jnp.stack([g8, g8, g8, img_u8[..., 3]], axis=-1)
 
 

@@ -26,6 +26,7 @@ def bench_lab1(n: int = 1000, dtype: str = "float64", reps: int = 20) -> Dict[st
     import jax.numpy as jnp
 
     from tpulab.ops.elementwise import make_binary_fn, resolve_binary_device
+    from tpulab.runtime.device import commit
     from tpulab.runtime.timing import measure_ms
 
     rng = np.random.default_rng(0)
@@ -33,8 +34,8 @@ def bench_lab1(n: int = 1000, dtype: str = "float64", reps: int = 20) -> Dict[st
     b = rng.uniform(-1e3, 1e3, n)
     dt = {"float64": jnp.float64, "float32": jnp.float32, "bfloat16": jnp.bfloat16}[dtype]
     device = resolve_binary_device(dt)
-    aj = jax.device_put(jnp.asarray(a, dt), device)
-    bj = jax.device_put(jnp.asarray(b, dt), device)
+    aj = commit(a, device, dt)
+    bj = commit(b, device, dt)
     fn = make_binary_fn("subtract", dt, device=device)
     ms, _ = measure_ms(fn, (aj, bj), warmup=3, reps=reps)
     base = CUDA_BASELINES_MS.get("lab1_n1000") if n == 1000 and dtype == "float64" else None

@@ -14,7 +14,7 @@ import jax.numpy as jnp
 
 from tpulab.io import protocol
 from tpulab.ops.sortops import sort_ascending
-from tpulab.runtime.device import default_device
+from tpulab.runtime.device import commit, default_device
 from tpulab.runtime.timing import format_timing_line, measure_ms
 
 
@@ -32,7 +32,7 @@ def run(
     device = default_device() if backend in (None, "auto") else jax.devices(backend)[0]
     # commit to the requested device BEFORE timing; the timed callable is
     # the jitted sort itself (inputs stay wherever they were committed)
-    x = jax.device_put(jnp.asarray(values, jnp.float32), device)
+    x = commit(values, device, jnp.float32)
 
     if timing:
         ms, out = measure_ms(sort_ascending, (x,), warmup=warmup, reps=reps)

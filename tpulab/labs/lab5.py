@@ -25,7 +25,7 @@ from tpulab.io import load_typed_array, save_typed_array
 from tpulab.io.protocol import TokenReader
 from tpulab.ops.reduction import reduce_op
 from tpulab.ops.sortops import sort_op
-from tpulab.runtime.device import default_device
+from tpulab.runtime.device import commit, default_device
 from tpulab.runtime.timing import format_timing_line, measure_ms
 
 
@@ -80,7 +80,7 @@ def run(
             )
             out = finish_sort(rows, counts, meta)
         else:
-            x = jax.device_put(jnp.asarray(values), device)
+            x = commit(values, device)
             ms, out = measure_ms(
                 lambda v: sort_op(v, backend=backend), (x,), warmup=warmup, reps=reps
             )
@@ -95,7 +95,7 @@ def run(
         x = stage_reduce(values, task, mesh=m)
         fn = lambda v: reduce_staged(v, op=task, mesh=m, axis="x")
     else:
-        x = jax.device_put(jnp.asarray(values), device)
+        x = commit(values, device)
         fn = lambda v: reduce_op(v, op=task, backend=backend)
     ms, out = measure_ms(fn, (x,), warmup=warmup, reps=reps)
     result = np.asarray(jax.device_get(out))

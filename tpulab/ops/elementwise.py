@@ -90,17 +90,20 @@ def binary_op(
     use_pallas: Optional[bool] = None,
 ) -> jax.Array:
     """Elementwise ``name`` over two vectors with dtype-driven placement."""
-    # Stage host inputs through NumPy, not jnp.asarray: jnp materializes
-    # on the *default* device first, and a TPU default device silently
-    # stores f64 as f32 (1e100-range values become inf) before device_put
-    # can move them to the CPU backend.
+    # Stage through runtime.device.commit: host inputs go NumPy->device
+    # (jnp.asarray would materialize on the default TPU device, silently
+    # storing f64 as f32 — 1e100-range values become inf), and a
+    # device-resident array never crosses backends directly (a TPU->CPU
+    # device_put permanently poisons later TPU dispatches on the tunnel).
+    from tpulab.runtime.device import commit
+
     a = a if isinstance(a, jax.Array) else np.asarray(a)
     b = b if isinstance(b, jax.Array) else np.asarray(b)
     if a.dtype != b.dtype:
         raise ValueError(f"dtype mismatch: {a.dtype} vs {b.dtype}")
     device = resolve_binary_device(a.dtype, backend)
-    a = jax.device_put(a, device)
-    b = jax.device_put(b, device)
+    a = commit(a, device)
+    b = commit(b, device)
     fn = make_binary_fn(
         name, a.dtype, launch=launch, device=device, use_pallas=use_pallas, rank=a.ndim
     )

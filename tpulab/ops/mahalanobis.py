@@ -104,6 +104,51 @@ def classify_labels(
     return best.astype(jnp.uint8)
 
 
+@functools.partial(
+    jax.jit, static_argnames=("compute_dtype", "use_pallas", "tile_rows", "interpret")
+)
+def _classify_full(x, mu, ic, compute_dtype, use_pallas: bool, tile_rows: int, interpret: bool):
+    """Labels-into-alpha as ONE jitted program (single device dispatch)."""
+    if use_pallas:
+        from tpulab.ops.pallas.classify import _classify_pallas_jit
+
+        labels = _classify_pallas_jit(x, mu, ic, tile_rows, interpret)
+    else:
+        labels = classify_labels(x, mu, ic, compute_dtype=compute_dtype)
+    return jnp.concatenate([x[..., :3], labels[..., None]], axis=-1)
+
+
+def classify_staged(
+    pixels_u8,
+    stats: ClassStats,
+    *,
+    launch: Optional[Tuple[int, int]] = None,
+    backend: Optional[str] = None,
+    use_pallas: Optional[bool] = None,
+    compute_dtype=None,
+):
+    """(fn, staged_args): inputs committed to the device once, ``fn`` is
+    the single jitted dispatch — what benchmarks should time
+    (kernel-only contract, tpulab/runtime/timing.py)."""
+    from tpulab.ops.pallas.classify import pick_tile_rows
+    from tpulab.runtime.device import commit, default_device
+
+    device = default_device() if backend in (None, "auto") else jax.devices(backend)[0]
+    x = commit(pixels_u8, device, jnp.uint8)
+    if compute_dtype is None:
+        compute_dtype = jnp.float64 if device.platform == "cpu" else jnp.float32
+    mu = commit(stats.mean, device)
+    ic = commit(stats.inv_cov, device)
+    if use_pallas is None:
+        use_pallas = device.platform == "tpu"
+    tile_rows = pick_tile_rows(launch, *x.shape[:2])
+    interpret = device.platform != "tpu"
+    fn = lambda img, m, c: _classify_full(
+        img, m, c, compute_dtype, use_pallas, tile_rows, interpret
+    )
+    return fn, (x, mu, ic)
+
+
 def classify(
     pixels_u8,
     stats: ClassStats,
@@ -120,22 +165,12 @@ def classify(
     pixel values are small integers so the argmin is robust — validated
     against the f64 path in the test suite).
     """
-    from tpulab.runtime.device import default_device
-
-    device = default_device() if backend in (None, "auto") else jax.devices(backend)[0]
-    x = jax.device_put(jnp.asarray(pixels_u8, jnp.uint8), device)
-    if compute_dtype is None:
-        compute_dtype = jnp.float64 if device.platform == "cpu" else jnp.float32
-    mu = jax.device_put(jnp.asarray(stats.mean), device)
-    ic = jax.device_put(jnp.asarray(stats.inv_cov), device)
-    if use_pallas is None:
-        use_pallas = device.platform == "tpu"
-    if use_pallas:
-        from tpulab.ops.pallas.classify import classify_labels_pallas
-
-        labels = classify_labels_pallas(
-            x, mu, ic, launch=launch, interpret=device.platform != "tpu"
-        )
-    else:
-        labels = classify_labels(x, mu, ic, compute_dtype=compute_dtype)
-    return jnp.concatenate([x[..., :3], labels[..., None]], axis=-1)
+    fn, args = classify_staged(
+        pixels_u8,
+        stats,
+        launch=launch,
+        backend=backend,
+        use_pallas=use_pallas,
+        compute_dtype=compute_dtype,
+    )
+    return fn(*args)

@@ -49,7 +49,9 @@ def _classify_kernel(mu_ref, ic_ref, r_ref, g_ref, b_ref, out_ref, *, nc: int):
             t_i = d[0] * ic_ref[c, 0, i] + d[1] * ic_ref[c, 1, i] + d[2] * ic_ref[c, 2, i]
             dist = dist + t_i * d[i]
         upd = dist < min_dist  # strict <: first minimal class wins
-        best = jnp.where(upd, c, best)
+        # jnp.int32(c), not c: a Python int promotes to i64 under the
+        # framework's global x64, which Mosaic cannot lower
+        best = jnp.where(upd, jnp.int32(c), best)
         min_dist = jnp.where(upd, dist, min_dist)
     out_ref[:] = best
 
@@ -58,8 +60,16 @@ def _classify_kernel(mu_ref, ic_ref, r_ref, g_ref, b_ref, out_ref, *, nc: int):
 def _classify_planes(r2d, g2d, b2d, mu, ic, tile_rows: int, nc: int, interpret: bool):
     rows = r2d.shape[0]
     grid = (pl.cdiv(rows, tile_rows),)
-    plane = pl.BlockSpec((tile_rows, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM)
-    smem = lambda shape: pl.BlockSpec(shape, lambda i: tuple(0 for _ in shape), memory_space=pltpu.SMEM)
+    # jnp.int32(0) created INSIDE each index map (a captured constant is
+    # rejected by pallas): under the framework's global x64 a Python-int
+    # index-map constant lowers as i64, which Mosaic cannot legalize
+    plane = pl.BlockSpec(
+        (tile_rows, LANES), lambda i: (i, jnp.int32(0)), memory_space=pltpu.VMEM
+    )
+    smem = lambda shape: pl.BlockSpec(
+        shape, lambda i, _n=len(shape): tuple(jnp.int32(0) for _ in range(_n)),
+        memory_space=pltpu.SMEM,
+    )
     return pl.pallas_call(
         functools.partial(_classify_kernel, nc=nc),
         out_shape=jax.ShapeDtypeStruct(r2d.shape, jnp.int32),
@@ -70,22 +80,14 @@ def _classify_planes(r2d, g2d, b2d, mu, ic, tile_rows: int, nc: int, interpret: 
     )(mu, ic, r2d, g2d, b2d)
 
 
-def classify_labels_pallas(
-    pixels_u8: jax.Array,
-    mean: jax.Array,
-    inv_cov: jax.Array,
-    *,
-    launch: Optional[Tuple[int, int]] = None,
-    interpret: bool = False,
-) -> jax.Array:
-    """(h, w, 4) u8 image -> (h, w) u8 labels, f32 compute."""
+@functools.partial(jax.jit, static_argnames=("tile_rows", "interpret"))
+def _classify_pallas_jit(pixels_u8, mean, inv_cov, tile_rows: int, interpret: bool):
+    """Whole pipeline (plane split, pad, kernel, crop) as ONE jitted
+    program — a single device dispatch, like the reference's one launch."""
     h, w = pixels_u8.shape[:2]
-    nc = int(mean.shape[0])
-    tile_rows = launch_to_rows(launch)
+    nc = mean.shape[0]
     n = h * w
-    rows_aligned = -(-max(1, -(-n // LANES)) // MIN_ROWS) * MIN_ROWS
-    tile_rows = min(tile_rows, rows_aligned)  # never pad small images to a full tile
-    rows = -(-rows_aligned // tile_rows) * tile_rows
+    rows = -(-max(1, -(-n // LANES)) // tile_rows) * tile_rows
     padded = rows * LANES
     rgb = pixels_u8[..., :3].astype(jnp.float32).reshape(n, 3)
     rgb = jnp.pad(rgb, ((0, padded - n), (0, 0)))
@@ -101,3 +103,25 @@ def classify_labels_pallas(
         interpret,
     )
     return labels.reshape(padded)[:n].reshape(h, w).astype(jnp.uint8)
+
+
+def pick_tile_rows(launch: Optional[Tuple[int, int]], h: int, w: int) -> int:
+    """Resolve the sweep config to a tile height, clamped so small images
+    are never padded to a full default tile."""
+    tile_rows = launch_to_rows(launch)
+    rows_aligned = -(-max(1, -(-(h * w) // LANES)) // MIN_ROWS) * MIN_ROWS
+    return min(tile_rows, rows_aligned)
+
+
+def classify_labels_pallas(
+    pixels_u8: jax.Array,
+    mean: jax.Array,
+    inv_cov: jax.Array,
+    *,
+    launch: Optional[Tuple[int, int]] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """(h, w, 4) u8 image -> (h, w) u8 labels, f32 compute."""
+    h, w = pixels_u8.shape[:2]
+    tile_rows = pick_tile_rows(launch, h, w)
+    return _classify_pallas_jit(pixels_u8, mean, inv_cov, tile_rows, interpret)

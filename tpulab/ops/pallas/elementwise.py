@@ -48,7 +48,12 @@ def _ew_kernel(op: Callable, a_ref, b_ref, o_ref):
 def _ew_padded(a2d, b2d, op: Callable, tile_rows: int, interpret: bool):
     rows = a2d.shape[0]
     grid = pl.cdiv(rows, tile_rows)
-    spec = pl.BlockSpec((tile_rows, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM)
+    # jnp.int32(0), not 0: the framework enables x64 globally (f64 lab1
+    # path) and a Python-int index-map constant lowers as i64, which
+    # Mosaic cannot legalize against the i32 program id
+    spec = pl.BlockSpec(
+        (tile_rows, LANES), lambda i: (i, jnp.int32(0)), memory_space=pltpu.VMEM
+    )
     return pl.pallas_call(
         functools.partial(_ew_kernel, op),
         out_shape=jax.ShapeDtypeStruct(a2d.shape, a2d.dtype),
@@ -57,6 +62,19 @@ def _ew_padded(a2d, b2d, op: Callable, tile_rows: int, interpret: bool):
         out_specs=spec,
         interpret=interpret,
     )(a2d, b2d)
+
+
+@functools.partial(jax.jit, static_argnames=("op", "tile_rows", "interpret"))
+def _pallas_binary_jit(a, b, op: Callable, tile_rows: int, interpret: bool):
+    """Whole pipeline (pad, reshape, kernel, crop) as ONE jitted program —
+    a single device dispatch, like the reference's single kernel launch."""
+    n = a.shape[0]
+    rows = -(-max(1, -(-n // LANES)) // tile_rows) * tile_rows
+    padded = rows * LANES
+    a2d = jnp.pad(a, (0, padded - n)).reshape(rows, LANES)
+    b2d = jnp.pad(b, (0, padded - n)).reshape(rows, LANES)
+    out = _ew_padded(a2d, b2d, op, tile_rows, interpret)
+    return out.reshape(padded)[:n]
 
 
 def pallas_binary(
@@ -81,9 +99,4 @@ def pallas_binary(
     # never let the tile exceed the (aligned) input — a small vector must
     # not be padded up to a full large tile of dead work
     tile_rows = max(MIN_ROWS, min(MAX_ROWS, int(tile_rows), rows_aligned))
-    rows = -(-rows_aligned // tile_rows) * tile_rows
-    padded = rows * LANES
-    a2d = jnp.pad(a, (0, padded - n)).reshape(rows, LANES)
-    b2d = jnp.pad(b, (0, padded - n)).reshape(rows, LANES)
-    out = _ew_padded(a2d, b2d, op, tile_rows, interpret)
-    return out.reshape(padded)[:n]
+    return _pallas_binary_jit(a, b, op, tile_rows, interpret)

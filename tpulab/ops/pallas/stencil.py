@@ -93,16 +93,9 @@ def _gradient_pallas(ypad: jax.Array, th: int, tw: int, interpret: bool) -> jax.
     )(ypad)
 
 
-def roberts_pallas(
-    pixels_u8: jax.Array,
-    *,
-    launch: Optional[Tuple[int, int, int, int]] = None,
-    interpret: bool = False,
-) -> jax.Array:
-    """Roberts edges via the halo stencil kernel; bit-identical to
-    :func:`tpulab.ops.roberts.roberts_edges`."""
+@functools.partial(jax.jit, static_argnames=("th", "tw", "interpret"))
+def _roberts_pallas_jit(pixels_u8: jax.Array, th: int, tw: int, interpret: bool):
     h, w = pixels_u8.shape[:2]
-    th, tw = launch_to_tile(launch, h, w)
     y = luminance_f32(pixels_u8)
     hp = _round_up(h, th)
     wp = _round_up(w, tw)
@@ -112,3 +105,18 @@ def roberts_pallas(
     g = _gradient_pallas(ypad, th, tw, interpret)[:h, :w]
     g8 = magnitude_to_u8(g)
     return jnp.stack([g8, g8, g8, pixels_u8[..., 3]], axis=-1)
+
+
+def roberts_pallas(
+    pixels_u8: jax.Array,
+    *,
+    launch: Optional[Tuple[int, int, int, int]] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Roberts edges via the halo stencil kernel; bit-identical to
+    :func:`tpulab.ops.roberts.roberts_edges`.  The whole pipeline
+    (luminance, pad, kernel, crop, pack) is one jitted program — a single
+    device dispatch, like the reference's single kernel launch."""
+    h, w = pixels_u8.shape[:2]
+    th, tw = launch_to_tile(launch, h, w)
+    return _roberts_pallas_jit(pixels_u8, th, tw, interpret)

@@ -36,8 +36,8 @@ def _reduce(values: jax.Array, op: str) -> jax.Array:
 def reduce_op(values, op: str = "sum", *, backend: Optional[str] = None) -> jax.Array:
     if op not in REDUCERS:
         raise ValueError(f"unknown reduction {op!r}; have {sorted(REDUCERS)}")
-    from tpulab.runtime.device import default_device
+    from tpulab.runtime.device import commit, default_device
 
     device = default_device() if backend in (None, "auto") else jax.devices(backend)[0]
-    x = jax.device_put(jnp.asarray(values), device)
+    x = commit(values, device)
     return _reduce(x, op)

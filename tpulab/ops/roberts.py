@@ -71,6 +71,32 @@ def roberts_edges(pixels_u8: jax.Array) -> jax.Array:
     return jnp.stack([g8, g8, g8, pixels_u8[..., 3]], axis=-1)
 
 
+def roberts_staged(
+    pixels_u8,
+    *,
+    launch: Optional[Tuple[int, int, int, int]] = None,
+    backend: Optional[str] = None,
+    use_pallas: Optional[bool] = None,
+):
+    """(fn, staged_args): input committed to the device once, ``fn`` is the
+    single jitted dispatch — what benchmarks should time (kernel-only
+    contract, tpulab/runtime/timing.py)."""
+    from tpulab.runtime.device import commit, default_device
+
+    device = default_device() if backend in (None, "auto") else jax.devices(backend)[0]
+    x = commit(pixels_u8, device, jnp.uint8)
+    if use_pallas is None:
+        use_pallas = device.platform == "tpu"
+    if use_pallas:
+        from tpulab.ops.pallas.stencil import roberts_pallas
+
+        interpret = device.platform != "tpu"
+        fn = lambda img: roberts_pallas(img, launch=launch, interpret=interpret)
+    else:
+        fn = roberts_edges
+    return fn, (x,)
+
+
 def roberts(
     pixels_u8,
     *,
@@ -83,14 +109,7 @@ def roberts(
     ``launch`` is the CUDA-style ``(bx, by, gx, gy)`` sweep config
     (reference lab2/src/to_plot.cu:57-64); it maps to the Pallas tile shape.
     """
-    from tpulab.runtime.device import default_device
-
-    device = default_device() if backend in (None, "auto") else jax.devices(backend)[0]
-    x = jax.device_put(jnp.asarray(pixels_u8, jnp.uint8), device)
-    if use_pallas is None:
-        use_pallas = device.platform == "tpu"
-    if use_pallas:
-        from tpulab.ops.pallas.stencil import roberts_pallas
-
-        return roberts_pallas(x, launch=launch, interpret=device.platform != "tpu")
-    return roberts_edges(x)
+    fn, args = roberts_staged(
+        pixels_u8, launch=launch, backend=backend, use_pallas=use_pallas
+    )
+    return fn(*args)

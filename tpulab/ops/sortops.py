@@ -26,10 +26,10 @@ def sort_op(values, *, backend: Optional[str] = None) -> jax.Array:
     uint8 inputs are widened to int32 for the sort and narrowed back
     (XLA sorts any dtype, but the narrow path keeps TPU layouts happy).
     """
-    from tpulab.runtime.device import default_device
+    from tpulab.runtime.device import commit, default_device
 
     device = default_device() if backend in (None, "auto") else jax.devices(backend)[0]
-    x = jax.device_put(jnp.asarray(values), device)
+    x = commit(values, device)
     if x.dtype == jnp.uint8:
         return sort_ascending(x.astype(jnp.int32)).astype(jnp.uint8)
     return sort_ascending(x)

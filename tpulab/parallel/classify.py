@@ -20,7 +20,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpulab.ops.mahalanobis import ClassStats, classify_labels
-from tpulab.parallel.mesh import make_mesh
+from tpulab.parallel.mesh import make_mesh, mesh_anchor
+from tpulab.runtime.device import commit
 
 
 @functools.partial(jax.jit, static_argnames=("mesh", "axis", "compute_dtype"))
@@ -48,7 +49,7 @@ def classify_sharded(
     body per shard; row-sharding does not change per-pixel math).
     """
     mesh = mesh or make_mesh(axes=(axis,))
-    img = jnp.asarray(pixels_u8, jnp.uint8)
+    img = commit(pixels_u8, mesh_anchor(mesh), jnp.uint8)
     if img.ndim != 3 or img.shape[-1] != 4:
         raise ValueError(f"expected (h, w, 4) RGBA, got {img.shape}")
     h = img.shape[0]
@@ -58,8 +59,8 @@ def classify_sharded(
         img = jnp.concatenate([img, jnp.repeat(img[-1:], pad, axis=0)], axis=0)
     sharding = NamedSharding(mesh, P(axis, None, None))
     img = jax.device_put(img, sharding)
-    mean = jax.device_put(jnp.asarray(stats.mean), NamedSharding(mesh, P()))
-    inv_cov = jax.device_put(jnp.asarray(stats.inv_cov), NamedSharding(mesh, P()))
+    mean = commit(stats.mean, NamedSharding(mesh, P()))
+    inv_cov = commit(stats.inv_cov, NamedSharding(mesh, P()))
     labels = _sharded_labels(
         img, mean, inv_cov, mesh=mesh, axis=axis, compute_dtype=compute_dtype
     )

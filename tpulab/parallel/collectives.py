@@ -19,7 +19,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tpulab.parallel.mesh import make_mesh
+from tpulab.parallel.mesh import make_mesh, mesh_anchor
+from tpulab.runtime.device import commit
 
 _LOCAL_REDUCERS = {
     "sum": jnp.sum,
@@ -75,7 +76,7 @@ _dist_reduce = reduce_staged
 
 def stage_reduce(values, op: str = "sum", *, mesh: Mesh, axis: str = "x") -> jax.Array:
     """Widen/pad/shard ``values`` for :func:`reduce_staged`."""
-    x = jnp.asarray(values)
+    x = commit(values, mesh_anchor(mesh))
     if x.dtype in (jnp.uint8, jnp.int8, jnp.int16, jnp.int32):
         x = x.astype(jnp.int64 if jax.config.jax_enable_x64 else jnp.int32)
     x = _pad_to_multiple(x, mesh.shape[axis], _identity_fill(op, x.dtype))
@@ -123,7 +124,7 @@ def distributed_mean(
 ) -> jax.Array:
     """Mean via psum of padded-with-zero shards divided by the true count."""
     mesh = mesh or make_mesh(n_devices=num_devices, axes=(axis,))
-    x = jnp.asarray(values)
+    x = commit(values, mesh_anchor(mesh))
     if not jnp.issubdtype(x.dtype, jnp.floating):
         x = x.astype(jnp.float64 if jax.config.jax_enable_x64 else jnp.float32)
     n_true = jnp.asarray(x.shape[0], x.dtype)
@@ -148,7 +149,7 @@ def _all_gather(x: jax.Array, *, mesh: Mesh, axis: str) -> jax.Array:
 def all_gather_op(values, *, mesh: Optional[Mesh] = None, axis: str = "x") -> jax.Array:
     """Gather a sharded 1-D array to every device (replicated output)."""
     mesh = mesh or make_mesh(axes=(axis,))
-    x = jnp.asarray(values)
+    x = commit(values, mesh_anchor(mesh))
     if x.shape[0] % mesh.shape[axis]:
         raise ValueError(f"length {x.shape[0]} not divisible by mesh axis {mesh.shape[axis]}")
     x = jax.device_put(x, NamedSharding(mesh, P(axis)))
@@ -167,7 +168,7 @@ def reduce_scatter_op(matrix, *, mesh: Optional[Mesh] = None, axis: str = "x") -
     """Row-wise psum_scatter: input (k, n) sharded over rows; output is the
     column-sum scattered so each device owns n/k of the result."""
     mesh = mesh or make_mesh(axes=(axis,))
-    x = jnp.asarray(matrix)
+    x = commit(matrix, mesh_anchor(mesh))
     k = mesh.shape[axis]
     if x.shape[0] != k or x.shape[1] % k:
         raise ValueError(f"expected ({k}, m*{k}) matrix, got {x.shape}")

@@ -33,7 +33,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tpulab.parallel.mesh import make_mesh
+from tpulab.parallel.mesh import make_mesh, mesh_anchor
+from tpulab.runtime.device import commit
 
 _KEY_DTYPE = {jnp.dtype(jnp.float32): jnp.uint32, jnp.dtype(jnp.float64): jnp.uint64}
 
@@ -106,7 +107,7 @@ def stage_sort(values, *, mesh: Mesh, axis: str = "x") -> Tuple[jax.Array, dict]
     time the collective alone (the reference times kernels, not H2D —
     SURVEY.md section 5.1).
     """
-    x = jnp.asarray(values)
+    x = commit(values, mesh_anchor(mesh))
     if x.ndim != 1:
         raise ValueError(f"expected 1-D array, got shape {x.shape}")
     meta = {"n": x.shape[0], "dtype": x.dtype, "p": mesh.shape[axis]}

@@ -26,7 +26,8 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpulab.ops.roberts import gradient_magnitude, luminance_f32, magnitude_to_u8
-from tpulab.parallel.mesh import make_mesh
+from tpulab.parallel.mesh import make_mesh, mesh_anchor
+from tpulab.runtime.device import commit
 
 
 def _local_roberts(img_u8: jax.Array, halo_row_y: jax.Array) -> jax.Array:
@@ -73,7 +74,7 @@ def roberts_sharded(
     works on any mesh.
     """
     mesh = mesh or make_mesh(axes=(axis,))
-    img = jnp.asarray(pixels_u8, jnp.uint8)
+    img = commit(pixels_u8, mesh_anchor(mesh), jnp.uint8)
     if img.ndim != 3 or img.shape[-1] != 4:
         raise ValueError(f"expected (h, w, 4) RGBA, got {img.shape}")
     h = img.shape[0]

@@ -98,6 +98,17 @@ def make_mesh(
     return Mesh(arr, names)
 
 
+def mesh_anchor(mesh: Mesh):
+    """A device of the mesh to stage host data on.
+
+    Staging host arrays on a mesh device (via runtime.device.commit)
+    keeps every later eager op and the sharded ``device_put`` on the
+    mesh's own backend — a cross-backend device-to-device transfer
+    permanently degrades TPU dispatch on the tunneled runtime.
+    """
+    return np.asarray(mesh.devices).flat[0]
+
+
 def cpu_test_mesh(axis_sizes: Dict[str, int]) -> Mesh:
     """Mesh over virtual CPU devices (test tier; requires
     ``--xla_force_host_platform_device_count``)."""

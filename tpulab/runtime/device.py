@@ -12,12 +12,47 @@ import functools
 from typing import Any, Dict, List
 
 import jax
+import numpy as np
 
 
 @functools.lru_cache(maxsize=None)
 def cpu_device(index: int = 0):
     """The host CPU backend device (always present, used for f64 paths)."""
     return jax.devices("cpu")[index]
+
+
+def _target_platform(target) -> str:
+    """Platform of a Device or Sharding target."""
+    platform = getattr(target, "platform", None)
+    if platform is not None:
+        return platform
+    return next(iter(target.device_set)).platform  # Sharding
+
+
+def commit(values, target, dtype=None) -> jax.Array:
+    """``device_put`` that never performs a cross-backend device-to-device
+    transfer.
+
+    On the tunneled-TPU environment, ``device_put`` of a TPU-resident
+    array onto the CPU *backend* permanently degrades every later TPU
+    dispatch (~70 ms each; observed on the axon relay, no recovery).
+    Host data therefore stages as NumPy straight onto the target —
+    crucially NOT via ``jnp.asarray``, which would materialize on the
+    default (TPU) device first — and a device-resident array headed for
+    a different backend is pulled to host before re-placement.
+
+    ``target`` is a Device or a Sharding; ``dtype`` optionally casts on
+    the host (NumPy), which also protects f64 values from the default
+    TPU device's silent f32 degradation.
+    """
+    if isinstance(values, jax.Array) and not values.is_deleted():
+        src = {d.platform for d in values.devices()}
+        if src == {_target_platform(target)}:
+            x = values if dtype is None else values.astype(dtype)
+            return jax.device_put(x, target)
+        values = jax.device_get(values)
+    arr = np.asarray(values, dtype) if dtype is not None else np.asarray(values)
+    return jax.device_put(arr, target)
 
 
 def default_device():

@@ -1,0 +1,88 @@
+"""Sequence-parallel attention tests: ring + Ulysses vs dense oracle.
+
+Runs on the 8-virtual-device CPU mesh (conftest).  The oracle is plain
+dense softmax attention in f32 NumPy — independent of the JAX paths.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tpulab.parallel.mesh import cpu_test_mesh
+from tpulab.parallel.ring import attention_reference, ring_attention, ulysses_attention
+
+
+def oracle(q, k, v, causal=True):
+    b, s, h, d = q.shape
+    out = np.empty_like(q, dtype=np.float32)
+    for bi in range(b):
+        for hi in range(h):
+            s_mat = (q[bi, :, hi] / np.sqrt(d)) @ k[bi, :, hi].T  # (s, s)
+            if causal:
+                mask = np.tril(np.ones((s, s), bool))
+                s_mat = np.where(mask, s_mat, -1e30)
+            s_mat = s_mat - s_mat.max(axis=-1, keepdims=True)
+            p = np.exp(s_mat)
+            p /= p.sum(axis=-1, keepdims=True)
+            out[bi, :, hi] = p @ v[bi, :, hi]
+    return out
+
+
+@pytest.fixture(scope="module")
+def mesh_sp():
+    return cpu_test_mesh({"sp": 8})
+
+
+def _qkv(rng, b=2, s=64, h=8, d=16):
+    shape = (b, s, h, d)
+    return (
+        rng.standard_normal(shape).astype(np.float32),
+        rng.standard_normal(shape).astype(np.float32),
+        rng.standard_normal(shape).astype(np.float32),
+    )
+
+
+class TestReference:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_numpy_oracle(self, rng, causal):
+        q, k, v = _qkv(rng)
+        got = np.asarray(attention_reference(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=causal))
+        np.testing.assert_allclose(got, oracle(q, k, v, causal), rtol=1e-5, atol=1e-5)
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_oracle(self, mesh_sp, rng, causal):
+        q, k, v = _qkv(rng)
+        got = np.asarray(ring_attention(q, k, v, mesh=mesh_sp, causal=causal))
+        np.testing.assert_allclose(got, oracle(q, k, v, causal), rtol=1e-4, atol=1e-5)
+
+    def test_long_sequence(self, mesh_sp, rng):
+        q, k, v = _qkv(rng, b=1, s=512, h=2, d=8)
+        got = np.asarray(ring_attention(q, k, v, mesh=mesh_sp))
+        np.testing.assert_allclose(got, oracle(q, k, v), rtol=1e-4, atol=1e-5)
+
+    def test_seq_not_divisible_raises(self, mesh_sp, rng):
+        q, k, v = _qkv(rng, s=30)
+        with pytest.raises(ValueError, match="divisible"):
+            ring_attention(q, k, v, mesh=mesh_sp)
+
+
+class TestUlyssesAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_oracle(self, mesh_sp, rng, causal):
+        q, k, v = _qkv(rng)
+        got = np.asarray(ulysses_attention(q, k, v, mesh=mesh_sp, causal=causal))
+        np.testing.assert_allclose(got, oracle(q, k, v, causal), rtol=1e-4, atol=1e-5)
+
+    def test_heads_not_divisible_raises(self, mesh_sp, rng):
+        q, k, v = _qkv(rng, h=6)
+        with pytest.raises(ValueError, match="heads"):
+            ulysses_attention(q, k, v, mesh=mesh_sp)
+
+    def test_ring_and_ulysses_agree(self, mesh_sp, rng):
+        q, k, v = _qkv(rng, b=1, s=128, h=8, d=32)
+        a = np.asarray(ring_attention(q, k, v, mesh=mesh_sp))
+        b = np.asarray(ulysses_attention(q, k, v, mesh=mesh_sp))
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-5)

@@ -27,7 +27,7 @@ def bench_lab1(n: int = 1000, dtype: str = "float64", reps: int = 20) -> Dict[st
 
     from tpulab.ops.elementwise import make_binary_fn, resolve_binary_device
     from tpulab.runtime.device import commit
-    from tpulab.runtime.timing import measure_ms
+    from tpulab.runtime.timing import measure_kernel_ms
 
     rng = np.random.default_rng(0)
     a = rng.uniform(-1e3, 1e3, n)
@@ -37,13 +37,49 @@ def bench_lab1(n: int = 1000, dtype: str = "float64", reps: int = 20) -> Dict[st
     aj = commit(a, device, dt)
     bj = commit(b, device, dt)
     fn = make_binary_fn("subtract", dt, device=device)
-    ms, _ = measure_ms(fn, (aj, bj), warmup=3, reps=reps)
+    ms, _ = measure_kernel_ms(fn, (aj, bj), iters=max(reps, 500), outer=5)
     base = CUDA_BASELINES_MS.get("lab1_n1000") if n == 1000 and dtype == "float64" else None
     return {
         "metric": f"lab1_subtract_n{n}_{dtype}_median_ms",
         "value": round(ms, 6),
         "unit": "ms",
         "vs_baseline": round(base / ms, 3) if base else None,
+        "device": device.platform,
+    }
+
+
+def bench_labformer(
+    b: int = 8, s: int = 512, reps: int = 20, dtype: str = "bfloat16"
+) -> Dict[str, Any]:
+    """Flagship model forward: tokens/s on one chip (no reference number —
+    the reference has no model tier; this line establishes the baseline)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpulab.models.labformer import LabformerConfig, forward, init_params
+    from tpulab.runtime.device import commit, default_device
+    from tpulab.runtime.timing import measure_ms
+
+    cfg = LabformerConfig(
+        d_model=512,
+        n_heads=8,
+        n_layers=8,
+        d_ff=2048,
+        max_seq=s,
+        dtype={"bfloat16": jnp.bfloat16, "float32": jnp.float32}[dtype],
+    )
+    device = default_device()
+    params = jax.device_put(init_params(cfg, seed=0), device)
+    tokens = commit(
+        np.random.default_rng(0).integers(0, cfg.vocab, (b, s)).astype(np.int32), device
+    )
+    fn = jax.jit(lambda p, t: forward(p, t, cfg))
+    ms, _ = measure_ms(fn, (params, tokens), warmup=3, reps=reps)
+    return {
+        "metric": f"labformer_fwd_b{b}_s{s}_{dtype}_tokens_per_s",
+        "value": round(b * s / (ms / 1e3), 1),
+        "unit": "tokens/s",
+        "vs_baseline": None,
         "device": device.platform,
     }
 
@@ -59,6 +95,7 @@ def run_benchmarks(only: Optional[str] = None, **kw) -> List[Dict[str, Any]]:
     registry = {
         "lab1_n1000": functools.partial(bench_lab1, 1000),
         "lab1_f32_1m": functools.partial(bench_lab1, 1 << 20, dtype="float32"),
+        "labformer_fwd": bench_labformer,
     }
     try:
         from tpulab.bench_image import bench_lab2, bench_lab3  # lands with lab2/lab3

@@ -26,11 +26,11 @@ def bench_lab2(size: int = 1024, reps: int = 30, use_pallas=None) -> Dict[str, A
     from tpulab.ops.pallas.stencil import roberts_pallas
     from tpulab.ops.roberts import roberts_edges
     from tpulab.runtime.device import commit, default_device
-    from tpulab.runtime.timing import measure_ms
+    from tpulab.runtime.timing import measure_kernel_ms
 
     device = default_device()
-    # input staged once; the timed fn is the single jitted dispatch
-    # (kernel-only contract — tpulab/runtime/timing.py)
+    # input staged once; the timed step chains on-device inside one jit
+    # (kernel-only contract - tpulab/runtime/timing.py)
     x = commit(_test_image(size, size), device)
     if use_pallas is None:
         use_pallas = device.platform == "tpu"
@@ -38,7 +38,7 @@ def bench_lab2(size: int = 1024, reps: int = 30, use_pallas=None) -> Dict[str, A
         fn = lambda img: roberts_pallas(img, interpret=device.platform != "tpu")
     else:
         fn = roberts_edges
-    ms, _ = measure_ms(fn, (x,), warmup=3, reps=reps)
+    ms, _ = measure_kernel_ms(fn, (x,), iters=max(reps, 500), outer=5)
     base = CUDA_BASELINES_MS["lab2_roberts_1024"]
     return {
         "metric": f"lab2_roberts_{size}x{size}_median_ms",
@@ -55,7 +55,7 @@ def bench_lab3(size: int = 1024, nc: int = 8, reps: int = 30, use_pallas=None) -
 
     from tpulab.ops.mahalanobis import class_statistics, classify_staged
     from tpulab.runtime.device import default_device
-    from tpulab.runtime.timing import measure_ms
+    from tpulab.runtime.timing import measure_kernel_ms
 
     rng = np.random.default_rng(11)
     img = _test_image(size, size)
@@ -66,7 +66,7 @@ def bench_lab3(size: int = 1024, nc: int = 8, reps: int = 30, use_pallas=None) -
     stats = class_statistics(img, classes)
     device = default_device()
     fn, args = classify_staged(img, stats, use_pallas=use_pallas)
-    ms, _ = measure_ms(fn, args, warmup=3, reps=reps)
+    ms, _ = measure_kernel_ms(fn, args, iters=max(reps, 500), outer=5)
     return {
         "metric": f"lab3_classify_{size}x{size}_nc{nc}_median_ms",
         "value": round(ms, 6),

@@ -1,0 +1,21 @@
+"""Model tier: mesh-first flagship models (see labformer)."""
+
+from tpulab.models.labformer import (
+    LabformerConfig,
+    forward,
+    init_params,
+    init_train_state,
+    loss_fn,
+    make_train_step,
+    shard_params,
+)
+
+__all__ = [
+    "LabformerConfig",
+    "forward",
+    "init_params",
+    "init_train_state",
+    "loss_fn",
+    "make_train_step",
+    "shard_params",
+]

@@ -1,0 +1,311 @@
+"""Labformer — the framework's flagship model: a byte-level decoder
+transformer designed mesh-first.
+
+The reference suite has no model tier (SURVEY.md section 0); this is the
+capability its multi-device trajectory points at, built TPU-native:
+
+* **dp** — batch sharding; gradients all-reduce over dp automatically
+  (GSPMD inserts the psum from the shardings).
+* **sp** — sequence/context parallelism: ring attention
+  (:func:`tpulab.parallel.ring._ring_body`) rotates K/V blocks over the
+  ``sp`` axis with ``ppermute``; activations stay sequence-sharded end
+  to end, so max context scales linearly with the axis size.
+* **tp** — tensor parallelism: attention heads and MLP hidden sharded
+  over ``tp`` (column-parallel in, row-parallel out — the Megatron
+  pattern expressed as shardings, with XLA inserting the collectives).
+* **pp** — pipeline parallelism: the layer-stacked parameters shard
+  over ``pp`` on the layer axis; the ``lax.scan`` over layers crosses
+  stage boundaries as GSPMD collective-permutes.
+* **ep** — expert parallelism: MoE expert weights shard over the fused
+  ``(dp, sp)`` submesh (DeepSpeed-MoE style — experts ride the data
+  axes, no dedicated mesh dimension).  Routing is exact top-1 (switch);
+  every expert computes densely and a one-hot gate selects — no token
+  dropping, bit-stable under resharding.
+
+Parameters are a plain pytree (stacked ``(L, ...)`` leaves); shardings
+are :class:`jax.sharding.NamedSharding` rules applied by tree-matching
+leaf paths, so the same model runs on any mesh factorization, including
+a 1-device mesh.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpulab.parallel.mesh import make_mesh
+from tpulab.parallel.ring import _ring_body
+
+
+@dataclasses.dataclass(frozen=True)
+class LabformerConfig:
+    vocab: int = 256          # byte-level
+    d_model: int = 128
+    n_heads: int = 8
+    n_layers: int = 4
+    d_ff: int = 512
+    n_experts: int = 0        # 0 => dense MLP; >0 => top-1 switch MoE
+    max_seq: int = 1024
+    rope_theta: float = 10000.0
+    dtype: Any = jnp.float32  # params/activations (bfloat16 on real TPU)
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+
+def init_params(cfg: LabformerConfig, seed: int = 0) -> Dict[str, Any]:
+    """Plain-pytree parameters; per-layer leaves stacked on axis 0."""
+    rng = np.random.default_rng(seed)
+    L, d, ff, dt = cfg.n_layers, cfg.d_model, cfg.d_ff, cfg.dtype
+
+    def dense(*shape, scale=None):
+        scale = scale if scale is not None else (1.0 / np.sqrt(shape[-2]))
+        return jnp.asarray(rng.standard_normal(shape) * scale, dt)
+
+    params: Dict[str, Any] = {
+        "embed": dense(cfg.vocab, d, scale=0.02),
+        "final_norm": jnp.ones((d,), dt),
+        "blocks": {
+            "ln1": jnp.ones((L, d), dt),
+            "wq": dense(L, d, d),
+            "wk": dense(L, d, d),
+            "wv": dense(L, d, d),
+            "wo": dense(L, d, d),
+            "ln2": jnp.ones((L, d), dt),
+        },
+    }
+    if cfg.n_experts:
+        E = cfg.n_experts
+        params["blocks"]["router"] = dense(L, d, E, scale=0.02)
+        params["blocks"]["w1"] = dense(L, E, d, ff)
+        params["blocks"]["w2"] = dense(L, E, ff, d)
+    else:
+        params["blocks"]["w1"] = dense(L, d, ff)
+        params["blocks"]["w2"] = dense(L, ff, d)
+    return params
+
+
+# Sharding rules: leaf name -> PartitionSpec (layer axis first for blocks).
+# ep is the fused (dp, sp) submesh on the expert axis of MoE weights.
+_SPECS = {
+    "embed": P(None, "tp"),
+    "final_norm": P(None),
+    "ln1": P("pp", None),
+    "ln2": P("pp", None),
+    "wq": P("pp", None, "tp"),
+    "wk": P("pp", None, "tp"),
+    "wv": P("pp", None, "tp"),
+    "wo": P("pp", "tp", None),
+    "router": P("pp", None, None),
+}
+_SPECS_DENSE = {"w1": P("pp", None, "tp"), "w2": P("pp", "tp", None)}
+_SPECS_MOE = {"w1": P("pp", ("dp", "sp"), None, "tp"), "w2": P("pp", ("dp", "sp"), "tp", None)}
+
+ACT_SPEC = P("dp", "sp", None)  # (batch, seq, d_model)
+
+
+def param_specs(cfg: LabformerConfig) -> Dict[str, Any]:
+    mlp = _SPECS_MOE if cfg.n_experts else _SPECS_DENSE
+    block = {k: _SPECS[k] for k in ("ln1", "wq", "wk", "wv", "wo", "ln2")}
+    block.update({k: mlp[k] for k in ("w1", "w2")})
+    if cfg.n_experts:
+        block["router"] = _SPECS["router"]
+    return {
+        "embed": _SPECS["embed"],
+        "final_norm": _SPECS["final_norm"],
+        "blocks": block,
+    }
+
+
+def _restrict(spec: P, mesh: Mesh) -> P:
+    """Drop mesh axes the mesh doesn't have (so any factorization works)."""
+    def keep(entry):
+        if entry is None:
+            return None
+        names = entry if isinstance(entry, tuple) else (entry,)
+        kept = tuple(n for n in names if n in mesh.axis_names and mesh.shape[n] >= 1)
+        return kept if len(kept) > 1 else (kept[0] if kept else None)
+    return P(*(keep(e) for e in spec))
+
+
+def shard_params(params, cfg: LabformerConfig, mesh: Mesh):
+    specs = param_specs(cfg)
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, _restrict(s, mesh))),
+        params,
+        specs,
+    )
+
+
+def _rmsnorm(x, scale):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + 1e-6).astype(x.dtype)) * scale
+
+
+def _rope(x, positions, theta: float):
+    """Rotary position embedding over (..., seq, heads, head_dim)."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = (theta ** (-np.arange(0, half) / half)).astype(np.float32)
+    angles = positions[:, None].astype(jnp.float32) * freqs[None, :]  # (seq, half)
+    cos = jnp.cos(angles)[None, :, None, :].astype(x.dtype)
+    sin = jnp.sin(angles)[None, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+
+
+def _attention(x, layer, cfg: LabformerConfig, mesh: Optional[Mesh], positions):
+    b, s, d = x.shape
+    h, dh = cfg.n_heads, cfg.head_dim
+    q = (x @ layer["wq"]).reshape(b, s, h, dh)
+    k = (x @ layer["wk"]).reshape(b, s, h, dh)
+    v = (x @ layer["wv"]).reshape(b, s, h, dh)
+    q = _rope(q, positions, cfg.rope_theta)
+    k = _rope(k, positions, cfg.rope_theta)
+    if mesh is not None and "sp" in mesh.axis_names and mesh.shape["sp"] > 1:
+        spec = _restrict(P("dp", "sp", "tp", None), mesh)
+        body = functools.partial(_ring_body, axis="sp", causal=True)
+        o = jax.shard_map(
+            body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+        )(q, k, v)
+    else:
+        from tpulab.parallel.ring import attention_reference
+
+        o = attention_reference(q, k, v, causal=True)
+    return o.reshape(b, s, d) @ layer["wo"]
+
+
+def _mlp(x, layer, cfg: LabformerConfig):
+    if cfg.n_experts:
+        # exact top-1 switch: dense expert compute, one-hot gate select
+        logits = x @ layer["router"]                     # (b, s, E)
+        gate = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        top = jnp.argmax(gate, axis=-1)                  # (b, s)
+        onehot = jax.nn.one_hot(top, cfg.n_experts, dtype=x.dtype)
+        weight = jnp.sum(gate.astype(x.dtype) * onehot, axis=-1)  # (b, s)
+        hidden = jnp.einsum("bsd,edf->bsef", x, layer["w1"])
+        hidden = jax.nn.gelu(hidden)
+        out = jnp.einsum("bsef,efd->bsed", hidden, layer["w2"])
+        out = jnp.einsum("bsed,bse->bsd", out, onehot)
+        return out * weight[..., None]
+    return jax.nn.gelu(x @ layer["w1"]) @ layer["w2"]
+
+
+def forward(params, tokens, cfg: LabformerConfig, mesh: Optional[Mesh] = None):
+    """Logits for next-token prediction; ``tokens`` (batch, seq) int32.
+
+    The ``lax.scan`` over the stacked layer axis is the pipeline: with
+    the layer axis sharded over ``pp``, each scan step's weights live on
+    one stage and GSPMD moves the carried activations across stages.
+    """
+    x = params["embed"][tokens]
+    if mesh is not None:
+        x = jax.lax.with_sharding_constraint(
+            x, NamedSharding(mesh, _restrict(ACT_SPEC, mesh))
+        )
+    positions = jnp.arange(tokens.shape[1])
+
+    def block(x, layer):
+        x = x + _attention(_rmsnorm(x, layer["ln1"]), layer, cfg, mesh, positions)
+        x = x + _mlp(_rmsnorm(x, layer["ln2"]), layer, cfg)
+        if mesh is not None:
+            x = jax.lax.with_sharding_constraint(
+                x, NamedSharding(mesh, _restrict(ACT_SPEC, mesh))
+            )
+        return x, None
+
+    x, _ = jax.lax.scan(block, x, params["blocks"])
+    x = _rmsnorm(x, params["final_norm"])
+    return x @ params["embed"].T  # tied output head
+
+
+def loss_fn(params, tokens, cfg: LabformerConfig, mesh: Optional[Mesh] = None):
+    """Causal next-byte cross entropy."""
+    logits = forward(params, tokens[:, :-1], cfg, mesh)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def make_train_step(cfg: LabformerConfig, mesh: Optional[Mesh], optimizer=None):
+    """Jitted (params, opt_state, tokens) -> (params, opt_state, loss)."""
+    import optax
+
+    optimizer = optimizer or optax.adamw(3e-4)
+
+    @jax.jit
+    def train_step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg, mesh)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return optimizer, train_step
+
+
+def init_train_state(cfg: LabformerConfig, mesh: Optional[Mesh], seed: int = 0):
+    import optax
+
+    params = init_params(cfg, seed)
+    if mesh is not None:
+        params = shard_params(params, cfg, mesh)
+    optimizer, train_step = make_train_step(cfg, mesh)
+    opt_state = optimizer.init(params)
+    return params, opt_state, train_step
+
+
+# ---------------------------------------------------------------- driver hooks
+
+
+def demo_forward_entry():
+    """(fn, example_args) for the driver's single-chip compile check."""
+    cfg = LabformerConfig(d_model=128, n_heads=8, n_layers=2, d_ff=256, max_seq=128)
+    params = init_params(cfg, seed=0)
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(0, cfg.vocab, (2, 128)), jnp.int32
+    )
+    fn = functools.partial(forward, cfg=cfg, mesh=None)
+    return fn, (params, tokens)
+
+
+def dryrun_train_step(n_devices: int, backend: Optional[str] = None) -> None:
+    """One sharded training step on tiny shapes over an n-device mesh.
+
+    Mesh axes (dp, sp, tp, pp) factored from ``n_devices``; the MoE
+    config exercises ep (experts over the fused dp*sp submesh).  Loss
+    must be finite and params must change.
+    """
+    mesh = make_mesh(n_devices=n_devices, axes=("dp", "sp", "tp", "pp"), backend=backend)
+    sp = mesh.shape["sp"]
+    tp = mesh.shape["tp"]
+    pp = mesh.shape["pp"]
+    cfg = LabformerConfig(
+        d_model=max(32, 8 * tp) * 2,
+        n_heads=max(4, tp * sp),
+        n_layers=max(2, 2 * pp),
+        d_ff=64,
+        n_experts=4,
+        max_seq=64,
+    )
+    params, opt_state, train_step = init_train_state(cfg, mesh, seed=0)
+    rng = np.random.default_rng(1)
+    seq = 8 * sp + 1  # +1: loss shifts tokens/targets
+    tokens = jax.device_put(
+        rng.integers(0, cfg.vocab, (2 * mesh.shape["dp"], seq)).astype(np.int32),
+        NamedSharding(mesh, _restrict(P("dp", None), mesh)),
+    )
+    before = np.asarray(jax.device_get(params["blocks"]["wq"]))[0, 0, :4].copy()
+    params, opt_state, loss = train_step(params, opt_state, tokens)
+    loss = float(loss)
+    assert np.isfinite(loss), f"non-finite loss {loss}"
+    after = np.asarray(jax.device_get(params["blocks"]["wq"]))[0, 0, :4]
+    assert not np.allclose(before, after), "params did not update"

@@ -86,19 +86,26 @@ def classify_labels(
     never win, exactly as the C ``dist < best_d`` comparison rejects NaN
     (main.cu:68-71).
     """
-    p = pixels_u8[..., :3].astype(compute_dtype)           # (h, w, 3)
-    mu = mean.astype(compute_dtype)                        # (nc, 3)
-    ic = inv_cov.astype(compute_dtype)                     # (nc, 3, 3)
-    d = p[:, :, None, :] - mu[None, None, :, :]            # (h, w, nc, 3)
-    t = jnp.einsum("hwcj,cji->hwci", d, ic)                # temp_i (main.cu:57-61)
-    dist = jnp.sum(t * d, axis=-1)                         # (h, w, nc)
+    from tpulab.ops.roberts import unpack_rgb_f32
 
-    nc = dist.shape[-1]
-    best = jnp.full(p.shape[:2], -1, jnp.int32)
-    min_dist = jnp.full(p.shape[:2], jnp.inf, dist.dtype)
+    # packed-plane formulation: all tensors are (h, w) with lane-aligned
+    # minor dims (a (..., 3) minor dim wastes TPU lanes and bandwidth);
+    # channel values are exact small integers, so f32->f64 is lossless
+    u = jax.lax.bitcast_convert_type(pixels_u8, jnp.uint32)   # (h, w)
+    planes = tuple(p.astype(compute_dtype) for p in unpack_rgb_f32(u))
+    mu = mean.astype(compute_dtype)                           # (nc, 3)
+    ic = inv_cov.astype(compute_dtype)                        # (nc, 3, 3)
+
+    nc = mu.shape[0]
+    best = jnp.full(u.shape, -1, jnp.int32)
+    min_dist = jnp.full(u.shape, jnp.inf, compute_dtype)
     for c in range(nc):  # static unroll, nc <= MAX_CLASSES
-        dc = dist[..., c]
-        upd = dc < min_dist
+        d = tuple(planes[i] - mu[c, i] for i in range(3))     # (h, w) x3
+        dc = jnp.zeros(u.shape, compute_dtype)
+        for i in range(3):  # temp_i then dist, main.cu:56-66 order
+            t_i = d[0] * ic[c, 0, i] + d[1] * ic[c, 1, i] + d[2] * ic[c, 2, i]
+            dc = dc + t_i * d[i]
+        upd = dc < min_dist  # strict <: NaN (degenerate class) never wins
         best = jnp.where(upd, jnp.int32(c), best)
         min_dist = jnp.where(upd, dc, min_dist)
     return best.astype(jnp.uint8)
@@ -115,7 +122,10 @@ def _classify_full(x, mu, ic, compute_dtype, use_pallas: bool, tile_rows: int, i
         labels = _classify_pallas_jit(x, mu, ic, tile_rows, interpret)
     else:
         labels = classify_labels(x, mu, ic, compute_dtype=compute_dtype)
-    return jnp.concatenate([x[..., :3], labels[..., None]], axis=-1)
+    # pack the label into the alpha byte of the uint32 plane (RGB kept)
+    u = jax.lax.bitcast_convert_type(x, jnp.uint32)
+    out = (u & jnp.uint32(0x00FFFFFF)) | (labels.astype(jnp.uint32) << 24)
+    return jax.lax.bitcast_convert_type(out[..., None], jnp.uint8).reshape(x.shape)
 
 
 def classify_staged(

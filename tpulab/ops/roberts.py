@@ -65,10 +65,45 @@ def magnitude_to_u8(g: jax.Array) -> jax.Array:
 
 
 @jax.jit
-def roberts_edges(pixels_u8: jax.Array) -> jax.Array:
-    """RGBA (h, w, 4) uint8 -> RGBA gray edge image, alpha preserved."""
+def roberts_edges_planar(pixels_u8: jax.Array) -> jax.Array:
+    """Reference formulation over the (h, w, 4) channel layout.
+
+    Bit-identical to :func:`roberts_edges`; kept as the readable spec
+    and as the cross-check for the packed fast path (tests compare
+    both against the C-semantics NumPy oracle)."""
     g8 = magnitude_to_u8(gradient_magnitude(luminance_f32(pixels_u8)))
     return jnp.stack([g8, g8, g8, pixels_u8[..., 3]], axis=-1)
+
+
+def unpack_rgb_f32(u32_plane: jax.Array):
+    """Packed (h, w) uint32 RGBA -> three f32 channel planes.
+
+    Little-endian byte order: byte 0 (lowest) is R, matching the
+    ``.data`` format's R,G,B,A byte sequence on every supported host."""
+    r = (u32_plane & jnp.uint32(0xFF)).astype(jnp.float32)
+    g = ((u32_plane >> 8) & jnp.uint32(0xFF)).astype(jnp.float32)
+    b = ((u32_plane >> 16) & jnp.uint32(0xFF)).astype(jnp.float32)
+    return r, g, b
+
+
+@jax.jit
+def roberts_edges(pixels_u8: jax.Array) -> jax.Array:
+    """RGBA (h, w, 4) uint8 -> RGBA gray edge image, alpha preserved.
+
+    Fast path: the image is bitcast to a packed (h, w) uint32 plane so
+    every tensor has a lane-aligned minor dimension — a (..., 4) uint8
+    minor dim wastes 97% of TPU vector lanes and HBM bandwidth (measured
+    ~2x end-to-end).  Byte math replicates the reference exactly: f32
+    luminance, clamp addressing, truncation-after-clamp.
+    """
+    u = jax.lax.bitcast_convert_type(pixels_u8, jnp.uint32)  # (h, w)
+    r, g, b = unpack_rgb_f32(u)
+    y = _LUMA_R * r + _LUMA_G * g + _LUMA_B * b
+    g8 = magnitude_to_u8(gradient_magnitude(y)).astype(jnp.uint32)
+    out = g8 | (g8 << 8) | (g8 << 16) | (u & jnp.uint32(0xFF000000))
+    return jax.lax.bitcast_convert_type(out[..., None], jnp.uint8).reshape(
+        pixels_u8.shape
+    )
 
 
 def roberts_staged(

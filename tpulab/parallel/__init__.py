@@ -19,7 +19,8 @@ collectives onto ICI; tests run on an 8-virtual-device CPU mesh
 section 4 prescribes.
 """
 
-from tpulab.parallel.mesh import best_factorization, make_mesh, mesh_devices
+from tpulab.parallel.mesh import best_factorization, make_mesh, mesh_anchor, mesh_devices
+from tpulab.parallel.ring import attention_reference, ring_attention, ulysses_attention
 from tpulab.parallel.collectives import (
     all_gather_op,
     distributed_mean,
@@ -41,4 +42,8 @@ __all__ = [
     "roberts_sharded",
     "distributed_sort",
     "classify_sharded",
+    "ring_attention",
+    "ulysses_attention",
+    "attention_reference",
+    "mesh_anchor",
 ]

@@ -1,0 +1,205 @@
+"""Sequence/context parallelism: ring attention and Ulysses all-to-all.
+
+The reference suite has no attention or sequence workloads (SURVEY.md
+section 5.7) — this module is the long-context tier of its multi-device
+trajectory, built the TPU way:
+
+* **Ring attention** (`ring_attention`): Q stays resident on each
+  sequence shard; K/V blocks rotate around the mesh axis with
+  ``lax.ppermute`` while an online-softmax accumulator (flash-attention
+  style running max/denominator) folds in each block.  Peak memory is
+  O(seq/p) per device and the ICI transfer of each K/V block overlaps
+  the matmul of the previous one in XLA's schedule.
+* **Ulysses** (`ulysses_attention`): ``lax.all_to_all`` re-shards
+  activations from sequence-sharded to head-sharded, runs full-sequence
+  local attention per head group, and transposes back.  Two all-to-alls
+  per layer instead of p ppermutes — better for moderate sequence
+  lengths with enough heads.
+
+Both are exact (not approximations): outputs match single-device
+attention to float tolerance, verified in tests/test_ring.py on the
+8-virtual-device CPU mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpulab.parallel.mesh import make_mesh, mesh_anchor
+from tpulab.runtime.device import commit
+
+NEG_INF = -1e30  # large-negative instead of -inf: keeps masked softmax NaN-free
+
+
+def _block_attend(q, k, v, bias):
+    """Scores for one (q-block, k-block) pair: (..., hq, hk) f32."""
+    s = jnp.einsum("...qhd,...khd->...hqk", q, k).astype(jnp.float32)
+    return s + bias
+
+
+def _online_softmax_step(carry, s, v):
+    """Fold one score block into the running (max, denom, weighted-sum)."""
+    m_prev, l_prev, o_prev = carry
+    m_cur = jnp.max(s, axis=-1)                       # (..., h, q)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)                   # rescale old accumulators
+    p = jnp.exp(s - m_new[..., None])                 # (..., h, q, k)
+    l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+    pv = jnp.einsum("...hqk,...khd->...qhd", p, v.astype(jnp.float32))
+    o_new = o_prev * alpha[..., None].swapaxes(-2, -3) + pv
+    return m_new, l_new, o_new
+
+
+def _causal_bias(q_pos, k_pos):
+    """(q, k) additive bias: 0 where k_pos <= q_pos else NEG_INF."""
+    mask = k_pos[None, :] <= q_pos[:, None]
+    return jnp.where(mask, 0.0, NEG_INF).astype(jnp.float32)
+
+
+def attention_reference(q, k, v, causal: bool = True):
+    """Single-device scaled-dot-product attention oracle.
+
+    Shapes ``(..., seq, heads, head_dim)``; softmax in f32, matching the
+    numerics of the distributed paths.
+    """
+    d = q.shape[-1]
+    qs = q / np.sqrt(d).astype(q.dtype)
+    s = jnp.einsum("...qhd,...khd->...hqk", qs, k).astype(jnp.float32)
+    if causal:
+        n_q, n_k = q.shape[-3], k.shape[-3]
+        s = s + _causal_bias(jnp.arange(n_q), jnp.arange(n_k))
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    o = jnp.einsum("...hqk,...khd->...qhd", p, v.astype(jnp.float32))
+    # denom (..., h, q) -> (..., q, h, 1) to divide the (..., q, h, d) out
+    o = o / jnp.sum(p, axis=-1)[..., None].swapaxes(-2, -3)
+    return o.astype(q.dtype)
+
+
+def _ring_body(q, k, v, *, axis: str, causal: bool):
+    """Per-device ring attention over sequence shards (runs in shard_map).
+
+    ``q, k, v``: (..., seq/p, heads, d).  K/V rotate p-1 times; each step
+    folds the visiting block into the online-softmax accumulator with the
+    correct global causal offsets.
+    """
+    p = jax.lax.axis_size(axis)
+    idx = jax.lax.axis_index(axis)
+    seq_local = q.shape[-3]
+    d = q.shape[-1]
+    qs = q / np.sqrt(d).astype(q.dtype)
+
+    # accumulators derived from q (x0) so they inherit q's varying-axes
+    # type: the carry becomes device-varying inside the loop (the bias
+    # depends on axis_index), so it must start out varying over every
+    # axis the shard_map shards q over — not just the ring axis
+    o0 = (q * 0).astype(jnp.float32)                              # (..., s, h, d)
+    zeros_hq = jnp.swapaxes(o0[..., 0], -1, -2)                   # (..., h, s)
+    m0 = zeros_hq + NEG_INF
+    l0 = zeros_hq
+
+    local_pos = jnp.arange(seq_local)
+    perm = [(i, (i + 1) % p) for i in range(p)]  # blocks move to the next rank
+
+    def step(t, carry):
+        m, l, o, kt, vt = carry
+        # the K/V block visiting at step t originated at rank (idx - t) mod p
+        src = (idx - t) % p
+        bias = _causal_bias(idx * seq_local + local_pos, src * seq_local + local_pos) if causal else 0.0
+        s = _block_attend(qs, kt, vt, bias)
+        m, l, o = _online_softmax_step((m, l, o), s, vt)
+        # rotate for the next step (the final rotation is harmless and
+        # keeps the loop body uniform for lax.fori_loop)
+        kt = jax.lax.ppermute(kt, axis, perm)
+        vt = jax.lax.ppermute(vt, axis, perm)
+        return m, l, o, kt, vt
+
+    m, l, o, _, _ = jax.lax.fori_loop(0, p, step, (m0, l0, o0, k, v))
+    out = o / l[..., None].swapaxes(-2, -3)  # (..., h, q) -> (..., q, h, 1)
+    return out.astype(q.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "axis", "causal"))
+def _ring_attention_sharded(q, k, v, *, mesh: Mesh, axis: str, causal: bool):
+    spec = P(None, axis, None, None)  # (batch, seq, heads, d): seq sharded
+    body = functools.partial(_ring_body, axis=axis, causal=causal)
+    return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)(
+        q, k, v
+    )
+
+
+def ring_attention(
+    q,
+    k,
+    v,
+    *,
+    mesh: Optional[Mesh] = None,
+    axis: str = "sp",
+    causal: bool = True,
+) -> jax.Array:
+    """Exact attention over a sequence-sharded (batch, seq, heads, d) input.
+
+    Host arrays are committed to the mesh backend and sharded over
+    ``axis``; sequence length must divide the axis size.
+    """
+    mesh = mesh or make_mesh(axes=(axis,))
+    spec = NamedSharding(mesh, P(None, axis, None, None))
+    q, k, v = (jax.device_put(commit(x, mesh_anchor(mesh)), spec) for x in (q, k, v))
+    if q.shape[1] % mesh.shape[axis]:
+        raise ValueError(f"seq {q.shape[1]} not divisible by mesh axis {mesh.shape[axis]}")
+    return _ring_attention_sharded(q, k, v, mesh=mesh, axis=axis, causal=causal)
+
+
+def _ulysses_body(q, k, v, *, axis: str, causal: bool):
+    """Per-device Ulysses attention (runs in shard_map).
+
+    In: (batch, seq/p, heads, d) sequence-sharded.  all_to_all re-shards
+    to (batch, seq, heads/p, d), local full-sequence attention runs per
+    head group, and the inverse all_to_all restores sequence sharding.
+    """
+    # split heads across the axis, gather sequence: seq/p -> seq, h -> h/p
+    qh = jax.lax.all_to_all(q, axis, split_axis=2, concat_axis=1, tiled=True)
+    kh = jax.lax.all_to_all(k, axis, split_axis=2, concat_axis=1, tiled=True)
+    vh = jax.lax.all_to_all(v, axis, split_axis=2, concat_axis=1, tiled=True)
+    o = attention_reference(qh, kh, vh, causal=causal)
+    return jax.lax.all_to_all(o, axis, split_axis=1, concat_axis=2, tiled=True)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "axis", "causal"))
+def _ulysses_sharded(q, k, v, *, mesh: Mesh, axis: str, causal: bool):
+    spec = P(None, axis, None, None)
+    body = functools.partial(_ulysses_body, axis=axis, causal=causal)
+    return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)(
+        q, k, v
+    )
+
+
+def ulysses_attention(
+    q,
+    k,
+    v,
+    *,
+    mesh: Optional[Mesh] = None,
+    axis: str = "sp",
+    causal: bool = True,
+) -> jax.Array:
+    """Exact attention via all-to-all head/sequence transposition.
+
+    Requires ``heads % axis_size == 0`` (each device owns a head group
+    during the local attention) and ``seq % axis_size == 0``.
+    """
+    mesh = mesh or make_mesh(axes=(axis,))
+    p = mesh.shape[axis]
+    if q.shape[2] % p:
+        raise ValueError(f"heads {q.shape[2]} not divisible by mesh axis {p}")
+    if q.shape[1] % p:
+        raise ValueError(f"seq {q.shape[1]} not divisible by mesh axis {p}")
+    spec = NamedSharding(mesh, P(None, axis, None, None))
+    q, k, v = (jax.device_put(commit(x, mesh_anchor(mesh)), spec) for x in (q, k, v))
+    return _ulysses_sharded(q, k, v, mesh=mesh, axis=axis, causal=causal)

@@ -1,0 +1,125 @@
+/* fastcodec — C implementations of the lab suite's hot codec loops.
+ *
+ * Native counterpart of the reference's per-pixel Python loops in
+ * utils/converter.py:84-113 (the profiled harness hotspot, SURVEY.md
+ * section 3.1).  Exposed functions:
+ *
+ *   hex_encode(data: bytes, group: int = 8) -> str
+ *       lowercase hex, space-separated fixed-size groups (one group =
+ *       one little-endian u32 word = one RGBA pixel or header int).
+ *   hex_decode(text: str) -> bytes
+ *       whitespace-tolerant hex -> raw bytes.
+ *
+ * Built with the stdlib CPython C API (no pybind11 in the image); see
+ * tools/build_native.py.  tpulab.io.imagefile auto-uses it when
+ * importable and falls back to binascii otherwise.
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <stdint.h>
+#include <string.h>
+
+static const char HEXDIGITS[] = "0123456789abcdef";
+
+static PyObject *fastcodec_hex_encode(PyObject *self, PyObject *args) {
+  Py_buffer buf;
+  Py_ssize_t group = 8;
+  if (!PyArg_ParseTuple(args, "y*|n", &buf, &group)) return NULL;
+  if (group <= 0) {
+    PyBuffer_Release(&buf);
+    PyErr_SetString(PyExc_ValueError, "group must be positive");
+    return NULL;
+  }
+  const uint8_t *src = (const uint8_t *)buf.buf;
+  Py_ssize_t n = buf.len;
+  Py_ssize_t hex_len = n * 2;
+  Py_ssize_t n_groups = hex_len ? (hex_len + group - 1) / group : 0;
+  Py_ssize_t total = hex_len + (n_groups > 0 ? n_groups - 1 : 0);
+
+  PyObject *out = PyUnicode_New(total, 127);
+  if (!out) {
+    PyBuffer_Release(&buf);
+    return NULL;
+  }
+  Py_UCS1 *dst = PyUnicode_1BYTE_DATA(out);
+  Py_ssize_t written = 0, in_group = 0;
+  for (Py_ssize_t i = 0; i < n; i++) {
+    uint8_t b = src[i];
+    for (int half = 0; half < 2; half++) {
+      if (in_group == group) {
+        dst[written++] = ' ';
+        in_group = 0;
+      }
+      dst[written++] = (uint8_t)HEXDIGITS[half ? (b & 0xF) : (b >> 4)];
+      in_group++;
+    }
+  }
+  PyBuffer_Release(&buf);
+  return out;
+}
+
+static int hex_val(uint32_t c) {
+  if (c >= '0' && c <= '9') return (int)(c - '0');
+  if (c >= 'a' && c <= 'f') return (int)(c - 'a' + 10);
+  if (c >= 'A' && c <= 'F') return (int)(c - 'A' + 10);
+  return -1;
+}
+
+static PyObject *fastcodec_hex_decode(PyObject *self, PyObject *args) {
+  PyObject *text;
+  if (!PyArg_ParseTuple(args, "U", &text)) return NULL;
+  if (PyUnicode_READY(text) < 0) return NULL;
+  Py_ssize_t len = PyUnicode_GET_LENGTH(text);
+  int kind = PyUnicode_KIND(text);
+  const void *data = PyUnicode_DATA(text);
+
+  uint8_t *tmp = (uint8_t *)PyMem_Malloc(len ? (size_t)len / 2 + 1 : 1);
+  if (!tmp) return PyErr_NoMemory();
+
+  Py_ssize_t out_len = 0;
+  int have_hi = 0, hi = 0;
+  for (Py_ssize_t i = 0; i < len; i++) {
+    uint32_t c = PyUnicode_READ(kind, data, i);
+    if (c == ' ' || c == '\n' || c == '\t' || c == '\r' || c == '\f' || c == 0x0B)
+      continue;
+    int v = hex_val(c);
+    if (v < 0) {
+      PyMem_Free(tmp);
+      PyErr_Format(PyExc_ValueError, "non-hex character %R at index %zd",
+                   PyUnicode_FromOrdinal(c), i);
+      return NULL;
+    }
+    if (have_hi) {
+      tmp[out_len++] = (uint8_t)((hi << 4) | v);
+      have_hi = 0;
+    } else {
+      hi = v;
+      have_hi = 1;
+    }
+  }
+  if (have_hi) {
+    PyMem_Free(tmp);
+    PyErr_SetString(PyExc_ValueError, "odd number of hex digits");
+    return NULL;
+  }
+  PyObject *out = PyBytes_FromStringAndSize((const char *)tmp, out_len);
+  PyMem_Free(tmp);
+  return out;
+}
+
+static PyMethodDef fastcodec_methods[] = {
+    {"hex_encode", fastcodec_hex_encode, METH_VARARGS,
+     "hex_encode(data, group=8) -> grouped lowercase hex string"},
+    {"hex_decode", fastcodec_hex_decode, METH_VARARGS,
+     "hex_decode(text) -> bytes (whitespace tolerant)"},
+    {NULL, NULL, 0, NULL}};
+
+static struct PyModuleDef fastcodec_module = {
+    PyModuleDef_HEAD_INIT, "_tpulab_fastcodec",
+    "C codec loops for the tpulab image formats", -1, fastcodec_methods};
+
+PyMODINIT_FUNC PyInit__tpulab_fastcodec(void) {
+  return PyModule_Create(&fastcodec_module);
+}

@@ -1,0 +1,184 @@
+"""Native tier tests: C codec parity, daemon protocol, C++ thin client.
+
+Builds artifacts on demand with tools/build_native.py (g++ is part of
+the toolchain contract); the daemon runs on the CPU backend.
+"""
+
+import os
+import pathlib
+import shutil
+import socket
+import struct
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+CLIENT = ROOT / "native" / "bin" / "tpulab_client"
+
+
+@pytest.fixture(scope="module", autouse=True)
+def built_native():
+    if shutil.which("g++") is None:
+        pytest.skip("no g++ in environment")
+    subprocess.run([sys.executable, str(ROOT / "tools" / "build_native.py")], check=True)
+
+
+class TestFastcodec:
+    @pytest.fixture(scope="class")
+    def codec(self):
+        sys.path.append(str(ROOT / "native" / "lib"))
+        return pytest.importorskip("_tpulab_fastcodec")
+
+    def test_encode_matches_python(self, codec, rng):
+        import binascii
+
+        blob = rng.integers(0, 256, 4 * 37 + 8, dtype=np.uint8).tobytes()
+        hx = binascii.hexlify(blob).decode()
+        want = " ".join(hx[i : i + 8] for i in range(0, len(hx), 8))
+        assert codec.hex_encode(blob, 8) == want
+
+    def test_roundtrip(self, codec, rng):
+        blob = rng.integers(0, 256, 1000, dtype=np.uint8).tobytes()
+        assert codec.hex_decode(codec.hex_encode(blob, 8)) == blob
+
+    def test_decode_whitespace_and_case(self, codec):
+        assert codec.hex_decode(" De\nAD\tbe  ef \r") == bytes.fromhex("deadbeef")
+
+    def test_decode_rejects_garbage(self, codec):
+        with pytest.raises(ValueError):
+            codec.hex_decode("xyz")
+        with pytest.raises(ValueError):
+            codec.hex_decode("abc")  # odd digit count
+
+    def test_empty(self, codec):
+        assert codec.hex_encode(b"", 8) == ""
+        assert codec.hex_decode("") == b""
+
+    def test_io_layer_uses_it(self, codec):
+        from tpulab.io import bytes_to_hex, hex_to_bytes
+
+        blob = b"\x01\x02\x03\x04\xff\xfe\xfd\xfc"
+        assert hex_to_bytes(bytes_to_hex(blob)) == blob
+
+
+@pytest.fixture(scope="module")
+def daemon(tmp_path_factory, built_native):
+    sock = str(tmp_path_factory.mktemp("d") / "tpulab.sock")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["PYTHONPATH"] = str(ROOT)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tpulab.daemon", "--socket", sock],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        cwd=str(ROOT),
+    )
+    for _ in range(300):  # JAX import can take a while
+        if os.path.exists(sock):
+            break
+        if proc.poll() is not None:
+            raise RuntimeError(f"daemon died: {proc.stdout.read()}")
+        time.sleep(0.1)
+    else:
+        proc.kill()
+        raise RuntimeError("daemon socket never appeared")
+    yield sock
+    proc.terminate()
+    proc.wait(timeout=10)
+
+
+def _raw_request(sock_path, header: bytes, payload: bytes):
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    s.connect(sock_path)
+    s.sendall(struct.pack("<I", len(header)) + header)
+    s.sendall(struct.pack("<Q", len(payload)) + payload)
+    status = s.recv(1)[0]
+    (n,) = struct.unpack("<Q", s.recv(8))
+    out = b""
+    while len(out) < n:
+        out += s.recv(n - len(out))
+    s.close()
+    return status, out.decode()
+
+
+class TestDaemon:
+    def test_lab1_over_socket(self, daemon):
+        status, out = _raw_request(
+            daemon, b'{"lab": "lab1", "config": {"warmup": 0, "reps": 1}}', b"3 1 2 3 4 5 6"
+        )
+        assert status == 0
+        lines = out.splitlines()
+        assert "execution time:" in lines[0]
+        got = np.array(lines[1].split(), dtype=np.float64)
+        np.testing.assert_allclose(got, [-3.0, -3.0, -3.0])
+
+    def test_error_reported(self, daemon):
+        status, out = _raw_request(daemon, b'{"lab": "nope"}', b"")
+        assert status == 1
+        assert "nope" in out
+
+    def test_warm_requests_are_fast(self, daemon):
+        _raw_request(daemon, b'{"lab": "hw1"}', b"1 -3 2")  # warm
+        t0 = time.perf_counter()
+        status, out = _raw_request(daemon, b'{"lab": "hw1"}', b"1 -3 2")
+        dt = time.perf_counter() - t0
+        assert status == 0 and "1.000000" in out and "2.000000" in out
+        # an interpreter cold start alone is >1s; warm round-trip must be far under
+        assert dt < 1.0, f"warm request took {dt:.2f}s"
+
+
+class TestClient:
+    def test_client_via_daemon(self, daemon):
+        env = dict(os.environ)
+        env["TPULAB_DAEMON_SOCKET"] = daemon
+        r = subprocess.run(
+            [str(CLIENT), "lab1", "--warmup", "0", "--reps", "1"],
+            input="2 10 20 1 2",
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=60,
+        )
+        assert r.returncode == 0, r.stderr
+        lines = r.stdout.splitlines()
+        assert "execution time:" in lines[0]
+        got = np.array(lines[1].split(), dtype=np.float64)
+        np.testing.assert_allclose(got, [9.0, 18.0])
+
+    def test_client_sweep_flag(self, daemon):
+        env = dict(os.environ)
+        env["TPULAB_DAEMON_SOCKET"] = daemon
+        with_tmp = pathlib.Path(daemon).parent
+        inp = with_tmp / "in.txt"
+        out_path = with_tmp / "out.data"
+        # 3x3 test image from the reference fixtures
+        src = pathlib.Path("/root/reference/lab2/data/test_01.txt")
+        if not src.exists():
+            pytest.skip("reference fixtures not mounted")
+        inp.write_text(src.read_text())
+        r = subprocess.run(
+            [str(CLIENT), "lab2", "--to-plot", "--warmup", "0", "--reps", "1"],
+            input=f"32 32 16 16\n{inp}\n{out_path}\n",
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=120,
+        )
+        assert r.returncode == 0, r.stderr
+        assert "execution time:" in r.stdout.splitlines()[0]
+        assert "FINISHED!" in r.stdout
+        from tpulab.io import load_image
+
+        golden = load_image("/root/reference/lab2/data_out_gt/test_01.txt")
+        np.testing.assert_array_equal(load_image(str(out_path)), golden)
+
+    def test_client_rejects_bad_usage(self, built_native):
+        r = subprocess.run([str(CLIENT)], capture_output=True, text=True)
+        assert r.returncode == 2

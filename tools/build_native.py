@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Build the native tier: the C++ daemon client and the C codec extension.
+
+Artifacts land in ``native/bin/`` (client) and next to the package as an
+importable extension (``native/lib/_tpulab_fastcodec*.so``, appended to
+sys.path by tpulab.io.imagefile when present).
+
+Usage: ``python tools/build_native.py [--clean]``
+Requires g++ (baked into the image); no network access needed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import shutil
+import subprocess
+import sys
+import sysconfig
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+NATIVE = ROOT / "native"
+BIN = NATIVE / "bin"
+LIB = NATIVE / "lib"
+
+
+def build_client() -> pathlib.Path:
+    BIN.mkdir(parents=True, exist_ok=True)
+    out = BIN / "tpulab_client"
+    src = NATIVE / "client" / "tpulab_client.cpp"
+    cmd = ["g++", "-std=c++17", "-O2", "-Wall", "-o", str(out), str(src)]
+    subprocess.run(cmd, check=True)
+    return out
+
+
+def build_fastcodec() -> pathlib.Path:
+    LIB.mkdir(parents=True, exist_ok=True)
+    src = NATIVE / "fastcodec" / "fastcodecmodule.c"
+    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    out = LIB / f"_tpulab_fastcodec{suffix}"
+    include = sysconfig.get_paths()["include"]
+    cmd = [
+        "gcc",
+        "-shared",
+        "-fPIC",
+        "-O2",
+        "-Wall",
+        f"-I{include}",
+        "-o",
+        str(out),
+        str(src),
+    ]
+    subprocess.run(cmd, check=True)
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--clean", action="store_true")
+    args = ap.parse_args(argv)
+    if args.clean:
+        for d in (BIN, LIB):
+            shutil.rmtree(d, ignore_errors=True)
+        print("cleaned")
+        return 0
+    client = build_client()
+    ext = build_fastcodec()
+    print(f"built {client.relative_to(ROOT)}")
+    print(f"built {ext.relative_to(ROOT)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,126 @@
+"""Warm-runtime daemon: persistent JAX process behind a unix socket.
+
+The reference's harness spawns a fresh native binary per run (reference
+``tester.py:126``); for a TPU backend that model would pay runtime init
+plus XLA compilation on every run (SURVEY.md section 7, "hard parts").
+This daemon keeps ONE process with a live backend and hot jit caches;
+the native thin client (``native/client/tpulab_client.cpp``) speaks the
+reference's stdin/stdout contract and forwards over the socket, so the
+harness still sees a subprocess-per-run binary while the compute stays
+warm.
+
+Wire protocol (all integers little-endian):
+
+    request:  u32 header_len | header JSON | u64 payload_len | payload
+              header = {"lab": str, "sweep": bool, "backend": str|null,
+                        "config": {...}}       payload = stdin text bytes
+    response: u8 status (0 ok / 1 error) | u64 len | output bytes
+
+Run: ``python -m tpulab.daemon --socket /tmp/tpulab.sock``
+Stop: SIGTERM/SIGINT, or an empty header (client disconnect is fine too).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socket
+import struct
+import sys
+import traceback
+from typing import Optional
+
+
+def _recv_exact(conn: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed mid-message")
+        buf += chunk
+    return buf
+
+
+def handle_request(header: dict, payload: bytes) -> bytes:
+    from tpulab.labs import get_workload
+
+    mod = get_workload(header["lab"])
+    out = mod.run(
+        payload.decode("utf-8"),
+        sweep=bool(header.get("sweep", False)),
+        backend=header.get("backend"),
+        **(header.get("config") or {}),
+    )
+    return out.encode("utf-8")
+
+
+def serve(socket_path: str, *, max_requests: Optional[int] = None) -> None:
+    try:
+        os.unlink(socket_path)
+    except FileNotFoundError:
+        pass
+    srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    srv.bind(socket_path)
+    srv.listen(16)
+
+    stop = {"flag": False}
+
+    def _sigterm(signum, frame):
+        stop["flag"] = True
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGTERM, _sigterm)
+
+    # warm the backend before accepting work so the first client request
+    # doesn't pay device discovery
+    import jax
+
+    jax.devices()
+    print(f"[tpulab.daemon] serving on {socket_path}", flush=True)
+
+    served = 0
+    try:
+        while not stop["flag"]:
+            conn, _ = srv.accept()
+            try:
+                raw = _recv_exact(conn, 4)
+                (hlen,) = struct.unpack("<I", raw)
+                header = json.loads(_recv_exact(conn, hlen))
+                (plen,) = struct.unpack("<Q", _recv_exact(conn, 8))
+                payload = _recv_exact(conn, plen)
+                try:
+                    out = handle_request(header, payload)
+                    conn.sendall(struct.pack("<BQ", 0, len(out)) + out)
+                except Exception:
+                    err = traceback.format_exc().encode("utf-8")
+                    conn.sendall(struct.pack("<BQ", 1, len(err)) + err)
+            except ConnectionError:
+                pass
+            finally:
+                conn.close()
+            served += 1
+            if max_requests is not None and served >= max_requests:
+                break
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.close()
+        try:
+            os.unlink(socket_path)
+        except FileNotFoundError:
+            pass
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--socket", default=os.environ.get("TPULAB_DAEMON_SOCKET", "/tmp/tpulab.sock"))
+    ap.add_argument("--max-requests", type=int, default=None, help="exit after N requests (tests)")
+    args = ap.parse_args(argv)
+    serve(args.socket, max_requests=args.max_requests)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,12 +20,25 @@ from __future__ import annotations
 import binascii
 import os
 import struct
+import sys
 from dataclasses import dataclass
 from typing import Tuple
 
 import numpy as np
 
 HEX_GROUP = 8  # hex chars per group == one little-endian 32-bit word
+
+# native codec (tools/build_native.py artifact): C loops for the hex
+# hot path — the reference's profiled bottleneck was the converter's
+# per-pixel Python loops (SURVEY.md section 3.1).  Pure-Python fallback
+# below keeps the package dependency-free when it isn't built.
+_NATIVE_LIB = os.path.join(os.path.dirname(__file__), "..", "..", "native", "lib")
+if os.path.isdir(_NATIVE_LIB) and _NATIVE_LIB not in sys.path:
+    sys.path.append(_NATIVE_LIB)
+try:
+    import _tpulab_fastcodec as _fastcodec
+except ImportError:
+    _fastcodec = None
 
 
 def get_size(blob: bytes) -> float:
@@ -92,12 +105,16 @@ def unpack_image(blob: bytes) -> np.ndarray:
 
 def bytes_to_hex(blob: bytes) -> str:
     """Byte stream -> space-separated lowercase 8-char hex groups."""
+    if _fastcodec is not None:
+        return _fastcodec.hex_encode(blob, HEX_GROUP)
     hx = binascii.hexlify(blob).decode("ascii")
     return " ".join(hx[i : i + HEX_GROUP] for i in range(0, len(hx), HEX_GROUP))
 
 
 def hex_to_bytes(text: str) -> bytes:
     """Whitespace-tolerant hex -> byte stream."""
+    if _fastcodec is not None:
+        return _fastcodec.hex_decode(text)
     cleaned = "".join(text.split())
     return binascii.unhexlify(cleaned)
 

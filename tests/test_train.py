@@ -1,0 +1,108 @@
+"""Training-loop tests: loss progress, checkpoint/resume parity, failure
+detection, tracing, and mesh training.  CPU backend (conftest)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from tpulab.models.labformer import LabformerConfig
+from tpulab.train import batches, train
+
+TINY = LabformerConfig(d_model=32, n_heads=4, n_layers=2, d_ff=64, max_seq=32)
+
+
+def _quiet(*a, **k):
+    pass
+
+
+class TestLoop:
+    def test_loss_decreases(self):
+        _, first = train(steps=1, batch=4, seq=32, cfg=TINY, log=_quiet)
+        _, last = train(steps=12, batch=4, seq=32, cfg=TINY, log=_quiet)
+        assert last < first
+
+    def test_deterministic_batches(self):
+        b = batches(256, 4, 16, seed=7)
+        np.testing.assert_array_equal(b(3), b(3))
+        assert not np.array_equal(b(3), b(4))
+
+
+class TestCheckpointResume:
+    def test_resume_matches_uninterrupted(self, tmp_path):
+        """save@10 -> resume -> 20 must equal straight-through 20."""
+        d1 = str(tmp_path / "interrupted")
+        train(steps=10, batch=4, seq=32, cfg=TINY, ckpt_dir=d1, save_every=10, log=_quiet)
+        _, resumed = train(
+            steps=20, batch=4, seq=32, cfg=TINY, ckpt_dir=d1, save_every=10,
+            resume=True, log=_quiet,
+        )
+        _, straight = train(steps=20, batch=4, seq=32, cfg=TINY, log=_quiet)
+        assert abs(resumed - straight) < 1e-5, (resumed, straight)
+
+    def test_fresh_run_clears_stale_dir(self, tmp_path):
+        d = str(tmp_path / "ck")
+        train(steps=5, batch=2, seq=32, cfg=TINY, ckpt_dir=d, save_every=5, log=_quiet)
+        # non-resume run must not restore from the stale snapshot
+        train(steps=5, batch=2, seq=32, cfg=TINY, ckpt_dir=d, save_every=5, log=_quiet)
+        assert os.path.isdir(d)
+
+
+class TestFailureDetection:
+    def test_nonfinite_loss_raises(self):
+        """A diverging run (lr=1e38 overflows f32 in a few steps) must
+        fail fast with FloatingPointError — the CSC-macro analog."""
+        import optax
+
+        with pytest.raises(FloatingPointError, match="non-finite loss"):
+            train(
+                steps=8, batch=2, seq=32, cfg=TINY, log=_quiet,
+                optimizer=optax.sgd(1e38),
+            )
+
+
+class TestTracing:
+    def test_trace_dir_written(self, tmp_path):
+        d = str(tmp_path / "trace")
+        train(steps=2, batch=2, seq=32, cfg=TINY, trace_dir=d, log=_quiet)
+        assert os.path.isdir(d) and any(os.scandir(d))
+
+    def test_event_log(self, tmp_path):
+        from tpulab.runtime.trace import EventLog
+
+        p = str(tmp_path / "events.jsonl")
+        log = EventLog(p, echo=False)
+        log.event("Experiment", "run started", k_times=3)
+        with log.timed("Kernel", "lab2"):
+            pass
+        log.close()
+        lines = [json.loads(l) for l in open(p)]
+        assert lines[0]["tag"] == "Experiment" and lines[0]["k_times"] == 3
+        assert "elapsed_ms" in lines[1]
+
+
+class TestMeshTraining:
+    def test_train_on_8dev_mesh(self):
+        _, loss = train(steps=3, batch=4, seq=32, cfg=TINY, mesh_devices=8, log=_quiet)
+        assert np.isfinite(loss)
+
+
+class TestCLI:
+    def test_cli_smoke(self, tmp_path):
+        env = dict(os.environ)
+        env.update(
+            JAX_PLATFORMS="cpu",
+            PALLAS_AXON_POOL_IPS="",
+            PYTHONPATH=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        r = subprocess.run(
+            [sys.executable, "-m", "tpulab", "train", "--steps", "2", "--batch", "2",
+             "--seq", "32"],
+            env=env, capture_output=True, text=True, timeout=300,
+        )
+        assert r.returncode == 0, r.stderr
+        out = json.loads(r.stdout.strip().splitlines()[-1])
+        assert out["final_step"] == 2

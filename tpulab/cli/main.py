@@ -27,6 +27,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     run_p.add_argument("--backend", default=None, help="cpu|tpu|auto")
 
     sub.add_parser("bench", help="run the benchmark suite")
+    sub.add_parser("train", help="train the flagship model (checkpoint/resume)")
+    sub.add_parser("daemon", help="start the warm-runtime daemon")
 
     args, extra = parser.parse_known_args(argv)
 
@@ -47,6 +49,16 @@ def main(argv: Optional[List[str]] = None) -> int:
         from tpulab.cli.bench import run_bench_cli
 
         return run_bench_cli(extra)
+
+    if args.command == "train":
+        from tpulab.train import main as train_main
+
+        return train_main(extra)
+
+    if args.command == "daemon":
+        from tpulab.daemon import main as daemon_main
+
+        return daemon_main(extra)
 
     parser.print_help()
     return 2

@@ -252,13 +252,11 @@ def make_train_step(cfg: LabformerConfig, mesh: Optional[Mesh], optimizer=None):
     return optimizer, train_step
 
 
-def init_train_state(cfg: LabformerConfig, mesh: Optional[Mesh], seed: int = 0):
-    import optax
-
+def init_train_state(cfg: LabformerConfig, mesh: Optional[Mesh], seed: int = 0, optimizer=None):
     params = init_params(cfg, seed)
     if mesh is not None:
         params = shard_params(params, cfg, mesh)
-    optimizer, train_step = make_train_step(cfg, mesh)
+    optimizer, train_step = make_train_step(cfg, mesh, optimizer)
     opt_state = optimizer.init(params)
     return params, opt_state, train_step
 

@@ -1,0 +1,168 @@
+"""Training loop for the flagship model: checkpoint/resume, tracing, sanitize.
+
+The reference suite has no checkpointing (SURVEY.md section 5.4 — its
+nearest analog is the converter's eager sibling-file materialization);
+this module supplies the real thing for the model tier:
+
+* **Checkpoint/resume** — orbax ``CheckpointManager`` snapshots
+  ``{params, opt_state, step}`` every ``save_every`` steps with async
+  barriers handled by orbax; ``--resume`` restores the latest snapshot
+  and continues bit-exactly (same data stream: the byte corpus is
+  deterministic in ``seed`` and step index).
+* **Failure detection** — loss is checked for NaN/inf every step (the
+  CSC-macro analog, reference lab1/src/main.cu:5-13: detect, report,
+  fail fast with a nonzero exit instead of silently diverging).
+* **Sanitize mode** — ``--sanitize`` enables ``jax_debug_nans``: XLA
+  re-runs the offending op un-jitted and raises at the exact primitive
+  that produced the first NaN (the TPU stand-in for compute-sanitizer,
+  SURVEY.md section 5.2).
+* **Tracing** — ``--trace-dir`` wraps the loop in the JAX profiler
+  (``tpulab.runtime.trace``); view with TensorBoard or Perfetto.
+
+Data: a deterministic synthetic byte corpus (seeded permutation of a
+repeated byte pattern) — self-contained like the reference's synthetic
+lab1 vectors (reference lab1/lab1_processor.py:30-36).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+import time
+from typing import Optional
+
+import numpy as np
+
+
+def batches(vocab: int, batch: int, seq: int, seed: int):
+    """Deterministic infinite batch stream, indexable by step."""
+    def batch_at(step: int) -> np.ndarray:
+        rng = np.random.default_rng((seed << 20) ^ step)
+        base = rng.integers(0, vocab, (batch, seq + 1), dtype=np.int64)
+        # inject structure so the loss can actually fall: runs of repeats
+        rep = rng.integers(0, vocab, (batch, 1), dtype=np.int64)
+        mask = rng.random((batch, seq + 1)) < 0.5
+        return np.where(mask, rep, base).astype(np.int32)
+
+    return batch_at
+
+
+def train(
+    steps: int = 50,
+    batch: int = 8,
+    seq: int = 128,
+    ckpt_dir: Optional[str] = None,
+    save_every: int = 20,
+    resume: bool = False,
+    mesh_devices: int = 0,
+    seed: int = 0,
+    sanitize: bool = False,
+    trace_dir: Optional[str] = None,
+    log=print,
+    cfg=None,
+    optimizer=None,
+):
+    """Run the loop; returns (final_step, last_loss)."""
+    import jax
+
+    if sanitize:
+        jax.config.update("jax_debug_nans", True)
+
+    from tpulab.models.labformer import LabformerConfig, init_train_state
+    from tpulab.parallel.mesh import make_mesh
+    from tpulab.runtime.trace import maybe_trace
+
+    cfg = cfg or LabformerConfig(d_model=128, n_heads=8, n_layers=4, d_ff=512, max_seq=seq)
+    mesh = None
+    if mesh_devices:
+        mesh = make_mesh(n_devices=mesh_devices, axes=("dp", "sp", "tp", "pp"))
+    params, opt_state, train_step = init_train_state(cfg, mesh, seed=seed, optimizer=optimizer)
+
+    start_step = 0
+    manager = None
+    if ckpt_dir:
+        import os
+        import shutil
+
+        import orbax.checkpoint as ocp
+
+        ckpt_path = os.path.abspath(ckpt_dir)
+        if not resume and os.path.exists(ckpt_path):
+            shutil.rmtree(ckpt_path)
+        manager = ocp.CheckpointManager(
+            ckpt_path, options=ocp.CheckpointManagerOptions(max_to_keep=3)
+        )
+        if resume and manager.latest_step() is not None:
+            start_step = manager.latest_step()
+            restored = manager.restore(
+                start_step,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardRestore({"params": params, "opt_state": opt_state})
+                ),
+            )
+            params = restored.state["params"]
+            opt_state = restored.state["opt_state"]
+            log(f"[train] resumed from step {start_step}")
+
+    batch_at = batches(cfg.vocab, batch, seq, seed)
+    loss = float("nan")
+    with maybe_trace(trace_dir):
+        for step in range(start_step, steps):
+            tokens = batch_at(step)
+            t0 = time.perf_counter()
+            params, opt_state, loss = train_step(params, opt_state, tokens)
+            loss = float(loss)
+            dt = (time.perf_counter() - t0) * 1e3
+            if not np.isfinite(loss):  # fail fast — the CSC-macro analog
+                raise FloatingPointError(f"non-finite loss {loss} at step {step}")
+            log(f"[train] step {step} loss {loss:.4f} ({dt:.1f} ms)")
+            if manager and (step + 1) % save_every == 0:
+                import orbax.checkpoint as ocp
+
+                manager.save(
+                    step + 1,
+                    args=ocp.args.Composite(
+                        state=ocp.args.StandardSave(
+                            {"params": params, "opt_state": opt_state}
+                        )
+                    ),
+                )
+    if manager:
+        manager.wait_until_finished()
+        manager.close()
+    return steps, loss
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--steps", type=int, default=50)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=128)
+    ap.add_argument("--ckpt-dir", default=None)
+    ap.add_argument("--save-every", type=int, default=20)
+    ap.add_argument("--resume", action="store_true")
+    ap.add_argument("--mesh", type=int, default=0, help="devices in the (dp,sp,tp,pp) mesh")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--sanitize", action="store_true", help="jax_debug_nans")
+    ap.add_argument("--trace-dir", default=None, help="JAX profiler output dir")
+    args = ap.parse_args(argv)
+    step, loss = train(
+        steps=args.steps,
+        batch=args.batch,
+        seq=args.seq,
+        ckpt_dir=args.ckpt_dir,
+        save_every=args.save_every,
+        resume=args.resume,
+        mesh_devices=args.mesh,
+        seed=args.seed,
+        sanitize=args.sanitize,
+        trace_dir=args.trace_dir,
+    )
+    print(json.dumps({"final_step": step, "loss": loss}))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

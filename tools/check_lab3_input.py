@@ -1,0 +1,44 @@
+#!/usr/bin/env python
+"""Standalone checker for the lab3 stdin grammar (reference
+``lab3/src/test_read_input.c:4-66`` parity tool, component N9).
+
+Reads the lab3 input from stdin — input path, output path, ``nc``, then
+per class ``np`` and ``np`` coordinate pairs — and echoes the parsed
+structure back in the same shape, so a malformed payload is caught
+before it reaches a workload.  Usage::
+
+    python tools/check_lab3_input.py [--sweep] < input.txt
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--sweep", action="store_true", help="expect the to_plot launch prefix")
+    args = ap.parse_args(argv)
+
+    from tpulab.io.protocol import parse_lab3
+
+    try:
+        inp = parse_lab3(sys.stdin.read(), sweep=args.sweep)
+    except Exception as exc:
+        print(f"PARSE ERROR: {exc}", file=sys.stderr)
+        return 1
+
+    if inp.launch:
+        print(f"launch: {inp.launch[0]} {inp.launch[1]}")
+    print(f"input_path: {inp.input_path}")
+    print(f"output_path: {inp.output_path}")
+    print(f"nc: {len(inp.classes)}")
+    for i, cls in enumerate(inp.classes):
+        pts = " ".join(f"{x} {y}" for x, y in cls.points)
+        print(f"class {i}: np={len(cls.points)} {pts}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

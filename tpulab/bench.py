@@ -84,6 +84,43 @@ def bench_labformer(
     }
 
 
+def bench_labformer_decode(
+    b: int = 8, steps: int = 128, reps: int = 3, dtype: str = "bfloat16"
+) -> Dict[str, Any]:
+    """KV-cache autoregressive decode: tokens/s (whole loop is one jit)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpulab.models.generate import generate_jit
+    from tpulab.models.labformer import LabformerConfig, init_params
+    from tpulab.runtime.device import commit, default_device
+    from tpulab.runtime.timing import measure_ms
+
+    cfg = LabformerConfig(
+        d_model=512,
+        n_heads=8,
+        n_layers=8,
+        d_ff=2048,
+        max_seq=1024,
+        dtype={"bfloat16": jnp.bfloat16, "float32": jnp.float32}[dtype],
+    )
+    device = default_device()
+    params = jax.device_put(init_params(cfg, seed=0), device)
+    prompt = commit(
+        np.random.default_rng(0).integers(0, cfg.vocab, (b, 8)).astype(np.int32), device
+    )
+    key = jax.random.PRNGKey(0)
+    fn = lambda p, t: generate_jit(p, t, key, cfg, steps, 1.0)
+    ms, _ = measure_ms(fn, (params, prompt), warmup=2, reps=reps)
+    return {
+        "metric": f"labformer_decode_b{b}_{steps}steps_{dtype}_tokens_per_s",
+        "value": round(b * steps / (ms / 1e3), 1),
+        "unit": "tokens/s",
+        "vs_baseline": None,
+        "device": device.platform,
+    }
+
+
 def run_benchmarks(only: Optional[str] = None, **kw) -> List[Dict[str, Any]]:
     """Run all registered benchmarks (or one, by substring match).
 
@@ -96,6 +133,7 @@ def run_benchmarks(only: Optional[str] = None, **kw) -> List[Dict[str, Any]]:
         "lab1_n1000": functools.partial(bench_lab1, 1000),
         "lab1_f32_1m": functools.partial(bench_lab1, 1 << 20, dtype="float32"),
         "labformer_fwd": bench_labformer,
+        "labformer_decode": bench_labformer_decode,
     }
     try:
         from tpulab.bench_image import bench_lab2, bench_lab3  # lands with lab2/lab3

@@ -28,6 +28,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     sub.add_parser("bench", help="run the benchmark suite")
     sub.add_parser("train", help="train the flagship model (checkpoint/resume)")
+    sub.add_parser("generate", help="sample from the flagship model (KV-cache decode)")
     sub.add_parser("daemon", help="start the warm-runtime daemon")
 
     args, extra = parser.parse_known_args(argv)
@@ -54,6 +55,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         from tpulab.train import main as train_main
 
         return train_main(extra)
+
+    if args.command == "generate":
+        from tpulab.models.generate import main as gen_main
+
+        return gen_main(extra)
 
     if args.command == "daemon":
         from tpulab.daemon import main as daemon_main

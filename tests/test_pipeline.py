@@ -1,0 +1,60 @@
+"""GPipe pipeline tests: stage-parallel result == sequential scan."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tpulab.parallel.mesh import cpu_test_mesh
+from tpulab.parallel.pipeline import pipeline_apply
+
+
+def mlp_layer(x, layer):
+    return jnp.tanh(x @ layer["w"] + layer["b"])
+
+
+def sequential(params, x):
+    def step(a, layer):
+        return mlp_layer(a, layer), None
+
+    out, _ = jax.lax.scan(step, jnp.asarray(x), params)
+    return np.asarray(out)
+
+
+def _params(rng, n_layers, d):
+    return {
+        "w": jnp.asarray(rng.standard_normal((n_layers, d, d)) * 0.5, jnp.float32),
+        "b": jnp.asarray(rng.standard_normal((n_layers, d)) * 0.1, jnp.float32),
+    }
+
+
+class TestPipeline:
+    @pytest.mark.parametrize("stages,n_micro", [(2, 2), (4, 4), (8, 2), (4, 1)])
+    def test_matches_sequential(self, rng, stages, n_micro):
+        mesh = cpu_test_mesh({"pp": stages})
+        params = _params(rng, n_layers=stages * 2, d=16)
+        x = rng.standard_normal((8, 16)).astype(np.float32)
+        got = np.asarray(pipeline_apply(mlp_layer, params, x, mesh=mesh, n_micro=n_micro))
+        np.testing.assert_allclose(got, sequential(params, x), rtol=1e-5, atol=1e-6)
+
+    def test_single_stage(self, rng):
+        mesh = cpu_test_mesh({"pp": 1})
+        params = _params(rng, n_layers=3, d=8)
+        x = rng.standard_normal((4, 8)).astype(np.float32)
+        got = np.asarray(pipeline_apply(mlp_layer, params, x, mesh=mesh, n_micro=2))
+        np.testing.assert_allclose(got, sequential(params, x), rtol=1e-5, atol=1e-6)
+
+    def test_layers_not_divisible_raises(self, rng):
+        mesh = cpu_test_mesh({"pp": 4})
+        params = _params(rng, n_layers=6, d=8)
+        with pytest.raises(ValueError, match="divisible"):
+            pipeline_apply(mlp_layer, params, np.zeros((4, 8), np.float32), mesh=mesh)
+
+    def test_batch_not_divisible_raises(self, rng):
+        mesh = cpu_test_mesh({"pp": 2})
+        params = _params(rng, n_layers=2, d=8)
+        with pytest.raises(ValueError, match="microbatches"):
+            pipeline_apply(
+                mlp_layer, params, np.zeros((5, 8), np.float32), mesh=mesh, n_micro=4
+            )

@@ -30,6 +30,7 @@ from tpulab.parallel.collectives import (
 from tpulab.parallel.halo import roberts_sharded
 from tpulab.parallel.dsort import distributed_sort
 from tpulab.parallel.classify import classify_sharded
+from tpulab.parallel.pipeline import pipeline_apply
 
 __all__ = [
     "make_mesh",
@@ -46,4 +47,5 @@ __all__ = [
     "ulysses_attention",
     "attention_reference",
     "mesh_anchor",
+    "pipeline_apply",
 ]

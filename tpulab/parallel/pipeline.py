@@ -1,0 +1,139 @@
+"""Microbatched pipeline parallelism (GPipe) over a mesh axis.
+
+The layer stack is sharded over the ``pp`` axis — each device (stage)
+owns ``L / S`` consecutive layers — and the batch is split into ``M``
+microbatches that flow through the stages with one ``lax.ppermute`` per
+tick.  The schedule is plain GPipe: ``M + S - 1`` ticks, every stage
+computing every tick (bubble ticks process garbage that is masked at
+collection), which keeps the program SPMD — exactly one jitted program
+for all stages, collectives riding ICI.
+
+This is the dedicated pipeline component; the labformer model's ``pp``
+axis uses GSPMD layer-sharding (scan over a pp-sharded layer stack) —
+this module is the explicit-schedule alternative with real microbatch
+overlap, verified against sequential execution in tests/test_pipeline.py.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpulab.parallel.mesh import make_mesh, mesh_anchor
+from tpulab.runtime.device import commit
+
+
+def _stage_body(local_params, x_mb, stage_fn: Callable, *, axis: str, n_micro: int):
+    """Runs on ONE pipeline stage (inside shard_map).
+
+    local_params: this stage's slice of the stacked layer params
+    (leading dim = layers-per-stage).  x_mb: (M, mb, ...) full
+    microbatched input, replicated (only stage 0 reads it).
+    """
+    s = jax.lax.axis_index(axis)
+    n_stages = jax.lax.axis_size(axis)
+    ticks = n_micro + n_stages - 1
+
+    def apply_local(act):
+        def one_layer(a, layer):
+            return stage_fn(a, layer), None
+
+        out, _ = jax.lax.scan(one_layer, act, local_params)
+        return out
+
+    mb_shape = x_mb.shape[1:]
+    act0 = jnp.zeros(mb_shape, x_mb.dtype)
+    outs0 = jnp.zeros((n_micro, *mb_shape), x_mb.dtype)
+    # accumulators become device-varying in the loop (axis_index masks)
+    act0 = jax.lax.pcast(act0, (axis,), to="varying")
+    outs0 = jax.lax.pcast(outs0, (axis,), to="varying")
+    x_mb = jax.lax.pcast(x_mb, (axis,), to="varying")
+
+    fwd = [(i, i + 1) for i in range(n_stages - 1)]  # stage i -> i+1, no wrap
+
+    def tick(t, carry):
+        act_in, outs = carry
+        # stage 0 injects microbatch t (clipped: bubble ticks reuse the last)
+        mb = jax.lax.dynamic_index_in_dim(
+            x_mb, jnp.clip(t, 0, n_micro - 1), 0, keepdims=False
+        )
+        act = jnp.where(s == 0, mb, act_in)
+        out = apply_local(act)
+        # the LAST stage finished microbatch (t - (S-1)) this tick
+        done_idx = t - (n_stages - 1)
+        is_last = s == n_stages - 1
+        valid = jnp.logical_and(is_last, done_idx >= 0)
+        store_at = jnp.clip(done_idx, 0, n_micro - 1)
+        cur = jax.lax.dynamic_index_in_dim(outs, store_at, 0, keepdims=False)
+        outs = jax.lax.dynamic_update_index_in_dim(
+            outs, jnp.where(valid, out, cur), store_at, 0
+        )
+        act_next = jax.lax.ppermute(out, axis, fwd)
+        return act_next, outs
+
+    _, outs = jax.lax.fori_loop(0, ticks, tick, (act0, outs0))
+    return outs[None]  # (1, M, mb, ...) -> concatenates to (S, M, mb, ...)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("stage_fn", "mesh", "axis", "n_micro")
+)
+def _pipeline_sharded(params_stacked, x_mb, stage_fn, *, mesh, axis, n_micro):
+    pspec = jax.tree_util.tree_map(lambda _: P(axis), params_stacked)
+    body = functools.partial(_stage_body, stage_fn=stage_fn, axis=axis, n_micro=n_micro)
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(pspec, P()),
+        out_specs=P(axis),
+    )(params_stacked, x_mb)
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    params_stacked,
+    x,
+    *,
+    mesh: Mesh = None,
+    axis: str = "pp",
+    n_micro: int = 4,
+):
+    """Apply ``L`` stacked layers to ``x`` with GPipe over ``mesh[axis]``.
+
+    ``stage_fn(activation, layer_params) -> activation`` is one layer;
+    ``params_stacked`` is a pytree whose leaves have leading dim ``L``
+    (divisible by the axis size); ``x`` is ``(B, ...)`` with ``B``
+    divisible by ``n_micro``.  Returns ``stage_fn`` applied through all
+    layers, identical to a sequential scan.
+    """
+    mesh = mesh or make_mesh(axes=(axis,))
+    n_stages = mesh.shape[axis]
+    leaves = jax.tree_util.tree_leaves(params_stacked)
+    n_layers = leaves[0].shape[0]
+    if n_layers % n_stages:
+        raise ValueError(f"{n_layers} layers not divisible by {n_stages} stages")
+    if x.shape[0] % n_micro:
+        raise ValueError(f"batch {x.shape[0]} not divisible by {n_micro} microbatches")
+
+    anchor = mesh_anchor(mesh)
+    params_staged = jax.tree_util.tree_map(
+        lambda p: jax.device_put(
+            commit(p, anchor), NamedSharding(mesh, P(axis))
+        ),
+        params_stacked,
+    )
+    xj = commit(x, anchor)
+    mb = x.shape[0] // n_micro
+    x_mb = jax.device_put(
+        xj.reshape(n_micro, mb, *x.shape[1:]), NamedSharding(mesh, P())
+    )
+
+    outs = _pipeline_sharded(
+        params_staged, x_mb, stage_fn, mesh=mesh, axis=axis, n_micro=n_micro
+    )
+    # (S, M, mb, ...): only the last stage's buffer is valid
+    return outs[-1].reshape(x.shape)

@@ -121,6 +121,49 @@ def bench_labformer_decode(
     }
 
 
+def bench_sort(n: int = 1 << 20, reps: int = 20) -> Dict[str, Any]:
+    """hw2/lab5 sort tier: jnp.sort of n f32 keys (kernel-only)."""
+    import jax.numpy as jnp
+
+    from tpulab.ops.sortops import sort_ascending
+    from tpulab.runtime.device import commit, default_device
+    from tpulab.runtime.timing import measure_kernel_ms
+
+    device = default_device()
+    x = commit(np.random.default_rng(0).standard_normal(n).astype(np.float32), device)
+    ms, _ = measure_kernel_ms(sort_ascending, (x,), iters=max(reps, 50), outer=5)
+    return {
+        "metric": f"hw2_sort_n{n}_f32_median_ms",
+        "value": round(ms, 6),
+        "unit": "ms",
+        "vs_baseline": None,  # reference hw2 is a serial bubble sort (no number)
+        "device": device.platform,
+    }
+
+
+def bench_reduce(n: int = 1 << 24, reps: int = 50) -> Dict[str, Any]:
+    """lab5 reduction tier: sum of n int32 (kernel-only)."""
+    import jax.numpy as jnp
+
+    from tpulab.ops.reduction import _reduce
+    from tpulab.runtime.device import commit, default_device
+    from tpulab.runtime.timing import measure_ms
+
+    device = default_device()
+    x = commit(
+        np.random.default_rng(0).integers(-100, 100, n).astype(np.int32), device
+    )
+    # reduce is not chainable (scalar out) — queue-amortized dispatch timing
+    ms, _ = measure_ms(lambda v: _reduce(v, "sum"), (x,), warmup=3, reps=max(reps, 50))
+    return {
+        "metric": f"lab5_reduce_sum_n{n}_i32_median_ms",
+        "value": round(ms, 6),
+        "unit": "ms",
+        "vs_baseline": None,  # lab5 source never committed (SURVEY.md section 0)
+        "device": device.platform,
+    }
+
+
 def run_benchmarks(only: Optional[str] = None, **kw) -> List[Dict[str, Any]]:
     """Run all registered benchmarks (or one, by substring match).
 
@@ -134,6 +177,8 @@ def run_benchmarks(only: Optional[str] = None, **kw) -> List[Dict[str, Any]]:
         "lab1_f32_1m": functools.partial(bench_lab1, 1 << 20, dtype="float32"),
         "labformer_fwd": bench_labformer,
         "labformer_decode": bench_labformer_decode,
+        "hw2_sort": bench_sort,
+        "lab5_reduce": bench_reduce,
     }
     try:
         from tpulab.bench_image import bench_lab2, bench_lab3  # lands with lab2/lab3

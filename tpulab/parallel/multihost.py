@@ -1,0 +1,113 @@
+"""Multi-host bring-up: the distributed communication backend.
+
+What MPI_Init + communicator setup is to the reference's (promised, never
+shipped — SURVEY.md section 0) MPI tier, this module is to a TPU pod or
+multi-slice deployment:
+
+* :func:`initialize` — ``jax.distributed.initialize`` with TPU-pod
+  autodetection (on Cloud TPU the coordinator/process count come from
+  the metadata environment; explicit args serve DCN/multi-slice or
+  GPU-style launches).  Collectives then ride ICI within a slice and
+  DCN across slices — no NCCL/MPI anywhere.
+* :func:`global_mesh` — a Mesh over ALL processes' devices, with the
+  axis order chosen so the innermost axes map to ICI neighbors
+  (jax device order is already host-major; keeping ``dp`` outermost
+  puts cross-host traffic on the gradient all-reduce only).
+* :func:`host_shard_to_global` — assemble a globally-sharded array from
+  each host's local shard (``jax.make_array_from_process_local_data``),
+  the standard multi-host input pipeline.
+
+Single-process calls are no-ops / plain constructions, so every code
+path here also runs (and is tested) on one host with virtual devices.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpulab.parallel.mesh import best_factorization
+
+
+def initialize(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    local_device_ids: Optional[Sequence[int]] = None,
+) -> bool:
+    """Join the multi-process runtime; returns True if initialized.
+
+    With no arguments on a TPU pod, jax autodetects everything from the
+    TPU metadata environment.  Outside a distributed launch (no args, no
+    coordinator env) this is a no-op returning False — single-process
+    development just works.
+    """
+    explicit = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    on_pod_env = any(
+        os.environ.get(k)
+        for k in ("TPU_WORKER_HOSTNAMES", "MEGASCALE_COORDINATOR_ADDRESS")
+    )
+    if not explicit and not on_pod_env:
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+        local_device_ids=local_device_ids,
+    )
+    return True
+
+
+def runtime_info() -> Dict[str, int]:
+    """Process/device counts of the current (possibly multi-host) runtime."""
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_device_count": jax.local_device_count(),
+        "global_device_count": jax.device_count(),
+    }
+
+
+def global_mesh(
+    axes: Sequence[str] = ("dp", "sp", "tp", "pp"),
+    axis_sizes: Optional[Dict[str, int]] = None,
+    *,
+    backend: Optional[str] = None,
+) -> Mesh:
+    """Mesh over every device of every process.
+
+    ``jax.devices()`` orders devices host-major, so factoring with the
+    leading axis largest keeps one host's devices contiguous along the
+    trailing (bandwidth-hungry: tp/pp) axes — cross-host DCN traffic
+    lands on the leading ``dp`` axis where only gradient all-reduces
+    travel.
+    """
+    devs = jax.devices(backend) if backend else jax.devices()
+    if axis_sizes is None:
+        axis_sizes = best_factorization(len(devs), axes)
+    shape = tuple(axis_sizes[a] for a in axes)
+    return Mesh(np.asarray(devs).reshape(shape), tuple(axes))
+
+
+def host_shard_to_global(local_data: np.ndarray, mesh: Mesh, spec: P) -> jax.Array:
+    """Assemble a global array from this process's local batch shard.
+
+    Each process passes only ITS rows (e.g. its slice of the global
+    batch); the result is a single global jax.Array sharded per
+    ``spec``.  On one process this equals ``device_put`` with the same
+    sharding.
+    """
+    sharding = NamedSharding(mesh, spec)
+    return jax.make_array_from_process_local_data(sharding, local_data)
+
+
+def sync_global_devices(tag: str = "tpulab") -> None:
+    """Barrier across all processes (no-op single-process)."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(tag)

@@ -31,6 +31,12 @@ from tpulab.parallel.halo import roberts_sharded
 from tpulab.parallel.dsort import distributed_sort
 from tpulab.parallel.classify import classify_sharded
 from tpulab.parallel.pipeline import pipeline_apply
+from tpulab.parallel.multihost import (
+    global_mesh,
+    host_shard_to_global,
+    initialize as initialize_multihost,
+    sync_global_devices,
+)
 
 __all__ = [
     "make_mesh",
@@ -48,4 +54,8 @@ __all__ = [
     "attention_reference",
     "mesh_anchor",
     "pipeline_apply",
+    "global_mesh",
+    "host_shard_to_global",
+    "initialize_multihost",
+    "sync_global_devices",
 ]

@@ -27,7 +27,6 @@ import os
 from typing import Dict, Optional, Sequence
 
 import jax
-import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpulab.parallel.mesh import best_factorization
@@ -78,19 +77,23 @@ def global_mesh(
     *,
     backend: Optional[str] = None,
 ) -> Mesh:
-    """Mesh over every device of every process.
+    """Mesh over every device of every process, host-locality aware.
 
-    ``jax.devices()`` orders devices host-major, so factoring with the
-    leading axis largest keeps one host's devices contiguous along the
-    trailing (bandwidth-hungry: tp/pp) axes — cross-host DCN traffic
-    lands on the leading ``dp`` axis where only gradient all-reduces
-    travel.
+    ``jax.devices()`` orders devices host-major, so the LEADING axis
+    must absorb the process count: the trailing (bandwidth-hungry:
+    sp/tp/pp) axes are factored from the LOCAL device count only and
+    therefore never span hosts — cross-host DCN traffic lands on the
+    leading ``dp`` axis, where only gradient all-reduces travel.
     """
-    devs = jax.devices(backend) if backend else jax.devices()
+    from tpulab.parallel.mesh import make_mesh
+
     if axis_sizes is None:
-        axis_sizes = best_factorization(len(devs), axes)
-    shape = tuple(axis_sizes[a] for a in axes)
-    return Mesh(np.asarray(devs).reshape(shape), tuple(axes))
+        n_local = jax.local_device_count(backend)
+        n_proc = jax.process_count()
+        inner = best_factorization(n_local, axes[1:]) if len(axes) > 1 else {}
+        axis_sizes = {axes[0]: n_proc, **{a: inner[a] for a in axes[1:]}}
+    ordered = {a: axis_sizes[a] for a in axes}
+    return make_mesh(ordered, backend=backend)
 
 
 def host_shard_to_global(local_data: np.ndarray, mesh: Mesh, spec: P) -> jax.Array:

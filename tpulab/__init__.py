@@ -25,6 +25,8 @@ Layout:
     tpulab.utils     ImgData tri-format converter, config coercion, downloads
 """
 
+import os
+
 import jax
 
 __version__ = "0.1.0"
@@ -35,6 +37,18 @@ __version__ = "0.1.0"
 # backend explicitly (TPUs have no native f64); f32/bf16 fast paths pass
 # explicit dtypes everywhere, so enabling x64 globally is safe.
 jax.config.update("jax_enable_x64", True)
+
+# Persistent XLA compilation cache: the harness's subprocess-per-run
+# model (reference tester.py:126) would otherwise recompile every kernel
+# in every process (SURVEY.md section 7 "hard parts").  Opt out with
+# TPULAB_COMPILE_CACHE=0; point it elsewhere with a path.
+_cache = os.environ.get(
+    "TPULAB_COMPILE_CACHE",
+    os.path.join(os.path.expanduser("~"), ".cache", "tpulab-jax"),
+)
+if _cache not in ("0", ""):
+    jax.config.update("jax_compilation_cache_dir", _cache)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
 
 from tpulab.runtime.device import cpu_device, default_device, device_info  # noqa: E402
 from tpulab.runtime.timing import format_timing_line, measure_ms  # noqa: E402

@@ -49,6 +49,17 @@ class TestForward:
         np.testing.assert_allclose(l1[:, :-1], l2[:, :-1], rtol=1e-5, atol=1e-5)
         assert not np.allclose(l1[:, -1], l2[:, -1])
 
+    def test_flash_backend_matches_dense(self, rng):
+        import dataclasses
+
+        dense_cfg = dataclasses.replace(CFG, attn_impl="dense")
+        flash_cfg = dataclasses.replace(CFG, attn_impl="flash")
+        params = init_params(dense_cfg, seed=0)
+        tokens = _tokens(rng, b=2, s=64)
+        a = np.asarray(forward(params, tokens, dense_cfg))
+        b = np.asarray(forward(params, tokens, flash_cfg))
+        np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-4)
+
     def test_moe_forward(self, rng):
         cfg = LabformerConfig(d_model=32, n_heads=4, n_layers=2, d_ff=64, n_experts=4)
         params = init_params(cfg, seed=0)

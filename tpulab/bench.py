@@ -121,6 +121,35 @@ def bench_labformer_decode(
     }
 
 
+def bench_flash_attention(s: int = 32768, reps: int = 5) -> Dict[str, Any]:
+    """Long-context tier: Pallas flash attention at a sequence length
+    where dense attention cannot fit (scores at s=32768 x 8 heads =
+    32 GB f32 > HBM)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpulab.ops.pallas.attention import flash_attention
+    from tpulab.runtime.device import commit, default_device
+    from tpulab.runtime.timing import measure_ms
+
+    device = default_device()
+    rng = np.random.default_rng(0)
+    q, k, v = (
+        commit(rng.standard_normal((1, s, 8, 64)).astype(np.float32), device,
+               jnp.bfloat16)
+        for _ in range(3)
+    )
+    ms, _ = measure_ms(lambda q, k, v: flash_attention(q, k, v), (q, k, v),
+                       warmup=2, reps=max(reps, 5))
+    return {
+        "metric": f"flash_attention_s{s}_h8_d64_bf16_median_ms",
+        "value": round(ms, 4),
+        "unit": "ms",
+        "vs_baseline": None,  # dense attention OOMs at this length
+        "device": device.platform,
+    }
+
+
 def bench_sort(n: int = 1 << 20, reps: int = 20) -> Dict[str, Any]:
     """hw2/lab5 sort tier: jnp.sort of n f32 keys.
 
@@ -179,6 +208,7 @@ def run_benchmarks(only: Optional[str] = None, **kw) -> List[Dict[str, Any]]:
         "labformer_decode": bench_labformer_decode,
         "hw2_sort": bench_sort,
         "lab5_reduce": bench_reduce,
+        "flash_attention": bench_flash_attention,
     }
     try:
         from tpulab.bench_image import bench_lab2, bench_lab3  # lands with lab2/lab3

@@ -54,6 +54,9 @@ class LabformerConfig:
     max_seq: int = 1024
     rope_theta: float = 10000.0
     dtype: Any = jnp.float32  # params/activations (bfloat16 on real TPU)
+    # attention backend: "dense" (O(s^2) reference), "flash" (Pallas
+    # blockwise, O(s) memory), or "auto" (flash from 1024 tokens up)
+    attn_impl: str = "auto"
 
     @property
     def head_dim(self) -> int:
@@ -177,9 +180,15 @@ def _attention(x, layer, cfg: LabformerConfig, mesh: Optional[Mesh], positions):
             body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
         )(q, k, v)
     else:
-        from tpulab.parallel.ring import attention_reference
+        use_flash = cfg.attn_impl == "flash" or (cfg.attn_impl == "auto" and s >= 1024)
+        if use_flash:
+            from tpulab.ops.pallas.attention import flash_attention
 
-        o = attention_reference(q, k, v, causal=True)
+            o = flash_attention(q, k, v, causal=True)
+        else:
+            from tpulab.parallel.ring import attention_reference
+
+            o = attention_reference(q, k, v, causal=True)
     return o.reshape(b, s, d) @ layer["wo"]
 
 

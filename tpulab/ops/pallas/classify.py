@@ -36,29 +36,39 @@ def launch_to_rows(launch: Optional[Tuple[int, int]]) -> int:
     return max(MIN_ROWS, min(MAX_ROWS, rows))
 
 
-def _classify_kernel(mu_ref, ic_ref, r_ref, g_ref, b_ref, out_ref, *, nc: int):
-    min_dist = jnp.full(r_ref.shape, jnp.inf, jnp.float32)
-    best = jnp.zeros(r_ref.shape, jnp.int32)
+def _classify_kernel(mu_ref, ic_ref, u_ref, out_ref, *, nc: int):
+    """Tile of PACKED uint32 RGBA pixels -> int32 labels.
+
+    In-kernel byte unpack (1 u32 load instead of 3 f32 plane loads per
+    pixel — 3x less VMEM traffic and no strided plane split outside).
+    All constants pinned to 32-bit types: Python ints lower as i64 under
+    the global x64 config, which Mosaic cannot legalize."""
+    u = u_ref[:]
+    mask = jnp.uint32(0xFF)
+
+    def byte_f32(x):
+        # Mosaic has no u32->f32 cast; bitcast the masked byte (<=255,
+        # sign-safe) to i32 first
+        return jax.lax.bitcast_convert_type(x & mask, jnp.int32).astype(jnp.float32)
+
+    planes = (byte_f32(u), byte_f32(u >> jnp.uint32(8)), byte_f32(u >> jnp.uint32(16)))
+    min_dist = jnp.full(u.shape, jnp.inf, jnp.float32)
+    best = jnp.zeros(u.shape, jnp.int32)
     for c in range(nc):  # static unroll — the constant-memory class loop
-        dr = r_ref[:] - mu_ref[c, 0]
-        dg = g_ref[:] - mu_ref[c, 1]
-        db = b_ref[:] - mu_ref[c, 2]
-        d = (dr, dg, db)
-        dist = jnp.zeros(r_ref.shape, jnp.float32)
+        d = tuple(planes[i] - mu_ref[c, i] for i in range(3))
+        dist = jnp.zeros(u.shape, jnp.float32)
         for i in range(3):
             t_i = d[0] * ic_ref[c, 0, i] + d[1] * ic_ref[c, 1, i] + d[2] * ic_ref[c, 2, i]
             dist = dist + t_i * d[i]
         upd = dist < min_dist  # strict <: first minimal class wins
-        # jnp.int32(c), not c: a Python int promotes to i64 under the
-        # framework's global x64, which Mosaic cannot lower
         best = jnp.where(upd, jnp.int32(c), best)
         min_dist = jnp.where(upd, dist, min_dist)
     out_ref[:] = best
 
 
 @functools.partial(jax.jit, static_argnames=("tile_rows", "nc", "interpret"))
-def _classify_planes(r2d, g2d, b2d, mu, ic, tile_rows: int, nc: int, interpret: bool):
-    rows = r2d.shape[0]
+def _classify_packed(u2d, mu, ic, tile_rows: int, nc: int, interpret: bool):
+    rows = u2d.shape[0]
     grid = (pl.cdiv(rows, tile_rows),)
     # jnp.int32(0) created INSIDE each index map (a captured constant is
     # rejected by pallas): under the framework's global x64 a Python-int
@@ -72,12 +82,12 @@ def _classify_planes(r2d, g2d, b2d, mu, ic, tile_rows: int, nc: int, interpret: 
     )
     return pl.pallas_call(
         functools.partial(_classify_kernel, nc=nc),
-        out_shape=jax.ShapeDtypeStruct(r2d.shape, jnp.int32),
+        out_shape=jax.ShapeDtypeStruct(u2d.shape, jnp.int32),
         grid=grid,
-        in_specs=[smem(mu.shape), smem(ic.shape), plane, plane, plane],
+        in_specs=[smem(mu.shape), smem(ic.shape), plane],
         out_specs=plane,
         interpret=interpret,
-    )(mu, ic, r2d, g2d, b2d)
+    )(mu, ic, u2d)
 
 
 @functools.partial(jax.jit, static_argnames=("tile_rows", "interpret"))
@@ -89,13 +99,10 @@ def _classify_pallas_jit(pixels_u8, mean, inv_cov, tile_rows: int, interpret: bo
     n = h * w
     rows = -(-max(1, -(-n // LANES)) // tile_rows) * tile_rows
     padded = rows * LANES
-    rgb = pixels_u8[..., :3].astype(jnp.float32).reshape(n, 3)
-    rgb = jnp.pad(rgb, ((0, padded - n), (0, 0)))
-    planes = rgb.reshape(rows, LANES, 3)
-    labels = _classify_planes(
-        planes[..., 0],
-        planes[..., 1],
-        planes[..., 2],
+    u = jax.lax.bitcast_convert_type(pixels_u8, jnp.uint32).reshape(n)
+    u = jnp.pad(u, (0, padded - n))
+    labels = _classify_packed(
+        u.reshape(rows, LANES),
         mean.astype(jnp.float32),
         inv_cov.astype(jnp.float32),
         tile_rows,

@@ -182,3 +182,28 @@ class TestClient:
     def test_client_rejects_bad_usage(self, built_native):
         r = subprocess.run([str(CLIENT)], capture_output=True, text=True)
         assert r.returncode == 2
+
+
+class TestHarnessDrivesClient:
+    def test_full_stack(self, daemon, tmp_path):
+        """harness -> native client subprocess -> daemon -> warm JAX:
+        the reference's run_test.py flow with the compiled binary."""
+        env = dict(os.environ)
+        env.update(
+            TPULAB_DAEMON_SOCKET=daemon,
+            JAX_PLATFORMS="cpu",
+            PALLAS_AXON_POOL_IPS="",
+            PYTHONPATH=str(ROOT),
+        )
+        art = tmp_path / "art"
+        r = subprocess.run(
+            [sys.executable, "-m", "tpulab.harness.run",
+             "--lab", "lab1",
+             "--binary-path", str(CLIENT),
+             "--binary-args", "lab1 --warmup 0 --reps 1",
+             "--k-times", "2",
+             "--artifact-dir", str(art)],
+            env=env, capture_output=True, text=True, timeout=300, cwd=str(ROOT),
+        )
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert (art / "stats_tpulab_client.csv").exists(), list(art.iterdir())

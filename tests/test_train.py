@@ -90,6 +90,26 @@ class TestMeshTraining:
         assert np.isfinite(loss)
 
 
+class TestMemoryLevers:
+    def test_remat_matches_plain(self):
+        """jax.checkpoint changes memory, not math: losses must agree."""
+        import dataclasses
+
+        _, plain = train(steps=4, batch=4, seq=32, cfg=TINY, log=_quiet)
+        _, remat = train(
+            steps=4, batch=4, seq=32,
+            cfg=dataclasses.replace(TINY, remat=True), log=_quiet,
+        )
+        assert abs(plain - remat) < 1e-5, (plain, remat)
+
+    def test_grad_accumulation_matches_full_batch(self):
+        """accum=4 microbatches of 2 == one batch of 8 (mean CE over the
+        same token set; adamw sees the averaged gradient)."""
+        _, full = train(steps=4, batch=8, seq=32, cfg=TINY, log=_quiet)
+        _, accum = train(steps=4, batch=8, seq=32, cfg=TINY, accum=4, log=_quiet)
+        assert abs(full - accum) < 1e-4, (full, accum)
+
+
 class TestCLI:
     def test_cli_smoke(self, tmp_path):
         env = dict(os.environ)

@@ -44,6 +44,9 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="external binary speaking the stdin contract")
     p.add_argument("--binary-path-cpu", "--binary_path_cpu", dest="binary_path_cpu",
                    help="external CPU reference binary")
+    p.add_argument("--binary-args", "--binary_args", dest="binary_args", default=None,
+                   help="extra argv for --binary-path, e.g. 'lab2 --to-plot' for the "
+                        "native daemon client (env TPULAB_DAEMON_SOCKET selects the daemon)")
     p.add_argument("--cpu-ref", action="store_true",
                    help="run the in-process CPU backend as the A/B reference")
     p.add_argument("--k-times", "--k_times", type=int, default=20)
@@ -66,10 +69,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     sweep = args.kernel_sizes is not None
 
     if args.binary_path:
+        extra_argv = args.binary_args.split() if args.binary_args else []
         target = SubprocessTarget(
             name=os.path.basename(args.binary_path),
             device_label="BIN",
-            argv=[args.binary_path],
+            argv=[args.binary_path, *extra_argv],
         )
         artifact_dir = args.artifact_dir or os.path.dirname(os.path.abspath(args.binary_path))
     else:

@@ -57,6 +57,10 @@ class LabformerConfig:
     # attention backend: "dense" (O(s^2) reference), "flash" (Pallas
     # blockwise, O(s) memory), or "auto" (flash from 1024 tokens up)
     attn_impl: str = "auto"
+    # rematerialize each block in backward (jax.checkpoint): trades
+    # ~30% more FLOPs for activation memory that no longer scales with
+    # n_layers — the HBM-vs-FLOPs lever for long-context training
+    remat: bool = False
 
     @property
     def head_dim(self) -> int:
@@ -231,6 +235,8 @@ def forward(params, tokens, cfg: LabformerConfig, mesh: Optional[Mesh] = None):
             )
         return x, None
 
+    if cfg.remat:
+        block = jax.checkpoint(block)
     x, _ = jax.lax.scan(block, x, params["blocks"])
     x = _rmsnorm(x, params["final_norm"])
     return x @ params["embed"].T  # tied output head
@@ -245,15 +251,37 @@ def loss_fn(params, tokens, cfg: LabformerConfig, mesh: Optional[Mesh] = None):
     return -jnp.mean(ll)
 
 
-def make_train_step(cfg: LabformerConfig, mesh: Optional[Mesh], optimizer=None):
-    """Jitted (params, opt_state, tokens) -> (params, opt_state, loss)."""
+def make_train_step(
+    cfg: LabformerConfig, mesh: Optional[Mesh], optimizer=None, accum: int = 1
+):
+    """Jitted (params, opt_state, tokens) -> (params, opt_state, loss).
+
+    ``accum > 1`` splits the batch into ``accum`` microbatches and
+    averages their gradients inside one jitted step (``lax.scan``) —
+    the effective batch grows without growing activation memory.
+    """
     import optax
 
     optimizer = optimizer or optax.adamw(3e-4)
 
     @jax.jit
     def train_step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg, mesh)
+        if accum <= 1:
+            loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg, mesh)
+        else:
+            micro = tokens.reshape(accum, tokens.shape[0] // accum, tokens.shape[1])
+
+            def one(carry, mb):
+                loss_acc, grads_acc = carry
+                loss, grads = jax.value_and_grad(loss_fn)(params, mb, cfg, mesh)
+                grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc, grads)
+                return (loss_acc + loss, grads_acc), None
+
+            zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+            (loss, grads), _ = jax.lax.scan(one, (jnp.float32(0.0), zeros), micro)
+            inv = jnp.float32(1.0 / accum)
+            loss = loss * inv
+            grads = jax.tree_util.tree_map(lambda g: g * inv.astype(g.dtype), grads)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
@@ -261,11 +289,17 @@ def make_train_step(cfg: LabformerConfig, mesh: Optional[Mesh], optimizer=None):
     return optimizer, train_step
 
 
-def init_train_state(cfg: LabformerConfig, mesh: Optional[Mesh], seed: int = 0, optimizer=None):
+def init_train_state(
+    cfg: LabformerConfig,
+    mesh: Optional[Mesh],
+    seed: int = 0,
+    optimizer=None,
+    accum: int = 1,
+):
     params = init_params(cfg, seed)
     if mesh is not None:
         params = shard_params(params, cfg, mesh)
-    optimizer, train_step = make_train_step(cfg, mesh, optimizer)
+    optimizer, train_step = make_train_step(cfg, mesh, optimizer, accum=accum)
     opt_state = optimizer.init(params)
     return params, opt_state, train_step
 

@@ -63,6 +63,8 @@ def train(
     log=print,
     cfg=None,
     optimizer=None,
+    accum: int = 1,
+    remat: bool = False,
 ):
     """Run the loop; returns (final_step, last_loss)."""
     import jax
@@ -74,11 +76,15 @@ def train(
     from tpulab.parallel.mesh import make_mesh
     from tpulab.runtime.trace import maybe_trace
 
-    cfg = cfg or LabformerConfig(d_model=128, n_heads=8, n_layers=4, d_ff=512, max_seq=seq)
+    cfg = cfg or LabformerConfig(
+        d_model=128, n_heads=8, n_layers=4, d_ff=512, max_seq=seq, remat=remat
+    )
     mesh = None
     if mesh_devices:
         mesh = make_mesh(n_devices=mesh_devices, axes=("dp", "sp", "tp", "pp"))
-    params, opt_state, train_step = init_train_state(cfg, mesh, seed=seed, optimizer=optimizer)
+    params, opt_state, train_step = init_train_state(
+        cfg, mesh, seed=seed, optimizer=optimizer, accum=accum
+    )
 
     start_step = 0
     manager = None
@@ -147,6 +153,8 @@ def main(argv=None) -> int:
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--sanitize", action="store_true", help="jax_debug_nans")
     ap.add_argument("--trace-dir", default=None, help="JAX profiler output dir")
+    ap.add_argument("--accum", type=int, default=1, help="gradient-accumulation microbatches")
+    ap.add_argument("--remat", action="store_true", help="rematerialize blocks (jax.checkpoint)")
     args = ap.parse_args(argv)
     step, loss = train(
         steps=args.steps,
@@ -159,6 +167,8 @@ def main(argv=None) -> int:
         seed=args.seed,
         sanitize=args.sanitize,
         trace_dir=args.trace_dir,
+        accum=args.accum,
+        remat=args.remat,
     )
     print(json.dumps({"final_step": step, "loss": loss}))
     return 0

@@ -31,6 +31,7 @@ from tpulab.parallel.halo import roberts_sharded
 from tpulab.parallel.dsort import distributed_sort
 from tpulab.parallel.classify import classify_sharded
 from tpulab.parallel.pipeline import pipeline_apply
+from tpulab.parallel.moe import switch_moe, switch_moe_reference
 from tpulab.parallel.multihost import (
     global_mesh,
     host_shard_to_global,
@@ -54,6 +55,8 @@ __all__ = [
     "attention_reference",
     "mesh_anchor",
     "pipeline_apply",
+    "switch_moe",
+    "switch_moe_reference",
     "global_mesh",
     "host_shard_to_global",
     "initialize_multihost",

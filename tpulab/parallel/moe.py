@@ -1,0 +1,151 @@
+"""Expert parallelism: top-1 switch MoE with all-to-all token dispatch.
+
+The labformer's in-model MoE (:func:`tpulab.models.labformer._mlp`)
+computes every expert densely and one-hot selects — exact, but E× the
+FLOPs.  This module is the production-shaped alternative: experts are
+SHARDED over the fused ``(dp, sp)`` submesh (DeepSpeed-MoE style — ep
+rides the data axes), and each token travels to its expert's owner
+through one ``lax.all_to_all``, computes there in an expert-batched
+matmul, and returns through a second all-to-all.
+
+Routing is top-1 (switch) with per-expert, per-source capacity ``C``;
+tokens over capacity are dropped (their output is the zero vector, the
+standard switch-transformer behavior).  With ``C >= local tokens`` the
+result is EXACT and equals the dense-gate oracle — that equivalence is
+the correctness test.
+
+Layout walk-through (per device, inside shard_map; ``P`` devices on the
+fused axis, ``E`` experts, ``E_loc = E/P`` local experts, ``n`` local
+tokens, capacity ``C``):
+
+    send[e, c, d]   token buffers bucketed by GLOBAL expert id
+    -> reshape (P, E_loc, C, d), all_to_all over dim 0
+    recv[p, e_loc, c, d]   = source p's bucket for MY local experts
+    -> (E_loc, P*C, d) expert-batched FFN (one einsum pair)
+    -> inverse all_to_all, gather back by (expert, slot), scale by gate
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from tpulab.parallel.mesh import make_mesh, mesh_anchor
+from tpulab.runtime.device import commit
+
+AxisName = Union[str, Tuple[str, ...]]
+
+
+def _moe_body(x, router_w, w1_loc, w2_loc, *, axis: AxisName, n_experts: int,
+              capacity: int):
+    """Per-device switch-MoE over local tokens (runs in shard_map).
+
+    x: (n, d) local tokens; router_w: (d, E) replicated;
+    w1_loc/w2_loc: (E_loc, d, ff)/(E_loc, ff, d) this device's experts.
+    """
+    n, d = x.shape
+    p = jax.lax.axis_size(axis)
+    e_loc = n_experts // p
+    c = capacity
+
+    gate_logits = x @ router_w                                    # (n, E)
+    gate = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    eid = jnp.argmax(gate, axis=-1).astype(jnp.int32)             # (n,)
+    gval = jnp.max(gate, axis=-1).astype(x.dtype)                 # (n,)
+
+    eoh = jax.nn.one_hot(eid, n_experts, dtype=jnp.int32)         # (n, E)
+    # slot within the expert's bucket: running count of earlier tokens
+    # routed to the same expert
+    pos = jnp.sum(jnp.cumsum(eoh, axis=0) * eoh, axis=-1) - 1     # (n,)
+    keep = pos < c
+    slot = jnp.clip(pos, 0, c - 1)
+
+    send = jnp.zeros((n_experts, c, d), x.dtype)
+    contrib = jnp.where(keep[:, None], x, jnp.zeros_like(x))
+    send = send.at[eid, slot].add(contrib)                        # dropped -> +0
+    send = send.reshape(p, e_loc, c, d)
+    recv = jax.lax.all_to_all(send, axis, split_axis=0, concat_axis=0, tiled=True)
+
+    tok = jnp.moveaxis(recv, 1, 0).reshape(e_loc, p * c, d)       # (E_loc, PC, d)
+    hid = jax.nn.gelu(jnp.einsum("ekd,edf->ekf", tok, w1_loc))
+    out = jnp.einsum("ekf,efd->ekd", hid, w2_loc)                 # (E_loc, PC, d)
+
+    back = jnp.moveaxis(out.reshape(e_loc, p, c, d), 0, 1)        # (P, E_loc, C, d)
+    ret = jax.lax.all_to_all(back, axis, split_axis=0, concat_axis=0, tiled=True)
+    ret = ret.reshape(n_experts, c, d)
+
+    y = ret[eid, slot]                                            # (n, d)
+    scale = jnp.where(keep, gval, jnp.zeros_like(gval))
+    return y * scale[:, None]
+
+
+def switch_moe_reference(x, router_w, w1, w2):
+    """Dense-gate oracle: compute every expert, one-hot select (the
+    labformer in-model formulation; exact, E-fold compute)."""
+    gate = jax.nn.softmax((x @ router_w).astype(jnp.float32), axis=-1)
+    eid = jnp.argmax(gate, axis=-1)
+    onehot = jax.nn.one_hot(eid, w1.shape[0], dtype=x.dtype)
+    gval = jnp.max(gate, axis=-1).astype(x.dtype)
+    hid = jax.nn.gelu(jnp.einsum("nd,edf->nef", x, w1))
+    out = jnp.einsum("nef,efd->ned", hid, w2)
+    return jnp.einsum("ned,ne->nd", out, onehot) * gval[:, None]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("mesh", "axis", "n_experts", "capacity")
+)
+def _switch_moe_sharded(x, router_w, w1, w2, *, mesh, axis, n_experts, capacity):
+    body = functools.partial(
+        _moe_body, axis=axis, n_experts=n_experts, capacity=capacity
+    )
+    axes = axis if isinstance(axis, tuple) else (axis,)
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(axes, None), P(), P(axes, None, None), P(axes, None, None)),
+        out_specs=P(axes, None),
+    )(x, router_w, w1, w2)
+
+
+def switch_moe(
+    tokens,
+    router_w,
+    w1,
+    w2,
+    *,
+    mesh: Optional[Mesh] = None,
+    axis: AxisName = "ep",
+    capacity_factor: float = 1.25,
+) -> jax.Array:
+    """Top-1 switch MoE with expert parallelism over ``mesh[axis]``.
+
+    ``tokens``: (N, d) sharded over the (possibly fused) axis;
+    ``w1``/(E, d, ff), ``w2``/(E, ff, d) sharded over experts;
+    ``router_w``/(d, E) replicated.  N and E must divide the axis size.
+    ``capacity_factor`` scales the per-expert, per-source bucket
+    (``C = ceil(cf * n_local / E)``); overflow tokens output zero.
+    """
+    mesh = mesh or make_mesh(axes=(axis,) if isinstance(axis, str) else axis)
+    axes = axis if isinstance(axis, tuple) else (axis,)
+    p = int(np.prod([mesh.shape[a] for a in axes]))
+    n_experts = w1.shape[0]
+    if n_experts % p:
+        raise ValueError(f"{n_experts} experts not divisible by axis size {p}")
+    if tokens.shape[0] % p:
+        raise ValueError(f"{tokens.shape[0]} tokens not divisible by axis size {p}")
+    n_local = tokens.shape[0] // p
+    capacity = max(1, int(np.ceil(capacity_factor * n_local / n_experts)))
+
+    anchor = mesh_anchor(mesh)
+    x = jax.device_put(commit(tokens, anchor), NamedSharding(mesh, P(axes, None)))
+    rw = jax.device_put(commit(router_w, anchor), NamedSharding(mesh, P()))
+    w1 = jax.device_put(commit(w1, anchor), NamedSharding(mesh, P(axes, None, None)))
+    w2 = jax.device_put(commit(w2, anchor), NamedSharding(mesh, P(axes, None, None)))
+    return _switch_moe_sharded(
+        x, rw, w1, w2, mesh=mesh, axis=axis, n_experts=n_experts, capacity=capacity
+    )

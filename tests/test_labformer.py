@@ -96,6 +96,24 @@ class TestSharded:
         got = np.asarray(jax.jit(lambda p, t: forward(p, t, CFG, mesh=mesh))(sharded, tok_sh))
         np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
 
+    def test_dispatch_moe_parity(self, mesh, rng):
+        """all_to_all expert dispatch == dense-gate MoE at full capacity."""
+        import dataclasses
+
+        base = dataclasses.replace(CFG, n_experts=4)
+        dispatch = dataclasses.replace(
+            base, moe_impl="dispatch", moe_capacity_factor=float(base.n_experts)
+        )
+        params = init_params(base, seed=0)
+        tokens = _tokens(rng, b=4, s=32)
+        want = np.asarray(forward(params, tokens, base, mesh=None))
+        sharded = shard_params(params, base, mesh)
+        tok_sh = jax.device_put(tokens, NamedSharding(mesh, _restrict(P("dp", None), mesh)))
+        got = np.asarray(
+            jax.jit(lambda p, t: forward(p, t, dispatch, mesh=mesh))(sharded, tok_sh)
+        )
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
     def test_loss_parity(self, mesh, rng):
         params = init_params(CFG, seed=0)
         tokens = _tokens(rng, b=4, s=33)

@@ -41,12 +41,15 @@ jax.config.update("jax_enable_x64", True)
 # Persistent XLA compilation cache: the harness's subprocess-per-run
 # model (reference tester.py:126) would otherwise recompile every kernel
 # in every process (SURVEY.md section 7 "hard parts").  Opt out with
-# TPULAB_COMPILE_CACHE=0; point it elsewhere with a path.
+# TPULAB_COMPILE_CACHE=0; point it elsewhere with a path.  Skipped when
+# the process is pinned to the CPU backend (tests, dryruns): XLA:CPU AOT
+# reload warns about machine-feature mismatches, and CPU compiles are
+# cheap anyway — the cache pays off on the TPU path (20-40s compiles).
 _cache = os.environ.get(
     "TPULAB_COMPILE_CACHE",
     os.path.join(os.path.expanduser("~"), ".cache", "tpulab-jax"),
 )
-if _cache not in ("0", ""):
+if _cache not in ("0", "") and os.environ.get("JAX_PLATFORMS", "").strip().lower() != "cpu":
     jax.config.update("jax_compilation_cache_dir", _cache)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.2)
 

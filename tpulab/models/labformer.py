@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import math
 from typing import Any, Dict, Optional, Tuple
 
 import jax
@@ -61,6 +62,12 @@ class LabformerConfig:
     # ~30% more FLOPs for activation memory that no longer scales with
     # n_layers — the HBM-vs-FLOPs lever for long-context training
     remat: bool = False
+    # MoE execution: "dense" computes every expert and one-hot selects
+    # (exact, E-fold FLOPs); "dispatch" routes tokens to their expert's
+    # owner with all_to_all over the fused (dp, sp) ep submesh
+    # (tpulab.parallel.moe) — requires a mesh with dp/sp axes
+    moe_impl: str = "dense"
+    moe_capacity_factor: float = 2.0
 
     @property
     def head_dim(self) -> int:
@@ -196,7 +203,28 @@ def _attention(x, layer, cfg: LabformerConfig, mesh: Optional[Mesh], positions):
     return o.reshape(b, s, d) @ layer["wo"]
 
 
-def _mlp(x, layer, cfg: LabformerConfig):
+def _mlp(x, layer, cfg: LabformerConfig, mesh: Optional[Mesh] = None):
+    if cfg.n_experts and cfg.moe_impl == "dispatch" and mesh is not None:
+        from tpulab.parallel.moe import _moe_body
+
+        axes = tuple(a for a in ("dp", "sp") if a in mesh.axis_names)
+        if not axes:
+            raise ValueError("dispatch MoE needs dp and/or sp mesh axes")
+        b, s, d = x.shape
+        p = math.prod(mesh.shape[a] for a in axes)
+        n_local = (b * s) // p
+        capacity = max(1, -(-int(cfg.moe_capacity_factor * n_local) // cfg.n_experts))
+        body = functools.partial(
+            _moe_body, axis=axes, n_experts=cfg.n_experts, capacity=capacity
+        )
+        flat = x.reshape(b * s, d)
+        y = jax.shard_map(
+            body,
+            mesh=mesh,
+            in_specs=(P(axes, None), P(), P(axes, None, None), P(axes, None, None)),
+            out_specs=P(axes, None),
+        )(flat, layer["router"], layer["w1"], layer["w2"])
+        return y.reshape(b, s, d)
     if cfg.n_experts:
         # exact top-1 switch: dense expert compute, one-hot gate select
         logits = x @ layer["router"]                     # (b, s, E)
@@ -228,7 +256,7 @@ def forward(params, tokens, cfg: LabformerConfig, mesh: Optional[Mesh] = None):
 
     def block(x, layer):
         x = x + _attention(_rmsnorm(x, layer["ln1"]), layer, cfg, mesh, positions)
-        x = x + _mlp(_rmsnorm(x, layer["ln2"]), layer, cfg)
+        x = x + _mlp(_rmsnorm(x, layer["ln2"]), layer, cfg, mesh)
         if mesh is not None:
             x = jax.lax.with_sharding_constraint(
                 x, NamedSharding(mesh, _restrict(ACT_SPEC, mesh))
@@ -336,6 +364,7 @@ def dryrun_train_step(n_devices: int, backend: Optional[str] = None) -> None:
         d_ff=64,
         n_experts=4,
         max_seq=64,
+        moe_impl="dispatch",  # real all_to_all ep dispatch in the dryrun
     )
     params, opt_state, train_step = init_train_state(cfg, mesh, seed=0)
     rng = np.random.default_rng(1)

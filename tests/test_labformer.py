@@ -96,6 +96,19 @@ class TestSharded:
         got = np.asarray(jax.jit(lambda p, t: forward(p, t, CFG, mesh=mesh))(sharded, tok_sh))
         np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
 
+    def test_ulysses_sp_parity(self, mesh, rng):
+        """Ulysses sequence parallelism == ring == single-device."""
+        import dataclasses
+
+        uly = dataclasses.replace(CFG, sp_impl="ulysses")
+        params = init_params(CFG, seed=0)
+        tokens = _tokens(rng, b=4, s=32)
+        want = np.asarray(forward(params, tokens, CFG, mesh=None))
+        sharded = shard_params(params, CFG, mesh)
+        tok_sh = jax.device_put(tokens, NamedSharding(mesh, _restrict(P("dp", None), mesh)))
+        got = np.asarray(jax.jit(lambda p, t: forward(p, t, uly, mesh=mesh))(sharded, tok_sh))
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
     def test_dispatch_moe_parity(self, mesh, rng):
         """all_to_all expert dispatch == dense-gate MoE at full capacity."""
         import dataclasses

@@ -58,6 +58,10 @@ class LabformerConfig:
     # attention backend: "dense" (O(s^2) reference), "flash" (Pallas
     # blockwise, O(s) memory), or "auto" (flash from 1024 tokens up)
     attn_impl: str = "auto"
+    # sequence-parallel strategy when the mesh has sp > 1: "ring"
+    # (ppermute K/V rotation, O(seq/p) peak memory) or "ulysses"
+    # (all_to_all head/sequence transpose; needs heads % (sp*tp) == 0)
+    sp_impl: str = "ring"
     # rematerialize each block in backward (jax.checkpoint): trades
     # ~30% more FLOPs for activation memory that no longer scales with
     # n_layers — the HBM-vs-FLOPs lever for long-context training
@@ -186,7 +190,12 @@ def _attention(x, layer, cfg: LabformerConfig, mesh: Optional[Mesh], positions):
     k = _rope(k, positions, cfg.rope_theta)
     if mesh is not None and "sp" in mesh.axis_names and mesh.shape["sp"] > 1:
         spec = _restrict(P("dp", "sp", "tp", None), mesh)
-        body = functools.partial(_ring_body, axis="sp", causal=True)
+        if cfg.sp_impl == "ulysses":
+            from tpulab.parallel.ring import _ulysses_body
+
+            body = functools.partial(_ulysses_body, axis="sp", causal=True)
+        else:
+            body = functools.partial(_ring_body, axis="sp", causal=True)
         o = jax.shard_map(
             body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
         )(q, k, v)

@@ -73,6 +73,18 @@ class LabformerConfig:
     moe_impl: str = "dense"
     moe_capacity_factor: float = 2.0
 
+    def __post_init__(self):
+        # silent-fallback guard: a typoed impl name must not run another
+        # (numerically identical) path and mislabel measurements
+        checks = {
+            "attn_impl": ("auto", "flash", "dense"),
+            "sp_impl": ("ring", "ulysses"),
+            "moe_impl": ("dense", "dispatch"),
+        }
+        for field, allowed in checks.items():
+            if getattr(self, field) not in allowed:
+                raise ValueError(f"{field}={getattr(self, field)!r}; expected one of {allowed}")
+
     @property
     def head_dim(self) -> int:
         assert self.d_model % self.n_heads == 0
@@ -193,6 +205,13 @@ def _attention(x, layer, cfg: LabformerConfig, mesh: Optional[Mesh], positions):
         if cfg.sp_impl == "ulysses":
             from tpulab.parallel.ring import _ulysses_body
 
+            tp = mesh.shape.get("tp", 1)
+            sp = mesh.shape["sp"]
+            if (h // tp) % sp:
+                raise ValueError(
+                    f"ulysses needs local heads divisible by sp: "
+                    f"{h} heads / tp={tp} over sp={sp}"
+                )
             body = functools.partial(_ulysses_body, axis="sp", causal=True)
         else:
             body = functools.partial(_ring_body, axis="sp", causal=True)

@@ -40,8 +40,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tpulab.parallel.mesh import make_mesh
+from tpulab.parallel.mesh import make_mesh, mesh_anchor
 from tpulab.parallel.ring import _ring_body
+from tpulab.runtime.device import commit
 
 
 @dataclasses.dataclass(frozen=True)
@@ -92,24 +93,31 @@ class LabformerConfig:
 
 
 def init_params(cfg: LabformerConfig, seed: int = 0) -> Dict[str, Any]:
-    """Plain-pytree parameters; per-layer leaves stacked on axis 0."""
+    """Plain-pytree parameters; per-layer leaves stacked on axis 0.
+
+    Leaves are host NumPy arrays: device placement happens exactly once,
+    either in :func:`shard_params` (mesh runs) or at the first jit call
+    (single-device runs).  Materializing on the default device here
+    would poison the virtual-CPU-mesh path when the default backend is
+    the tunneled TPU (see runtime.device.commit).
+    """
     rng = np.random.default_rng(seed)
     L, d, ff, dt = cfg.n_layers, cfg.d_model, cfg.d_ff, cfg.dtype
 
     def dense(*shape, scale=None):
         scale = scale if scale is not None else (1.0 / np.sqrt(shape[-2]))
-        return jnp.asarray(rng.standard_normal(shape) * scale, dt)
+        return np.asarray(rng.standard_normal(shape) * scale, dt)
 
     params: Dict[str, Any] = {
         "embed": dense(cfg.vocab, d, scale=0.02),
-        "final_norm": jnp.ones((d,), dt),
+        "final_norm": np.ones((d,), dt),
         "blocks": {
-            "ln1": jnp.ones((L, d), dt),
+            "ln1": np.ones((L, d), dt),
             "wq": dense(L, d, d),
             "wk": dense(L, d, d),
             "wv": dense(L, d, d),
             "wo": dense(L, d, d),
-            "ln2": jnp.ones((L, d), dt),
+            "ln2": np.ones((L, d), dt),
         },
     }
     if cfg.n_experts:
@@ -167,9 +175,12 @@ def _restrict(spec: P, mesh: Mesh) -> P:
 
 
 def shard_params(params, cfg: LabformerConfig, mesh: Mesh):
+    """Place params into their mesh shardings via ``commit`` (never a raw
+    ``device_put``: a leaf resident on another backend would otherwise
+    trigger the cross-backend transfer that degrades the tunneled TPU)."""
     specs = param_specs(cfg)
     return jax.tree_util.tree_map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, _restrict(s, mesh))),
+        lambda x, s: commit(x, NamedSharding(mesh, _restrict(s, mesh))),
         params,
         specs,
     )
@@ -353,10 +364,17 @@ def init_train_state(
     accum: int = 1,
 ):
     params = init_params(cfg, seed)
+    optimizer, train_step = make_train_step(cfg, mesh, optimizer, accum=accum)
     if mesh is not None:
         params = shard_params(params, cfg, mesh)
-    optimizer, train_step = make_train_step(cfg, mesh, optimizer, accum=accum)
-    opt_state = optimizer.init(params)
+        # optax's init eagerly creates its step counter; anchor it to the
+        # mesh's backend so a mesh on a non-default backend (the virtual
+        # CPU fleet under a TPU-default process) never dispatches — or
+        # later cross-backend-transfers — on the default device
+        with jax.default_device(mesh_anchor(mesh)):
+            opt_state = optimizer.init(params)
+    else:
+        opt_state = optimizer.init(params)
     return params, opt_state, train_step
 
 

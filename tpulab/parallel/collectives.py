@@ -19,8 +19,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tpulab.parallel.mesh import make_mesh, mesh_anchor
-from tpulab.runtime.device import commit
+from tpulab.parallel.mesh import make_mesh
+from tpulab.runtime.device import commit, to_host
 
 _LOCAL_REDUCERS = {
     "sum": jnp.sum,
@@ -39,12 +39,15 @@ _PSUM_COMBINE = {
 }
 
 
-def _pad_to_multiple(x: jax.Array, m: int, fill) -> jax.Array:
+def _pad_to_multiple(x: np.ndarray, m: int, fill) -> np.ndarray:
+    """Host-side pad (numpy): staging must not run eager jax ops — a fresh
+    eager array materializes on the *default* backend, which on the
+    tunneled single-TPU runtime is not the mesh's backend."""
     n = x.shape[0]
     pad = (-n) % m
     if pad == 0:
         return x
-    return jnp.concatenate([x, jnp.full((pad,), fill, x.dtype)])
+    return np.concatenate([x, np.full((pad,), fill, x.dtype)])
 
 
 _IDENTITY = {"sum": 0, "prod": 1, "min": None, "max": None}  # None -> edge value
@@ -75,12 +78,19 @@ _dist_reduce = reduce_staged
 
 
 def stage_reduce(values, op: str = "sum", *, mesh: Mesh, axis: str = "x") -> jax.Array:
-    """Widen/pad/shard ``values`` for :func:`reduce_staged`."""
-    x = commit(values, mesh_anchor(mesh))
-    if x.dtype in (jnp.uint8, jnp.int8, jnp.int16, jnp.int32):
-        x = x.astype(jnp.int64 if jax.config.jax_enable_x64 else jnp.int32)
+    """Widen/pad/shard ``values`` for :func:`reduce_staged`.
+
+    Numpy-first: widen + pad happen on host, then one ``commit`` places
+    the array directly into its mesh sharding — no eager op ever touches
+    the default backend (which may be a different platform than the
+    mesh's, e.g. the virtual-CPU fleet under a TPU-default process).
+    """
+    x = to_host(values)
+    _NARROW = (np.dtype(np.uint8), np.dtype(np.int8), np.dtype(np.int16), np.dtype(np.int32))
+    if x.dtype in _NARROW:
+        x = x.astype(np.int64 if jax.config.jax_enable_x64 else np.int32)
     x = _pad_to_multiple(x, mesh.shape[axis], _identity_fill(op, x.dtype))
-    return jax.device_put(x, NamedSharding(mesh, P(axis)))
+    return commit(x, NamedSharding(mesh, P(axis)))
 
 
 def distributed_reduce(
@@ -124,12 +134,12 @@ def distributed_mean(
 ) -> jax.Array:
     """Mean via psum of padded-with-zero shards divided by the true count."""
     mesh = mesh or make_mesh(n_devices=num_devices, axes=(axis,))
-    x = commit(values, mesh_anchor(mesh))
-    if not jnp.issubdtype(x.dtype, jnp.floating):
-        x = x.astype(jnp.float64 if jax.config.jax_enable_x64 else jnp.float32)
-    n_true = jnp.asarray(x.shape[0], x.dtype)
+    x = to_host(values)
+    if x.dtype.kind not in "fc":
+        x = x.astype(np.float64 if jax.config.jax_enable_x64 else np.float32)
+    n_true = commit(np.asarray(x.shape[0], x.dtype), NamedSharding(mesh, P()))
     x = _pad_to_multiple(x, mesh.shape[axis], np.asarray(0, x.dtype))
-    x = jax.device_put(x, NamedSharding(mesh, P(axis)))
+    x = commit(x, NamedSharding(mesh, P(axis)))
     return _dist_mean(x, n_true, mesh=mesh, axis=axis)
 
 
@@ -149,10 +159,10 @@ def _all_gather(x: jax.Array, *, mesh: Mesh, axis: str) -> jax.Array:
 def all_gather_op(values, *, mesh: Optional[Mesh] = None, axis: str = "x") -> jax.Array:
     """Gather a sharded 1-D array to every device (replicated output)."""
     mesh = mesh or make_mesh(axes=(axis,))
-    x = commit(values, mesh_anchor(mesh))
+    x = values if isinstance(values, jax.Array) else np.asarray(values)
     if x.shape[0] % mesh.shape[axis]:
         raise ValueError(f"length {x.shape[0]} not divisible by mesh axis {mesh.shape[axis]}")
-    x = jax.device_put(x, NamedSharding(mesh, P(axis)))
+    x = commit(x, NamedSharding(mesh, P(axis)))
     return _all_gather(x, mesh=mesh, axis=axis)
 
 
@@ -168,9 +178,9 @@ def reduce_scatter_op(matrix, *, mesh: Optional[Mesh] = None, axis: str = "x") -
     """Row-wise psum_scatter: input (k, n) sharded over rows; output is the
     column-sum scattered so each device owns n/k of the result."""
     mesh = mesh or make_mesh(axes=(axis,))
-    x = commit(matrix, mesh_anchor(mesh))
+    x = matrix if isinstance(matrix, jax.Array) else np.asarray(matrix)
     k = mesh.shape[axis]
     if x.shape[0] != k or x.shape[1] % k:
         raise ValueError(f"expected ({k}, m*{k}) matrix, got {x.shape}")
-    x = jax.device_put(x, NamedSharding(mesh, P(axis, None)))
+    x = commit(x, NamedSharding(mesh, P(axis, None)))
     return _reduce_scatter(x, mesh=mesh, axis=axis)

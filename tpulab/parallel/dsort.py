@@ -33,30 +33,37 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from tpulab.parallel.mesh import make_mesh, mesh_anchor
-from tpulab.runtime.device import commit
+from tpulab.parallel.mesh import make_mesh
+from tpulab.runtime.device import commit, to_host
 
-_KEY_DTYPE = {jnp.dtype(jnp.float32): jnp.uint32, jnp.dtype(jnp.float64): jnp.uint64}
+_KEY_DTYPE = {np.dtype(np.float32): np.uint32, np.dtype(np.float64): np.uint64}
 
 
-def _encode_keys(x: jax.Array) -> jax.Array:
-    """Monotone bijection float -> unsigned int (IEEE total order)."""
-    udtype = _KEY_DTYPE[x.dtype]
-    nbits = jnp.iinfo(udtype).bits
-    x = jnp.where(jnp.isnan(x), jnp.asarray(jnp.nan, x.dtype), x)
-    u = jax.lax.bitcast_convert_type(x, udtype)
-    topbit = np.asarray(1, udtype) << (nbits - 1)
+def _encode_keys(x: np.ndarray) -> np.ndarray:
+    """Monotone bijection float -> unsigned int (IEEE total order).
+
+    Host-side numpy: key encoding is staging, and staging must not run
+    eager jax ops (a fresh eager array would land on the *default*
+    backend, not necessarily the mesh's — see runtime.device.commit).
+    """
+    udtype = np.dtype(_KEY_DTYPE[x.dtype])
+    nbits = udtype.itemsize * 8
+    topbit = np.asarray(1, udtype) << np.asarray(nbits - 1, udtype)
     allones = np.asarray(~np.asarray(0, udtype), udtype)
-    return u ^ jnp.where(u >> (nbits - 1) == 1, allones, topbit)
+    x = np.where(np.isnan(x), np.asarray(np.nan, x.dtype), x)
+    u = np.ascontiguousarray(x).view(udtype)
+    return u ^ np.where(u >> np.asarray(nbits - 1, udtype) == 1, allones, topbit)
 
 
-def _decode_keys(k: jax.Array, fdtype) -> jax.Array:
-    udtype = _KEY_DTYPE[jnp.dtype(fdtype)]
-    nbits = jnp.iinfo(udtype).bits
-    topbit = np.asarray(1, udtype) << (nbits - 1)
+def _decode_keys(k: np.ndarray, fdtype) -> np.ndarray:
+    fdtype = np.dtype(fdtype)
+    udtype = np.dtype(_KEY_DTYPE[fdtype])
+    nbits = udtype.itemsize * 8
+    topbit = np.asarray(1, udtype) << np.asarray(nbits - 1, udtype)
     allones = np.asarray(~np.asarray(0, udtype), udtype)
-    u = k ^ jnp.where(k >> (nbits - 1) == 1, topbit, allones)
-    return jax.lax.bitcast_convert_type(u, fdtype)
+    k = np.ascontiguousarray(k).astype(udtype, copy=False)
+    u = k ^ np.where(k >> np.asarray(nbits - 1, udtype) == 1, topbit, allones)
+    return u.view(fdtype)
 
 
 def _sentinel(dtype) -> np.ndarray:
@@ -107,26 +114,31 @@ def stage_sort(values, *, mesh: Mesh, axis: str = "x") -> Tuple[jax.Array, dict]
     time the collective alone (the reference times kernels, not H2D —
     SURVEY.md section 5.1).
     """
-    x = commit(values, mesh_anchor(mesh))
+    x = to_host(values)
     if x.ndim != 1:
         raise ValueError(f"expected 1-D array, got shape {x.shape}")
     meta = {"n": x.shape[0], "dtype": x.dtype, "p": mesh.shape[axis]}
-    if x.dtype == jnp.uint8:
-        x = x.astype(jnp.int32)
+    if x.dtype == np.uint8:
+        x = x.astype(np.int32)
     elif jnp.issubdtype(x.dtype, jnp.floating):
+        # jnp.issubdtype (not dtype.kind == "f") so extension floats like
+        # ml_dtypes.bfloat16 are caught here and rejected loudly rather
+        # than sorted raw (raw NaNs would collide with the sentinel fill)
+        if x.dtype not in _KEY_DTYPE:
+            raise TypeError(f"unsupported float dtype for distributed sort: {x.dtype}")
         x = _encode_keys(x)
     pad = (-x.shape[0]) % mesh.shape[axis]
     if pad:
-        x = jnp.concatenate([x, jnp.full((pad,), _sentinel(x.dtype), x.dtype)])
-    return jax.device_put(x, NamedSharding(mesh, P(axis))), meta
+        x = np.concatenate([x, np.full((pad,), _sentinel(x.dtype), x.dtype)])
+    return commit(x, NamedSharding(mesh, P(axis))), meta
 
 
 def finish_sort(rows, counts, meta: dict) -> np.ndarray:
     """Trim bucket padding, decode keys, restore the input dtype."""
     rows, counts = np.asarray(rows), np.asarray(counts)
     out = np.concatenate([rows[i, : counts[i]] for i in range(meta["p"])])[: meta["n"]]
-    if jnp.issubdtype(meta["dtype"], jnp.floating):
-        out = np.asarray(_decode_keys(jnp.asarray(out), meta["dtype"]))
+    if np.dtype(meta["dtype"]).kind == "f":
+        out = _decode_keys(out, meta["dtype"])
     return out.astype(meta["dtype"])
 
 

@@ -55,6 +55,19 @@ def commit(values, target, dtype=None) -> jax.Array:
     return jax.device_put(arr, target)
 
 
+def to_host(values) -> np.ndarray:
+    """Pull ``values`` to host numpy (device arrays included) for staging.
+
+    The staging side of every collective goes through here: transforms
+    (widen, pad, key-encode) run in numpy and a single :func:`commit`
+    places the result, so no eager jax op can land on the default
+    backend (which may be a different platform than the target mesh's).
+    """
+    if isinstance(values, jax.Array):
+        values = jax.device_get(values)
+    return np.asarray(values)
+
+
 def default_device():
     """The default accelerator device (TPU when attached, else CPU)."""
     return jax.devices()[0]

@@ -55,6 +55,31 @@ std::string json_escape(const std::string& s) {
   return out;
 }
 
+// Strict JSON number grammar (RFC 8259): -?(0|[1-9][0-9]*)(\.[0-9]+)?
+// ([eE][+-]?[0-9]+)?.  Looser sniffing ("007", "1.", "-", ".") would
+// emit invalid JSON the daemon's json.loads rejects; anything failing
+// this grammar is forwarded as a quoted string instead.
+bool is_json_number(const std::string& v) {
+  size_t i = 0, n = v.size();
+  auto digit = [&](size_t j) { return j < n && v[j] >= '0' && v[j] <= '9'; };
+  if (i < n && v[i] == '-') ++i;
+  if (!digit(i)) return false;
+  if (v[i] == '0') ++i;
+  else while (digit(i)) ++i;
+  if (i < n && v[i] == '.') {
+    ++i;
+    if (!digit(i)) return false;
+    while (digit(i)) ++i;
+  }
+  if (i < n && (v[i] == 'e' || v[i] == 'E')) {
+    ++i;
+    if (i < n && (v[i] == '+' || v[i] == '-')) ++i;
+    if (!digit(i)) return false;
+    while (digit(i)) ++i;
+  }
+  return i == n;
+}
+
 // --key value pairs -> JSON object with bool/number passthrough (the
 // daemon's workload kwargs are type-coerced Python-side as well; numbers
 // are forwarded unquoted so e.g. --reps 5 arrives as an int).
@@ -65,15 +90,7 @@ std::string config_json(const std::vector<std::pair<std::string, std::string>>& 
     if (!first) out += ",";
     first = false;
     out += "\"" + json_escape(k) + "\":";
-    bool numeric = !v.empty();
-    bool dot = false;
-    for (size_t i = 0; i < v.size() && numeric; ++i) {
-      char c = v[i];
-      if (c == '-' && i == 0) continue;
-      if (c == '.') { numeric = !dot; dot = true; continue; }
-      if (c < '0' || c > '9') numeric = false;
-    }
-    if (v == "true" || v == "false" || numeric)
+    if (v == "true" || v == "false" || is_json_number(v))
       out += v;
     else
       out += "\"" + json_escape(v) + "\"";

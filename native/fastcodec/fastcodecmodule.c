@@ -87,8 +87,11 @@ static PyObject *fastcodec_hex_decode(PyObject *self, PyObject *args) {
     int v = hex_val(c);
     if (v < 0) {
       PyMem_Free(tmp);
-      PyErr_Format(PyExc_ValueError, "non-hex character %R at index %zd",
-                   PyUnicode_FromOrdinal(c), i);
+      /* format the ordinal directly: %R on a fresh PyUnicode_FromOrdinal
+       * would leak the temporary (PyErr_Format does not steal it).
+       * lowercase %04x: uppercase %X only exists from CPython 3.12 */
+      PyErr_Format(PyExc_ValueError, "non-hex character U+%04x at index %zd",
+                   (unsigned)c, i);
       return NULL;
     }
     if (have_hi) {

@@ -36,6 +36,15 @@ class TestFlashAttention:
         want = np.asarray(attention_reference(q, k, v, causal=True))
         np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
 
+    def test_ragged_seq_unequal_blocks(self, rng):
+        """Padding must reach a multiple of BOTH blocks (lcm, not max):
+        s=20 with bq=16, bk=12 pads to 48 — a max-based pad (32) would
+        leave trailing K rows unprocessed with no error."""
+        q, k, v = _qkv(rng, s=20)
+        got = np.asarray(flash_attention(q, k, v, block_q=16, block_k=12))
+        want = np.asarray(attention_reference(q, k, v, causal=True))
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
     def test_noncausal_ragged_raises(self, rng):
         q, k, v = _qkv(rng, s=100)
         with pytest.raises(NotImplementedError):

@@ -183,6 +183,23 @@ class TestClient:
         r = subprocess.run([str(CLIENT)], capture_output=True, text=True)
         assert r.returncode == 2
 
+    def test_client_quotes_nonjson_numbers(self, daemon):
+        """Number-looking kwargs that are not valid JSON numbers ("007",
+        "1.", "-", ".") must be forwarded as quoted strings — unquoted
+        they would make the daemon's json.loads reject the request."""
+        env = dict(os.environ)
+        env["TPULAB_DAEMON_SOCKET"] = daemon
+        r = subprocess.run(
+            [str(CLIENT), "hw1", "--a1", "007", "--a2", "1.", "--a3", "-", "--a4", "."],
+            input="1 -3 2",
+            env=env,
+            capture_output=True,
+            text=True,
+            timeout=60,
+        )
+        assert r.returncode == 0, r.stderr
+        assert "1.000000" in r.stdout and "2.000000" in r.stdout
+
 
 class TestHarnessDrivesClient:
     def test_full_stack(self, daemon, tmp_path):

@@ -22,6 +22,7 @@ tests; interpret mode covers CPU.
 from __future__ import annotations
 
 import functools
+import math
 from typing import Optional
 
 import jax
@@ -82,6 +83,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
 def _flash_bhsd(q, k, v, block_q: int, block_k: int, causal: bool, interpret: bool):
     """(bh, s, d) fused attention."""
     bh, s, d = q.shape
+    if s % block_q or s % block_k:
+        # guards the floor divisions below: a trailing partial block
+        # would silently never be processed
+        raise ValueError(f"seq {s} must divide block_q={block_q} and block_k={block_k}")
     n_q = s // block_q
     n_k = s // block_k
     scale = 1.0 / np.sqrt(d)
@@ -130,7 +135,10 @@ def flash_attention(
     b, s, h, d = q.shape
     block_q = min(block_q, max(8, s))
     block_k = min(block_k, max(8, s))
-    pad = (-s) % max(block_q, block_k)
+    # lcm, not max: with unequal blocks a max-multiple padded length need
+    # not divide the smaller block, and _flash_bhsd's floor-divided grid
+    # would silently skip the trailing rows
+    pad = (-s) % math.lcm(block_q, block_k)
     if pad:
         # pad queries arbitrarily (cropped) and keys at -inf reach: the
         # causal mask plus k_pos>=s padding must not attract weight, so

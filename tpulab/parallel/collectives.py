@@ -20,7 +20,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpulab.parallel.mesh import make_mesh
-from tpulab.runtime.device import commit, to_host
+from tpulab.runtime.device import commit, pad_to_multiple, to_host
 
 _LOCAL_REDUCERS = {
     "sum": jnp.sum,
@@ -37,17 +37,6 @@ _PSUM_COMBINE = {
     # the value replicated for shard_map's out_specs=P() check
     "prod": lambda x, ax: jax.lax.pmax(jnp.prod(jax.lax.all_gather(x, ax)), ax),
 }
-
-
-def _pad_to_multiple(x: np.ndarray, m: int, fill) -> np.ndarray:
-    """Host-side pad (numpy): staging must not run eager jax ops — a fresh
-    eager array materializes on the *default* backend, which on the
-    tunneled single-TPU runtime is not the mesh's backend."""
-    n = x.shape[0]
-    pad = (-n) % m
-    if pad == 0:
-        return x
-    return np.concatenate([x, np.full((pad,), fill, x.dtype)])
 
 
 _IDENTITY = {"sum": 0, "prod": 1, "min": None, "max": None}  # None -> edge value
@@ -89,7 +78,7 @@ def stage_reduce(values, op: str = "sum", *, mesh: Mesh, axis: str = "x") -> jax
     _NARROW = (np.dtype(np.uint8), np.dtype(np.int8), np.dtype(np.int16), np.dtype(np.int32))
     if x.dtype in _NARROW:
         x = x.astype(np.int64 if jax.config.jax_enable_x64 else np.int32)
-    x = _pad_to_multiple(x, mesh.shape[axis], _identity_fill(op, x.dtype))
+    x = pad_to_multiple(x, mesh.shape[axis], _identity_fill(op, x.dtype))
     return commit(x, NamedSharding(mesh, P(axis)))
 
 
@@ -138,7 +127,7 @@ def distributed_mean(
     if x.dtype.kind not in "fc":
         x = x.astype(np.float64 if jax.config.jax_enable_x64 else np.float32)
     n_true = commit(np.asarray(x.shape[0], x.dtype), NamedSharding(mesh, P()))
-    x = _pad_to_multiple(x, mesh.shape[axis], np.asarray(0, x.dtype))
+    x = pad_to_multiple(x, mesh.shape[axis], np.asarray(0, x.dtype))
     x = commit(x, NamedSharding(mesh, P(axis)))
     return _dist_mean(x, n_true, mesh=mesh, axis=axis)
 

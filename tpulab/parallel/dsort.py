@@ -34,7 +34,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpulab.parallel.mesh import make_mesh
-from tpulab.runtime.device import commit, to_host
+from tpulab.runtime.device import commit, pad_to_multiple, to_host
 
 _KEY_DTYPE = {np.dtype(np.float32): np.uint32, np.dtype(np.float64): np.uint64}
 
@@ -127,9 +127,7 @@ def stage_sort(values, *, mesh: Mesh, axis: str = "x") -> Tuple[jax.Array, dict]
         if x.dtype not in _KEY_DTYPE:
             raise TypeError(f"unsupported float dtype for distributed sort: {x.dtype}")
         x = _encode_keys(x)
-    pad = (-x.shape[0]) % mesh.shape[axis]
-    if pad:
-        x = np.concatenate([x, np.full((pad,), _sentinel(x.dtype), x.dtype)])
+    x = pad_to_multiple(x, mesh.shape[axis], _sentinel(x.dtype))
     return commit(x, NamedSharding(mesh, P(axis))), meta
 
 

@@ -68,6 +68,19 @@ def to_host(values) -> np.ndarray:
     return np.asarray(values)
 
 
+def pad_to_multiple(x: np.ndarray, m: int, fill) -> np.ndarray:
+    """Pad a 1-D host array with ``fill`` so its length divides ``m``.
+
+    Host-side (numpy) for the same reason as :func:`to_host`: padding is
+    staging, and a fresh eager jax array would land on the default
+    backend rather than the target mesh's.
+    """
+    pad = (-x.shape[0]) % m
+    if pad == 0:
+        return x
+    return np.concatenate([x, np.full((pad,), fill, x.dtype)])
+
+
 def default_device():
     """The default accelerator device (TPU when attached, else CPU)."""
     return jax.devices()[0]

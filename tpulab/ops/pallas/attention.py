@@ -86,7 +86,9 @@ def _flash_bhsd(q, k, v, block_q: int, block_k: int, causal: bool, interpret: bo
     if s % block_q or s % block_k:
         # guards the floor divisions below: a trailing partial block
         # would silently never be processed
-        raise ValueError(f"seq {s} must divide block_q={block_q} and block_k={block_k}")
+        raise ValueError(
+            f"seq {s} must be divisible by block_q={block_q} and block_k={block_k}"
+        )
     n_q = s // block_q
     n_k = s // block_k
     scale = 1.0 / np.sqrt(d)
@@ -136,9 +138,16 @@ def flash_attention(
     block_q = min(block_q, max(8, s))
     block_k = min(block_k, max(8, s))
     # lcm, not max: with unequal blocks a max-multiple padded length need
-    # not divide the smaller block, and _flash_bhsd's floor-divided grid
-    # would silently skip the trailing rows
-    pad = (-s) % math.lcm(block_q, block_k)
+    # not be divisible by the smaller block, and _flash_bhsd's
+    # floor-divided grid would silently skip the trailing rows
+    pad_unit = math.lcm(block_q, block_k)
+    if (-s) % pad_unit and pad_unit > 2 * max(block_q, block_k):
+        # near-coprime blocks would pad all the way to the lcm (up to
+        # block_q*block_k extra rows); unify to the smaller block — equal
+        # blocks tile any padded length with pad bounded by one block
+        block_q = block_k = min(block_q, block_k)
+        pad_unit = block_q
+    pad = (-s) % pad_unit
     if pad:
         # pad queries arbitrarily (cropped) and keys at -inf reach: the
         # causal mask plus k_pos>=s padding must not attract weight, so

@@ -69,7 +69,7 @@ def to_host(values) -> np.ndarray:
 
 
 def pad_to_multiple(x: np.ndarray, m: int, fill) -> np.ndarray:
-    """Pad a 1-D host array with ``fill`` so its length divides ``m``.
+    """Pad a 1-D host array with ``fill`` to the next multiple of ``m``.
 
     Host-side (numpy) for the same reason as :func:`to_host`: padding is
     staging, and a fresh eager jax array would land on the default

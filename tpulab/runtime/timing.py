@@ -109,8 +109,11 @@ def measure_ms(
     (~66 ms on the relayed TPU — far larger than most kernels) across
     the batch instead of measuring it.
     """
+    # at least one warmup always runs: the kernel-only contract excludes
+    # compile time, and the platform sniff below needs a real output
+    # (warmup=0 would sniff "cpu" and skip the tunnel-rtt subtraction)
     out = None
-    for _ in range(max(warmup, 0)):
+    for _ in range(max(warmup, 1)):
         out = fn(*args)
     _force(out)
     reps = max(reps, 1)

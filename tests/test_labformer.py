@@ -15,7 +15,9 @@ from tpulab.models.labformer import (
     LabformerConfig,
     _restrict,
     dryrun_train_step,
+    expert_load,
     forward,
+    forward_with_aux,
     init_params,
     init_train_state,
     loss_fn,
@@ -78,6 +80,48 @@ class TestTraining:
             losses.append(float(loss))
         assert losses[-1] < losses[0], losses
         assert all(np.isfinite(losses))
+
+
+class TestMoeAuxLoss:
+    def test_aux_near_one_at_init(self, rng):
+        """A fresh (small-scale random) router routes near-uniformly, so
+        aux sits near its uniform optimum of 1 (not a general lower
+        bound — concentrated routing with skewed gates can dip below)."""
+        cfg = LabformerConfig(d_model=32, n_heads=4, n_layers=2, d_ff=64, n_experts=4)
+        params = init_params(cfg, seed=0)
+        _, aux = forward_with_aux(params, _tokens(rng), cfg)
+        assert 0.9 < float(aux) < 1.5, float(aux)
+
+    def test_dense_model_has_zero_aux(self, rng):
+        _, aux = forward_with_aux(init_params(CFG, seed=0), _tokens(rng), CFG)
+        assert float(aux) == 0.0
+
+    def test_no_collapse_under_dispatch_training(self, rng):
+        """~100 training steps on the all_to_all dispatch path must keep
+        expert assignment spread (the aux loss prevents the classic
+        top-1 router collapse onto one expert)."""
+        mesh = cpu_test_mesh({"dp": 2, "sp": 2, "tp": 2})
+        cfg = LabformerConfig(
+            d_model=32,
+            n_heads=4,
+            n_layers=2,
+            d_ff=32,
+            n_experts=4,
+            moe_impl="dispatch",
+            moe_aux_weight=0.05,
+        )
+        params, opt_state, step = init_train_state(cfg, mesh, seed=0)
+        tok_sharding = NamedSharding(mesh, _restrict(P("dp", None), mesh))
+        data = rng.integers(0, 256, (16, 4, 33)).astype(np.int32)
+        for i in range(100):
+            tokens = jax.device_put(jnp.asarray(data[i % 16]), tok_sharding)
+            params, opt_state, loss = step(params, opt_state, tokens)
+        assert np.isfinite(float(loss))
+        host = jax.device_get(params)
+        eval_tokens = jnp.asarray(data.reshape(-1, 33)[:, :-1])
+        frac = np.asarray(expert_load(host, eval_tokens, cfg)).mean(axis=0)
+        assert frac.max() < 0.8, f"router collapsed: {frac}"
+        assert (frac > 0.02).sum() >= 2, f"experts starved: {frac}"
 
 
 class TestSharded:

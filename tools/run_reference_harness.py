@@ -44,14 +44,18 @@ TINY_FIXTURES = (
 
 def stage_workdir(workdir: pathlib.Path) -> pathlib.Path:
     data = workdir / "lab2" / "data"
-    data.mkdir(parents=True)
+    data.mkdir(parents=True, exist_ok=True)  # --workdir may be reused
     for fn in TINY_FIXTURES:
         src = REFERENCE / "lab2" / "data" / fn
         if src.exists():
             shutil.copy(src, data / fn)
-    shutil.copytree(REFERENCE / "lab2" / "data_out_gt", workdir / "lab2" / "data_out_gt")
+    shutil.copytree(
+        REFERENCE / "lab2" / "data_out_gt",
+        workdir / "lab2" / "data_out_gt",
+        dirs_exist_ok=True,
+    )
     srcdir = workdir / "lab2" / "src"
-    srcdir.mkdir()
+    srcdir.mkdir(exist_ok=True)
     client = ROOT / "native" / "bin" / "tpulab_client"
     if not client.exists():
         raise SystemExit("native client missing; run tools/build_native.py first")
@@ -67,18 +71,23 @@ def stage_workdir(workdir: pathlib.Path) -> pathlib.Path:
 def start_daemon(workdir: pathlib.Path, env: dict) -> tuple:
     sock = str(workdir / "daemon.sock")
     env = dict(env, TPULAB_DAEMON_SOCKET=sock, PYTHONPATH=str(ROOT))
+    # log to a file, not a PIPE: nobody drains a pipe during the harness
+    # run, and a full pipe buffer would block the daemon's writes
+    log = open(workdir / "daemon.log", "w")
     proc = subprocess.Popen(
         [sys.executable, "-m", "tpulab.daemon", "--socket", sock],
         cwd=workdir,
         env=env,
-        stdout=subprocess.PIPE,
+        stdout=log,
         stderr=subprocess.STDOUT,
         text=True,
     )
     deadline = time.time() + 60
     while time.time() < deadline:
         if proc.poll() is not None:
-            raise SystemExit(f"daemon died: {proc.stdout.read()}")
+            raise SystemExit(
+                f"daemon died: {(workdir / 'daemon.log').read_text()[-2000:]}"
+            )
         try:
             s = socket.socket(socket.AF_UNIX)
             s.connect(sock)

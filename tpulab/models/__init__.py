@@ -2,7 +2,9 @@
 
 from tpulab.models.labformer import (
     LabformerConfig,
+    expert_load,
     forward,
+    forward_with_aux,
     init_params,
     init_train_state,
     loss_fn,
@@ -12,7 +14,9 @@ from tpulab.models.labformer import (
 
 __all__ = [
     "LabformerConfig",
+    "expert_load",
     "forward",
+    "forward_with_aux",
     "init_params",
     "init_train_state",
     "loss_fn",

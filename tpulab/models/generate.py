@@ -61,7 +61,8 @@ def _decode_block(x, layer, k_cache, v_cache, pos, cfg: LabformerConfig):
     v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
     o = _attend_cached(q, k_cache, v_cache, pos)
     x = x + o.reshape(b, 1, cfg.d_model) @ layer["wo"]
-    x = x + _mlp(_rmsnorm(x, layer["ln2"]), layer, cfg)
+    y, _ = _mlp(_rmsnorm(x, layer["ln2"]), layer, cfg)  # aux unused at decode
+    x = x + y
     return x, k_cache, v_cache
 
 

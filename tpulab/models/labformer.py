@@ -73,6 +73,11 @@ class LabformerConfig:
     # (tpulab.parallel.moe) — requires a mesh with dp/sp axes
     moe_impl: str = "dense"
     moe_capacity_factor: float = 2.0
+    # switch-transformer router load-balancing loss weight (Fedus et al.
+    # 2021 eq. 4: E * sum_e fraction_e * mean_prob_e, averaged over
+    # layers).  Without it top-1 routing collapses onto one expert under
+    # training and the all_to_all dispatch path becomes dead weight.
+    moe_aux_weight: float = 0.01
 
     def __post_init__(self):
         # silent-fallback guard: a typoed impl name must not run another
@@ -242,8 +247,35 @@ def _attention(x, layer, cfg: LabformerConfig, mesh: Optional[Mesh], positions):
     return o.reshape(b, s, d) @ layer["wo"]
 
 
+def _moe_aux_loss(gate, top, n_experts: int):
+    """Switch load-balancing loss and per-expert load: ``(aux, f)``.
+
+    ``aux = E * sum_e f_e * P_e`` (f32 scalar; Fedus et al. 2021 eq. 4)
+    where ``f_e`` = fraction of tokens argmax-routed to expert e and
+    ``P_e`` = mean router probability of e.  ``aux == 1`` at a uniform
+    spread and grows toward E as routing concentrates; differentiable
+    through ``P_e`` (f_e is piecewise constant), which is exactly the
+    switch-transformer gradient.  Takes the already-computed gate so the
+    router matmul isn't paid twice.
+    """
+    f = jnp.mean(jax.nn.one_hot(top, n_experts, dtype=jnp.float32), axis=(0, 1))
+    p = jnp.mean(gate, axis=(0, 1))
+    return n_experts * jnp.sum(f * p), f
+
+
 def _mlp(x, layer, cfg: LabformerConfig, mesh: Optional[Mesh] = None):
+    """Returns ``(y, (aux, f))``: block output, router load-balancing
+    scalar, and per-expert load fractions ((1,) zeros for dense MLP)."""
+    if cfg.n_experts:
+        gate = jax.nn.softmax((x @ layer["router"]).astype(jnp.float32), axis=-1)
+        top = jnp.argmax(gate, axis=-1)  # (b, s)
+        aux = _moe_aux_loss(gate, top, cfg.n_experts)
+    else:
+        aux = (jnp.float32(0.0), jnp.zeros((1,), jnp.float32))
     if cfg.n_experts and cfg.moe_impl == "dispatch" and mesh is not None:
+        # the dispatch body recomputes its own gate per shard inside
+        # shard_map (routing and dispatch must agree locally); the outer
+        # gate above feeds only the aux statistics
         from tpulab.parallel.moe import _moe_body
 
         axes = tuple(a for a in ("dp", "sp") if a in mesh.axis_names)
@@ -263,24 +295,22 @@ def _mlp(x, layer, cfg: LabformerConfig, mesh: Optional[Mesh] = None):
             in_specs=(P(axes, None), P(), P(axes, None, None), P(axes, None, None)),
             out_specs=P(axes, None),
         )(flat, layer["router"], layer["w1"], layer["w2"])
-        return y.reshape(b, s, d)
+        return y.reshape(b, s, d), aux
     if cfg.n_experts:
         # exact top-1 switch: dense expert compute, one-hot gate select
-        logits = x @ layer["router"]                     # (b, s, E)
-        gate = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-        top = jnp.argmax(gate, axis=-1)                  # (b, s)
+        # (gate/top reused from the aux computation above)
         onehot = jax.nn.one_hot(top, cfg.n_experts, dtype=x.dtype)
         weight = jnp.sum(gate.astype(x.dtype) * onehot, axis=-1)  # (b, s)
         hidden = jnp.einsum("bsd,edf->bsef", x, layer["w1"])
         hidden = jax.nn.gelu(hidden)
         out = jnp.einsum("bsef,efd->bsed", hidden, layer["w2"])
         out = jnp.einsum("bsed,bse->bsd", out, onehot)
-        return out * weight[..., None]
-    return jax.nn.gelu(x @ layer["w1"]) @ layer["w2"]
+        return out * weight[..., None], aux
+    return jax.nn.gelu(x @ layer["w1"]) @ layer["w2"], aux
 
 
-def forward(params, tokens, cfg: LabformerConfig, mesh: Optional[Mesh] = None):
-    """Logits for next-token prediction; ``tokens`` (batch, seq) int32.
+def _forward_scan(params, tokens, cfg: LabformerConfig, mesh: Optional[Mesh]):
+    """(logits, aux_per_layer, load_per_layer).
 
     The ``lax.scan`` over the stacked layer axis is the pipeline: with
     the layer axis sharded over ``pp``, each scan step's weights live on
@@ -295,27 +325,54 @@ def forward(params, tokens, cfg: LabformerConfig, mesh: Optional[Mesh] = None):
 
     def block(x, layer):
         x = x + _attention(_rmsnorm(x, layer["ln1"]), layer, cfg, mesh, positions)
-        x = x + _mlp(_rmsnorm(x, layer["ln2"]), layer, cfg, mesh)
+        y, aux_f = _mlp(_rmsnorm(x, layer["ln2"]), layer, cfg, mesh)
+        x = x + y
         if mesh is not None:
             x = jax.lax.with_sharding_constraint(
                 x, NamedSharding(mesh, _restrict(ACT_SPEC, mesh))
             )
-        return x, None
+        return x, aux_f
 
     if cfg.remat:
         block = jax.checkpoint(block)
-    x, _ = jax.lax.scan(block, x, params["blocks"])
+    x, (aux_per_layer, load_per_layer) = jax.lax.scan(block, x, params["blocks"])
     x = _rmsnorm(x, params["final_norm"])
-    return x @ params["embed"].T  # tied output head
+    return x @ params["embed"].T, aux_per_layer, load_per_layer  # tied head
+
+
+def forward_with_aux(
+    params, tokens, cfg: LabformerConfig, mesh: Optional[Mesh] = None
+):
+    """(logits, aux): next-token logits and the mean per-layer router
+    load-balancing loss (0 when the model has no experts)."""
+    logits, aux_per_layer, _ = _forward_scan(params, tokens, cfg, mesh)
+    return logits, jnp.mean(aux_per_layer)
+
+
+def expert_load(params, tokens, cfg: LabformerConfig, mesh: Optional[Mesh] = None):
+    """(n_layers, n_experts) fraction of tokens argmax-routed per expert,
+    measured on the TRUE per-layer inputs (the post-attention residual
+    stream) — the router-collapse diagnostic."""
+    _, _, load = _forward_scan(params, tokens, cfg, mesh)
+    return load
+
+
+def forward(params, tokens, cfg: LabformerConfig, mesh: Optional[Mesh] = None):
+    """Logits for next-token prediction; ``tokens`` (batch, seq) int32."""
+    return forward_with_aux(params, tokens, cfg, mesh)[0]
 
 
 def loss_fn(params, tokens, cfg: LabformerConfig, mesh: Optional[Mesh] = None):
-    """Causal next-byte cross entropy."""
-    logits = forward(params, tokens[:, :-1], cfg, mesh)
+    """Causal next-byte cross entropy, plus the weighted router
+    load-balancing loss when the model has experts (cfg.moe_aux_weight)."""
+    logits, aux = forward_with_aux(params, tokens[:, :-1], cfg, mesh)
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
-    return -jnp.mean(ll)
+    loss = -jnp.mean(ll)
+    if cfg.n_experts and cfg.moe_aux_weight:
+        loss = loss + jnp.float32(cfg.moe_aux_weight) * aux
+    return loss
 
 
 def make_train_step(

@@ -30,7 +30,7 @@ from tpulab.parallel.collectives import (
 from tpulab.parallel.halo import roberts_sharded
 from tpulab.parallel.dsort import distributed_sort
 from tpulab.parallel.classify import classify_sharded
-from tpulab.parallel.pipeline import pipeline_apply
+from tpulab.parallel.pipeline import make_pipeline_train_step, pipeline_apply
 from tpulab.parallel.moe import switch_moe, switch_moe_reference
 from tpulab.parallel.multihost import (
     global_mesh,
@@ -54,6 +54,7 @@ __all__ = [
     "ulysses_attention",
     "attention_reference",
     "mesh_anchor",
+    "make_pipeline_train_step",
     "pipeline_apply",
     "switch_moe",
     "switch_moe_reference",

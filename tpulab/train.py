@@ -65,6 +65,9 @@ def train(
     optimizer=None,
     accum: int = 1,
     remat: bool = False,
+    experts: int = 0,
+    moe_impl: str = "dense",
+    moe_aux_weight: float = 0.01,
 ):
     """Run the loop; returns (final_step, last_loss)."""
     import jax
@@ -77,7 +80,15 @@ def train(
     from tpulab.runtime.trace import maybe_trace
 
     cfg = cfg or LabformerConfig(
-        d_model=128, n_heads=8, n_layers=4, d_ff=512, max_seq=seq, remat=remat
+        d_model=128,
+        n_heads=8,
+        n_layers=4,
+        d_ff=512,
+        max_seq=seq,
+        remat=remat,
+        n_experts=experts,
+        moe_impl=moe_impl,
+        moe_aux_weight=moe_aux_weight,
     )
     mesh = None
     if mesh_devices:
@@ -155,6 +166,15 @@ def main(argv=None) -> int:
     ap.add_argument("--trace-dir", default=None, help="JAX profiler output dir")
     ap.add_argument("--accum", type=int, default=1, help="gradient-accumulation microbatches")
     ap.add_argument("--remat", action="store_true", help="rematerialize blocks (jax.checkpoint)")
+    ap.add_argument("--experts", type=int, default=0, help="MoE experts (0 = dense MLP)")
+    ap.add_argument(
+        "--moe-impl", default="dense", choices=("dense", "dispatch"),
+        help="MoE execution: dense gate or all_to_all expert dispatch (needs --mesh)",
+    )
+    ap.add_argument(
+        "--moe-aux-weight", type=float, default=0.01,
+        help="switch-transformer router load-balancing loss weight",
+    )
     args = ap.parse_args(argv)
     step, loss = train(
         steps=args.steps,
@@ -169,6 +189,9 @@ def main(argv=None) -> int:
         trace_dir=args.trace_dir,
         accum=args.accum,
         remat=args.remat,
+        experts=args.experts,
+        moe_impl=args.moe_impl,
+        moe_aux_weight=args.moe_aux_weight,
     )
     print(json.dumps({"final_step": step, "loss": loss}))
     return 0

@@ -114,6 +114,103 @@ class TestImageProcessors:
         assert not bool(df["verified"].any())
         assert (tmp_path / "failed_lab2_corrupt.csv").exists()
 
+    def test_lab2_downloaded_png_extends_dataset(self, tmp_path):
+        """Reference lab2_processor.py:68-73 behavior: extra PNG links
+        are downloaded into the data dir and join the round-robin; the
+        downloaded image is benchmark-only (no golden) so it verifies
+        automatically.  Served from a local HTTP server (zero egress)."""
+        import functools
+        import http.server
+        import shutil
+        import threading
+
+        from PIL import Image
+
+        serve_dir = tmp_path / "www"
+        serve_dir.mkdir()
+        rng = np.random.default_rng(5)
+        Image.fromarray(
+            rng.integers(0, 255, (6, 7, 4), dtype=np.uint8), "RGBA"
+        ).save(serve_dir / "extra.png")
+        handler = functools.partial(
+            http.server.SimpleHTTPRequestHandler, directory=str(serve_dir)
+        )
+        httpd = http.server.ThreadingHTTPServer(("localhost", 0), handler)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            url = f"http://localhost:{httpd.server_address[1]}/extra.png"
+            data_dir = tmp_path / "data"
+            shutil.copytree(os.path.join(REPO, "data/lab2/data"), data_dir)
+            n_base = len(
+                Lab2Processor(
+                    dir_to_data=str(data_dir),
+                    dir_to_data_out=str(tmp_path / "out0"),
+                    log=lambda *a: None,
+                ).dataset.paths
+            )
+            proc = Lab2Processor(
+                dir_to_data=str(data_dir),
+                dir_to_data_out=str(tmp_path / "out"),
+                dir_to_data_out_gt=os.path.join(REPO, "data/lab2/data_out_gt"),
+                extra_links_to_png=[url],
+                log=lambda *a: None,
+            )
+            assert len(proc.dataset.paths) == n_base + 1
+            target = InProcessTarget(
+                name="lab2_tpu", workload="lab2", sweep=True,
+                config={"warmup": 0, "reps": 1},
+            )
+            tester = make_tester(
+                target, tmp_path, k_times=n_base + 1,
+                kernel_sizes=[[[32, 32], [16, 16]]],
+            )
+            df = run_tester(tester, proc)
+            assert bool((df["verified"] == True).all())  # noqa: E712
+            assert len(df) == n_base + 1  # the extra PNG really ran
+        finally:
+            httpd.shutdown()
+
+    def test_downloads_redirect_away_from_protected_dir(self, tmp_path, monkeypatch):
+        """A read-only (protected) data dir must not receive downloads;
+        they land under data_out/_downloads instead."""
+        import functools
+        import http.server
+        import threading
+
+        from PIL import Image
+
+        from tpulab.harness.processors.imageset import ImageDataset
+
+        serve_dir = tmp_path / "www"
+        serve_dir.mkdir()
+        Image.new("RGBA", (3, 3), (1, 2, 3, 255)).save(serve_dir / "x.png")
+        handler = functools.partial(
+            http.server.SimpleHTTPRequestHandler, directory=str(serve_dir)
+        )
+        httpd = http.server.ThreadingHTTPServer(("localhost", 0), handler)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        try:
+            import shutil as _sh
+
+            data_dir = str(tmp_path / "data")  # hermetic copy, marked protected
+            _sh.copytree(os.path.join(REPO, "data/lab2/data"), data_dir)
+            monkeypatch.setenv("TPULAB_PROTECTED_DIRS", data_dir)
+            before = set(os.listdir(data_dir))
+            out_dir = tmp_path / "out"
+            ds = ImageDataset(
+                data_dir,
+                str(out_dir),
+                extra_links_to_png=[
+                    f"http://localhost:{httpd.server_address[1]}/x.png"
+                ],
+            )
+            extras = [p for p in ds.paths if p.startswith(str(out_dir))]
+            assert len(extras) == 1 and os.path.exists(extras[0])
+            assert os.sep + "_downloads" + os.sep in extras[0]
+            assert set(os.listdir(data_dir)) == before  # protected dir untouched
+        finally:
+            httpd.shutdown()
+
     def test_lab3_golden_sweep(self, tmp_path):
         proc = Lab3Processor(
             dir_to_data=os.path.join(REPO, "data/lab3/data"),

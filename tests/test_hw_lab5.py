@@ -104,3 +104,20 @@ class TestTpuInfo:
         out = tpu_info.run("")
         assert "Device 0:" in out and "platform: cpu" in out
         assert "num_devices: 8" in out  # virtual CPU mesh from conftest
+        assert "ici_num_chips: 8" in out  # fleet topology section
+
+    def test_generation_limits_table(self):
+        """The gpu_info launch-limit analog: VMEM / MXU / VPU limits are
+        reported for known TPU generations and omitted for unknowns."""
+        from tpulab.runtime.device import generation_limits
+
+        v5e = generation_limits("TFRT TPU v5 lite")
+        assert v5e["mxu_shape"] == (128, 128)
+        assert v5e["bf16_peak_tflops_per_chip"] == 197
+        assert generation_limits("cpu") == {}
+
+    def test_ici_topology_shape(self):
+        from tpulab.runtime.device import ici_topology
+
+        topo = ici_topology()
+        assert topo["num_chips"] == 8  # virtual CPU fleet

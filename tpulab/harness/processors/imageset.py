@@ -13,7 +13,8 @@ from __future__ import annotations
 import os
 import re
 import shutil
-from typing import Dict, List, Optional, Tuple
+import uuid
+from typing import Dict, List, Optional, Sequence, Tuple
 
 from tpulab.io.imagefile import save_image
 from tpulab.utils.imgdata import ImgData, _is_protected
@@ -64,20 +65,46 @@ class ImageDataset:
         dir_to_data_out: Optional[str] = None,
         dir_to_data_out_gt: Optional[str] = None,
         reset_out: bool = True,
+        extra_links_to_png: Optional[Sequence[str]] = None,
     ):
         self.dir_to_data = dir_to_data
         self.dir_to_data_out = dir_to_data_out or os.path.join(dir_to_data, "..", "data_out")
         self.dir_to_data_out_gt = dir_to_data_out_gt or os.path.join(
             dir_to_data, "..", "data_out_gt"
         )
+        # reset the out dir BEFORE downloading: a protected data dir
+        # redirects downloads under dir_to_data_out, which the reset wipes
+        if reset_out and not _is_protected(self.dir_to_data_out):
+            shutil.rmtree(self.dir_to_data_out, ignore_errors=True)
+        os.makedirs(self.dir_to_data_out, exist_ok=True)
         self.paths = scan_images(dir_to_data)
+        self.paths += self._download_extras(extra_links_to_png or ())
         if not self.paths:
             raise FileNotFoundError(f"no images found in {dir_to_data!r}")
         self._idx = 0
         self._load_cache: Dict[str, Tuple[str, ImgData]] = {}
-        if reset_out and not _is_protected(self.dir_to_data_out):
-            shutil.rmtree(self.dir_to_data_out, ignore_errors=True)
-        os.makedirs(self.dir_to_data_out, exist_ok=True)
+
+    def _download_extras(self, links: Sequence[str]) -> List[str]:
+        """Downloaded PNGs extend the dataset (reference
+        lab2_processor.py:68-73: each extra link lands in the data dir
+        under a uuid name).  A protected/read-only data dir redirects the
+        download next to the outputs; failed downloads (air-gapped
+        environments) are skipped with a log line, not fatal."""
+        if isinstance(links, str):  # bare --extra_links_to_png URL kwarg
+            links = [links]
+        if not links:
+            return []
+        from tpulab.utils.download import download_file
+
+        save_dir = self.dir_to_data
+        if _is_protected(save_dir) or not os.access(save_dir, os.W_OK):
+            save_dir = os.path.join(self.dir_to_data_out, "_downloads")
+        got = []
+        for url in links:
+            path = download_file(url, save_dir, filename=f"{uuid.uuid4()}.png")
+            if path:
+                got.append(path)
+        return got
 
     def next_item(self) -> Tuple[str, Optional[str]]:
         """(input path, golden path or None), round-robin.

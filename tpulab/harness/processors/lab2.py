@@ -28,6 +28,7 @@ class Lab2Processor(WorkloadProcessor):
         dir_to_data_out: Optional[str] = None,
         dir_to_data_out_gt: Optional[str] = None,
         verbose_diff: bool = True,
+        extra_links_to_png: Optional[list] = None,
         log=print,
         **_ignored,
     ):
@@ -36,6 +37,7 @@ class Lab2Processor(WorkloadProcessor):
             os.path.normpath(dir_to_data or DEFAULT_DATA_DIR),
             dir_to_data_out,
             dir_to_data_out_gt,
+            extra_links_to_png=extra_links_to_png,
         )
         self.verbose_diff = verbose_diff
         self.log = log

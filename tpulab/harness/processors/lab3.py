@@ -55,6 +55,7 @@ class Lab3Processor(WorkloadProcessor):
         count_pts: int = 4,
         pinned_points: Optional[Dict[str, List[np.ndarray]]] = None,
         verbose_diff: bool = True,
+        extra_links_to_png: Optional[List[str]] = None,
         log=print,
         **_ignored,
     ):
@@ -65,6 +66,7 @@ class Lab3Processor(WorkloadProcessor):
             os.path.normpath(dir_to_data or DEFAULT_DATA_DIR),
             dir_to_data_out,
             dir_to_data_out_gt,
+            extra_links_to_png=extra_links_to_png,
         )
         self.count_classes = count_classes
         self.count_pts = max(2, count_pts)  # 1 point -> degenerate /(np-1)

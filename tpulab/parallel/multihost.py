@@ -27,6 +27,7 @@ import os
 from typing import Dict, Optional, Sequence
 
 import jax
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpulab.parallel.mesh import best_factorization

@@ -100,6 +100,57 @@ def resolve_device(backend: str | None):
     return jax.devices(backend)[0]
 
 
+# Per-generation architectural limits — the ``gpu_info`` launch-limit
+# analog (reference gpu_info/src/main.cu:4-19 prints shared/constant
+# memory, max threads/grid dims, SM count).  TPU's equivalents are the
+# VMEM budget a Pallas kernel tiles into, the MXU systolic-array shape
+# the compiler maps matmuls onto, and the VPU vector-register lane
+# layout.  Values from the public JAX/TPU system documentation; matched
+# against ``device_kind`` by substring.
+TPU_GENERATION_LIMITS = {
+    "v4": {"vmem_per_core_bytes": 16 * 2**20, "mxu_shape": (128, 128),
+           "vpu_lanes": 128, "vpu_sublanes": 8, "hbm_gib_per_chip": 32,
+           "bf16_peak_tflops_per_chip": 275},
+    "v5 lite": {"vmem_per_core_bytes": 128 * 2**20, "mxu_shape": (128, 128),
+                "vpu_lanes": 128, "vpu_sublanes": 8, "hbm_gib_per_chip": 16,
+                "bf16_peak_tflops_per_chip": 197},
+    "v5e": {"vmem_per_core_bytes": 128 * 2**20, "mxu_shape": (128, 128),
+            "vpu_lanes": 128, "vpu_sublanes": 8, "hbm_gib_per_chip": 16,
+            "bf16_peak_tflops_per_chip": 197},
+    "v5p": {"vmem_per_core_bytes": 128 * 2**20, "mxu_shape": (128, 128),
+            "vpu_lanes": 128, "vpu_sublanes": 8, "hbm_gib_per_chip": 95,
+            "bf16_peak_tflops_per_chip": 459},
+    "v6": {"vmem_per_core_bytes": 128 * 2**20, "mxu_shape": (256, 256),
+           "vpu_lanes": 128, "vpu_sublanes": 8, "hbm_gib_per_chip": 32,
+           "bf16_peak_tflops_per_chip": 918},
+}
+
+
+def generation_limits(device_kind: str) -> Dict[str, Any]:
+    """Architectural limits for a ``device_kind`` string (empty if unknown)."""
+    kind = device_kind.lower()
+    for key, limits in TPU_GENERATION_LIMITS.items():
+        if key in kind:
+            return dict(limits)
+    return {}
+
+
+def ici_topology() -> Dict[str, Any]:
+    """Interconnect picture of the attached fleet: per-dimension coordinate
+    bounds of the chip grid (the ICI mesh), plus slice structure when the
+    runtime exposes it — the multi-chip half of the gpu_info analog."""
+    devs = jax.devices()
+    topo: Dict[str, Any] = {"num_chips": len(devs)}
+    coords = [getattr(d, "coords", None) for d in devs]
+    if all(c is not None for c in coords) and coords:
+        arr = np.asarray(coords)
+        topo["mesh_shape"] = tuple(int(n) for n in arr.max(0) - arr.min(0) + 1)
+    slices = {getattr(d, "slice_index", 0) for d in devs}
+    if len(slices) > 1:
+        topo["num_slices"] = len(slices)
+    return topo
+
+
 def device_info(device=None) -> Dict[str, Any]:
     """Structured device description (the ``tpu_info`` payload)."""
     d = device if device is not None else default_device()
@@ -112,6 +163,10 @@ def device_info(device=None) -> Dict[str, Any]:
         "num_local_devices": jax.local_device_count(),
         "num_processes": jax.process_count(),
     }
+    try:
+        info["platform_version"] = d.client.platform_version
+    except Exception:
+        pass
     coords = getattr(d, "coords", None)
     if coords is not None:
         info["coords"] = tuple(coords)
@@ -126,6 +181,9 @@ def device_info(device=None) -> Dict[str, Any]:
         for key in ("bytes_limit", "bytes_in_use", "peak_bytes_in_use"):
             if key in stats:
                 info[key] = stats[key]
+    info.update(generation_limits(info["device_kind"]))
+    for key, val in ici_topology().items():
+        info[f"ici_{key}"] = val
     return info
 
 

@@ -28,17 +28,21 @@ from tpulab.io.imagefile import (
 
 # Directories the framework must never write into, even for the
 # sibling-format cache (the read-only reference snapshot may be mounted rw).
-PROTECTED_PREFIXES = tuple(
-    os.path.abspath(p)
-    for p in os.environ.get("TPULAB_PROTECTED_DIRS", "/root/reference").split(":")
-    if p
-)
+# Read per call, not at import: the guard must honor TPULAB_PROTECTED_DIRS
+# changes made after tpulab is imported (tests, embedding applications).
+def _protected_prefixes() -> tuple:
+    return tuple(
+        os.path.abspath(p)
+        for p in os.environ.get("TPULAB_PROTECTED_DIRS", "/root/reference").split(":")
+        if p
+    )
 
 
 def _is_protected(directory: str) -> bool:
     directory = os.path.abspath(directory)
     return any(
-        directory == p or directory.startswith(p + os.sep) for p in PROTECTED_PREFIXES
+        directory == p or directory.startswith(p + os.sep)
+        for p in _protected_prefixes()
     )
 
 

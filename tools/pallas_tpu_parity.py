@@ -1,0 +1,169 @@
+"""Real-TPU Pallas parity artifact: compiled Mosaic kernels vs XLA twins.
+
+Every Pallas kernel in the unit suite runs ``interpret=True`` on the CPU
+mesh; this script is the committed proof that the *compiled* (Mosaic)
+lowering of each kernel is correct on actual TPU hardware.  The kernels
+ARE the product (reference lab2/src/main.cu:15-52, lab3/src/main.cu:40-76,
+lab1/src/main.cu:22-29), so their hardware lowering gets its own pinned
+artifact: ``results/pallas_tpu_parity.json``.
+
+Checks (all compiled, interpret=False, on the real chip):
+  - elementwise subtract (lab1 kernel) vs fused-XLA subtract: bit-exact
+  - Roberts halo-DMA stencil (lab2) vs XLA roberts_edges: bit-exact
+  - Mahalanobis classify (lab3) vs XLA classify_labels: bit-exact labels
+  - flash attention vs naive XLA softmax attention: f32 tolerance
+
+Usage: python tools/pallas_tpu_parity.py [--out results/pallas_tpu_parity.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+import numpy as np
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _naive_attention(q, k, v, causal: bool):
+    """O(s^2) reference attention in f32 over (b, s, h, d)."""
+    import jax.numpy as jnp
+
+    qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * scale
+    if causal:
+        s = q.shape[1]
+        mask = np.tril(np.ones((s, s), bool))
+        logits = jnp.where(mask[None, None], logits, -jnp.inf)
+    w = jnp.exp(logits - logits.max(-1, keepdims=True))
+    w = w / w.sum(-1, keepdims=True)
+    return jnp.einsum("bhqk,bkhd->bqhd", w, vf)
+
+
+def run_checks() -> list:
+    import jax
+    import jax.numpy as jnp
+
+    from tpulab.ops.elementwise import make_binary_fn
+    from tpulab.ops.mahalanobis import class_statistics, classify_labels
+    from tpulab.ops.pallas.attention import flash_attention
+    from tpulab.ops.pallas.classify import classify_labels_pallas
+    from tpulab.ops.pallas.elementwise import pallas_binary
+    from tpulab.ops.pallas.stencil import roberts_pallas
+    from tpulab.ops.roberts import roberts_edges
+
+    rng = np.random.default_rng(2026)
+    checks = []
+
+    # lab1: f32 subtract, awkward (non-aligned) length
+    n = 123_457
+    a = jnp.asarray(rng.standard_normal(n).astype(np.float32))
+    b = jnp.asarray(rng.standard_normal(n).astype(np.float32))
+    got = np.asarray(pallas_binary(a, b, interpret=False))
+    want = np.asarray(make_binary_fn("subtract", jnp.float32)(a, b))
+    checks.append({
+        "kernel": "pallas_elementwise_subtract",
+        "shape": [n],
+        "dtype": "float32",
+        "bit_exact": bool(np.array_equal(got, want)),
+        "max_abs_err": float(np.max(np.abs(got - want))),
+    })
+
+    # lab2: Roberts stencil, non-multiple-of-tile image with alpha variety
+    h, w = 1021, 1531
+    img = rng.integers(0, 256, size=(h, w, 4), dtype=np.uint8)
+    imgj = jnp.asarray(img)
+    got = np.asarray(roberts_pallas(imgj, interpret=False))
+    want = np.asarray(roberts_edges(imgj))
+    checks.append({
+        "kernel": "pallas_roberts_stencil",
+        "shape": [h, w, 4],
+        "dtype": "uint8",
+        "bit_exact": bool(np.array_equal(got, want)),
+        "mismatch_px": int((got != want).any(-1).sum()),
+    })
+
+    # lab3: Mahalanobis classify, 5 classes incl. a 2-point (near-degenerate
+    # covariance) class, odd image size
+    h, w = 777, 513
+    img = rng.integers(0, 256, size=(h, w, 4), dtype=np.uint8)
+    classes = [
+        np.stack([rng.integers(0, w, size=k), rng.integers(0, h, size=k)], axis=1)
+        for k in (4, 7, 2, 16, 9)
+    ]
+    stats = class_statistics(img, classes)
+    imgj = jnp.asarray(img)
+    mu = jnp.asarray(stats.mean)
+    ic = jnp.asarray(stats.inv_cov)
+    got = np.asarray(classify_labels_pallas(imgj, mu, ic, interpret=False))
+    want = np.asarray(classify_labels(imgj, mu, ic))
+    checks.append({
+        "kernel": "pallas_mahalanobis_classify",
+        "shape": [h, w],
+        "n_classes": len(classes),
+        "bit_exact": bool(np.array_equal(got, want)),
+        "mismatch_px": int((got != want).sum()),
+    })
+
+    # flash attention: causal, seq not a block multiple, bf16 inputs
+    b_, s, nh, d = 2, 1536, 4, 64
+    q = jnp.asarray(rng.standard_normal((b_, s, nh, d)).astype(np.float32) * 0.5,
+                    jnp.bfloat16)
+    k = jnp.asarray(rng.standard_normal((b_, s, nh, d)).astype(np.float32) * 0.5,
+                    jnp.bfloat16)
+    v = jnp.asarray(rng.standard_normal((b_, s, nh, d)).astype(np.float32),
+                    jnp.bfloat16)
+    got = np.asarray(
+        flash_attention(q, k, v, causal=True, block_q=512, block_k=512,
+                        interpret=False).astype(jnp.float32)
+    )
+    want = np.asarray(_naive_attention(q, k, v, causal=True))
+    err = np.max(np.abs(got - want))
+    checks.append({
+        "kernel": "pallas_flash_attention",
+        "shape": [b_, s, nh, d],
+        "dtype": "bfloat16",
+        "max_abs_err": float(err),
+        "tol": 2e-2,  # bf16 inputs, f32 accumulation
+        "within_tol": bool(err < 2e-2),
+    })
+    return checks
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=str(ROOT / "results" / "pallas_tpu_parity.json"))
+    args = ap.parse_args(argv)
+
+    import jax
+
+    dev = jax.devices()[0]
+    if dev.platform != "tpu":
+        print(f"refusing to run: default device is {dev.platform}, not tpu "
+              "(this artifact pins the Mosaic lowering on real hardware)",
+              file=sys.stderr)
+        return 2
+
+    checks = run_checks()
+    ok = all(c.get("bit_exact", c.get("within_tol", False)) for c in checks)
+    report = {
+        "device_kind": dev.device_kind,
+        "jax_version": jax.__version__,
+        "compiled": True,
+        "interpret": False,
+        "ok": ok,
+        "checks": checks,
+    }
+    out = pathlib.Path(args.out)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(json.dumps(report, indent=2) + "\n")
+    print(json.dumps(report, indent=2))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

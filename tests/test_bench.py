@@ -1,0 +1,57 @@
+"""Bench accounting units: analytic labformer FLOPs + MFU fields.
+
+Timing benchmarks themselves are hardware-bound (see RESULTS.md /
+BENCH_r*.json); what is testable hermetically is the accounting — the
+analytic FLOPs formula (used because XLA's cost model counts a
+``lax.scan`` body once regardless of trip count) and the MFU math.
+"""
+
+import numpy as np
+
+from tpulab.bench import _mfu_fields, labformer_fwd_flops
+
+
+class _Cfg:
+    d_model = 4
+    d_ff = 8
+    n_layers = 2
+    vocab = 16
+
+
+def test_labformer_fwd_flops_hand_computed():
+    # per token: 2 * 2 layers * (4*4*4 + 2*4*8) + 2*4*16 = 4*(64+64)+128 = 640
+    # attention: 2 layers * 4*s*s*d / 2 (causal) with s=3, d=4 = 2*4*9*4/2 = 144
+    # batch 5: 5 * (3*640 + 144) = 5 * 2064 = 10320
+    assert labformer_fwd_flops(_Cfg, b=5, s=3) == 10320
+    # non-causal doubles only the attention term
+    assert labformer_fwd_flops(_Cfg, b=5, s=3, causal=False) == 5 * (3 * 640 + 288)
+
+
+def test_labformer_fwd_flops_matches_real_config_scale():
+    from tpulab.models.labformer import LabformerConfig
+
+    cfg = LabformerConfig(d_model=512, n_heads=8, n_layers=8, d_ff=2048, max_seq=512)
+    got = labformer_fwd_flops(cfg, b=8, s=512)
+    # 2*params*tokens dominates: params ~ 8*(4*512^2 + 2*512*2048) = 25.2M
+    approx = 2 * 25_165_824 * 8 * 512
+    assert 1.0 < got / approx < 1.15  # logits + causal attention on top
+
+
+class _Dev:
+    device_kind = "TPU v5 lite"
+
+
+def test_mfu_fields_math():
+    # 197 TFLOP/s peak (v5 lite table): 98.5 TFLOP/s achieved = 50%
+    f = _mfu_fields(98.5e9, 1.0, _Dev())  # 98.5 GFLOP in 1 ms
+    assert f["achieved_tflops"] == 98.5
+    assert f["mfu_pct_of_bf16_peak"] == 50.0
+    assert f["peak_tflops"] == 197
+
+
+def test_mfu_fields_empty_without_peak_or_flops():
+    class Unknown:
+        device_kind = "host"
+
+    assert _mfu_fields(1e9, 1.0, Unknown()) == {}
+    assert _mfu_fields(0, 1.0, _Dev()) == {}

@@ -21,6 +21,46 @@ CUDA_BASELINES_MS = {
 }
 
 
+def labformer_fwd_flops(cfg, b: int, s: int, causal: bool = True) -> int:
+    """Analytic model FLOPs for one labformer forward (multiply-add = 2).
+
+    The scaling-book convention: matmul FLOPs only (projections, MLP,
+    logits, attention score/value contractions; causal attention counts
+    half the score matrix).  Analytic, NOT ``compiled.cost_analysis()``:
+    the layer stack runs under ``lax.scan`` and XLA's cost model counts
+    the scan body once regardless of trip count, underreporting an
+    ``n_layers``-deep model by ~``n_layers``x.
+    """
+    d, dff = cfg.d_model, cfg.d_ff
+    per_tok = 2 * cfg.n_layers * (4 * d * d + 2 * d * dff) + 2 * d * cfg.vocab
+    attn = cfg.n_layers * 4 * s * s * d  # QK^T + PV, all heads
+    if causal:
+        attn //= 2
+    return b * (s * per_tok + attn)
+
+
+def _mfu_fields(flops: float, ms: float, device) -> Dict[str, Any]:
+    """Achieved TFLOP/s and %-of-peak for ``flops`` model FLOPs in ``ms``.
+
+    Peak comes from the device generation table (runtime.device) — bf16
+    systolic peak, the denominator of the scaling-book MFU convention.
+    """
+    from tpulab.runtime.device import generation_limits
+
+    peak = generation_limits(getattr(device, "device_kind", "")).get(
+        "bf16_peak_tflops_per_chip"
+    )
+    if flops <= 0 or not peak:
+        return {}
+    achieved = flops / (ms / 1e3) / 1e12
+    return {
+        "model_flops": float(flops),
+        "achieved_tflops": round(achieved, 2),
+        "mfu_pct_of_bf16_peak": round(100.0 * achieved / peak, 2),
+        "peak_tflops": peak,
+    }
+
+
 def bench_lab1(n: int = 1000, dtype: str = "float64", reps: int = 20) -> Dict[str, Any]:
     import jax
     import jax.numpy as jnp
@@ -81,6 +121,7 @@ def bench_labformer(
         "unit": "tokens/s",
         "vs_baseline": None,
         "device": device.platform,
+        **_mfu_fields(labformer_fwd_flops(cfg, b, s), ms, device),
     }
 
 
@@ -141,12 +182,14 @@ def bench_flash_attention(s: int = 32768, reps: int = 5) -> Dict[str, Any]:
     )
     ms, _ = measure_ms(lambda q, k, v: flash_attention(q, k, v), (q, k, v),
                        warmup=2, reps=max(reps, 5))
+    flops = 8 * (4 * s * s * 64) // 2  # QK^T + PV x 8 heads, causal half
     return {
         "metric": f"flash_attention_s{s}_h8_d64_bf16_median_ms",
         "value": round(ms, 4),
         "unit": "ms",
         "vs_baseline": None,  # dense attention OOMs at this length
         "device": device.platform,
+        **_mfu_fields(flops, ms, device),
     }
 
 
@@ -229,5 +272,8 @@ def run_benchmarks(only: Optional[str] = None, **kw) -> List[Dict[str, Any]]:
             else set()
         )
         accepted = {k: v for k, v in kw.items() if k in params and k not in bound}
-        rows.append(fn(**accepted))
+        try:
+            rows.append(fn(**accepted))
+        except Exception as e:  # one broken bench must not hide the rest
+            rows.append({"metric": name, "error": f"{type(e).__name__}: {e}"})
     return rows

@@ -45,32 +45,46 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
         l_ref[:] = jnp.zeros_like(l_ref)
         acc_ref[:] = jnp.zeros_like(acc_ref)
 
-    # np.float32 scale, not np.float64: under the global x64 a float64
-    # scalar would promote the product and poison the f32 scratch refs
-    q = q_ref[0].astype(jnp.float32) * np.float32(scale)  # (bq, d)
-    k = k_ref[0].astype(jnp.float32)                  # (bk, d)
-    v = v_ref[0].astype(jnp.float32)                  # (bk, d)
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # (bq, bk) f32
+    # Causal: strictly-upper-triangle K blocks (first k position past the
+    # last q position of this Q block) contribute nothing — skip their
+    # matmuls entirely.  The scratch carries (m, l, acc) across the
+    # skipped steps untouched, halving MXU work for long sequences.  The
+    # final o_ref write below stays OUTSIDE the skip: for short-q rows
+    # the last K steps are all masked, and kb == n_k-1 must still flush.
+    active = (kb * block_k <= qb * block_q + block_q - 1) if causal else None
+
+    def _compute():
+        # np.float32 scale, not np.float64: under the global x64 a float64
+        # scalar would promote the product and poison the f32 scratch refs
+        q = q_ref[0].astype(jnp.float32) * np.float32(scale)  # (bq, d)
+        k = k_ref[0].astype(jnp.float32)                  # (bk, d)
+        v = v_ref[0].astype(jnp.float32)                  # (bk, d)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # (bq, bk) f32
+
+        if causal:
+            q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            # np.float32 constant: a Python float lowers as f64 under the
+            # global x64 config, which Mosaic cannot truncate
+            s = jnp.where(k_pos <= q_pos, s, np.float32(NEG_INF))
+
+        m_prev = m_ref[:]                                  # (bq, 1)
+        l_prev = l_ref[:]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)                             # (bq, bk)
+        l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ()))
+        )
+        m_ref[:] = m_new
+        l_ref[:] = l_new
 
     if causal:
-        q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
-        k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-        # np.float32 constant: a Python float lowers as f64 under the
-        # global x64 config, which Mosaic cannot truncate
-        s = jnp.where(k_pos <= q_pos, s, np.float32(NEG_INF))
-
-    m_prev = m_ref[:]                                  # (bq, 1)
-    l_prev = l_ref[:]
-    m_cur = jnp.max(s, axis=1, keepdims=True)
-    m_new = jnp.maximum(m_prev, m_cur)
-    alpha = jnp.exp(m_prev - m_new)
-    p = jnp.exp(s - m_new)                             # (bq, bk)
-    l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
-    acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-        p, v, (((1,), (0,)), ((), ()))
-    )
-    m_ref[:] = m_new
-    l_ref[:] = l_new
+        pl.when(active)(_compute)
+    else:
+        _compute()
 
     @pl.when(kb == n_k - 1)
     def _finish():

@@ -58,3 +58,46 @@ class TestFlashAttention:
         np.testing.assert_allclose(
             np.asarray(got, np.float32), np.asarray(want, np.float32), rtol=0.1, atol=0.1
         )
+
+
+class TestFlashBackward:
+    """custom_vjp gradients vs jax.grad through the dense reference."""
+
+    def _grads(self, fn, q, k, v, tgt):
+        import jax
+
+        loss = lambda q, k, v: jnp.sum((fn(q, k, v) - tgt) ** 2)
+        return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+    @pytest.mark.parametrize(
+        "s,bq,bk,causal",
+        [
+            (128, 64, 64, True),
+            (128, 64, 64, False),
+            (128, 32, 64, True),   # unequal blocks
+            (100, 64, 64, True),   # padded seq: pad rows must not leak grad
+        ],
+    )
+    def test_grads_match_reference(self, rng, s, bq, bk, causal):
+        q, k, v = _qkv(rng, s=s)
+        tgt = jnp.asarray(rng.standard_normal(q.shape), jnp.float32)
+        flash = lambda q, k, v: flash_attention(
+            q, k, v, causal=causal, block_q=bq, block_k=bk
+        )
+        dense = lambda q, k, v: attention_reference(q, k, v, causal=causal)
+        got = self._grads(flash, q, k, v, tgt)
+        want = self._grads(dense, q, k, v, tgt)
+        for g, w, name in zip(got, want, "q k v".split()):
+            np.testing.assert_allclose(
+                np.asarray(g), np.asarray(w), rtol=2e-4, atol=2e-4,
+                err_msg=f"d{name} mismatch",
+            )
+
+    def test_bwd_block_cap_preserves_divisibility(self):
+        from tpulab.ops.pallas.attention import _bwd_block
+
+        assert _bwd_block(1024) == 512
+        assert _bwd_block(768) == 384   # halving, not clamping to 512
+        assert _bwd_block(96) == 96
+        for b in (1024, 768, 512, 96, 24):
+            assert b % _bwd_block(b) == 0

@@ -131,6 +131,32 @@ def run_checks() -> list:
         "tol": 2e-2,  # bf16 inputs, f32 accumulation
         "within_tol": bool(err < 2e-2),
     })
+
+    # flash backward (custom_vjp dq/dkv kernels), f32 for a tight bound
+    b_, s, nh, d = 1, 1024, 2, 64
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((b_, s, nh, d)).astype(np.float32) * 0.5)
+        for _ in range(3)
+    )
+    tgt = jnp.asarray(rng.standard_normal((b_, s, nh, d)).astype(np.float32))
+    import jax
+
+    loss_f = lambda q, k, v: jnp.sum(
+        (flash_attention(q, k, v, causal=True, block_q=512, block_k=512,
+                         interpret=False) - tgt) ** 2
+    )
+    loss_n = lambda q, k, v: jnp.sum((_naive_attention(q, k, v, True) - tgt) ** 2)
+    gf = jax.jit(jax.grad(loss_f, argnums=(0, 1, 2)))(q, k, v)
+    gn = jax.jit(jax.grad(loss_n, argnums=(0, 1, 2)))(q, k, v)
+    gerr = max(float(jnp.abs(a - b).max()) for a, b in zip(gf, gn))
+    checks.append({
+        "kernel": "pallas_flash_attention_backward",
+        "shape": [b_, s, nh, d],
+        "dtype": "float32",
+        "max_abs_err": gerr,
+        "tol": 5e-3,  # f32 grads, large-magnitude sum-of-squares loss
+        "within_tol": bool(gerr < 5e-3),
+    })
     return checks
 
 

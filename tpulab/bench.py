@@ -125,6 +125,55 @@ def bench_labformer(
     }
 
 
+def bench_labformer_train(
+    b: int = 8, s: int = 2048, reps: int = 10, dtype: str = "bfloat16"
+) -> Dict[str, Any]:
+    """Flagship training step: tokens/s and MFU on one chip.
+
+    ``s`` defaults past the flash threshold (attn_impl auto >= 1024) so
+    the timed step differentiates THROUGH the Pallas flash kernel via
+    its custom_vjp — the long-context training path.  Model FLOPs follow
+    the standard 3x-forward convention (forward + ~2x backward).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from tpulab.models.labformer import LabformerConfig, init_train_state
+    from tpulab.runtime.device import commit, default_device
+    from tpulab.runtime.timing import measure_ms
+
+    cfg = LabformerConfig(
+        d_model=512,
+        n_heads=8,
+        n_layers=8,
+        d_ff=2048,
+        max_seq=s,
+        dtype={"bfloat16": jnp.bfloat16, "float32": jnp.float32}[dtype],
+    )
+    device = default_device()
+    params, opt_state, step = init_train_state(cfg, mesh=None, seed=0)
+    params = jax.device_put(params, device)
+    opt_state = jax.device_put(opt_state, device)
+    tokens = commit(
+        np.random.default_rng(0).integers(0, cfg.vocab, (b, s + 1)).astype(np.int32),
+        device,
+    )
+    # time the full optimizer step but hold params/opt_state fixed across
+    # reps (feeding outputs back would make reps data-dependent serial
+    # anyway; fixed inputs keep the enqueue-N amortization valid)
+    fn = lambda p, o, t: step(p, o, t)[2]
+    ms, _ = measure_ms(fn, (params, opt_state, tokens), warmup=3, reps=reps)
+    tokens_per_s = b * s / (ms / 1e3)
+    return {
+        "metric": f"labformer_train_b{b}_s{s}_{dtype}_tokens_per_s",
+        "value": round(tokens_per_s, 1),
+        "unit": "tokens/s",
+        "vs_baseline": None,
+        "device": device.platform,
+        **_mfu_fields(3 * labformer_fwd_flops(cfg, b, s), ms, device),
+    }
+
+
 def bench_labformer_decode(
     b: int = 8, steps: int = 128, reps: int = 3, dtype: str = "bfloat16"
 ) -> Dict[str, Any]:
@@ -248,6 +297,7 @@ def run_benchmarks(only: Optional[str] = None, **kw) -> List[Dict[str, Any]]:
         "lab1_n1000": functools.partial(bench_lab1, 1000),
         "lab1_f32_1m": functools.partial(bench_lab1, 1 << 20, dtype="float32"),
         "labformer_fwd": bench_labformer,
+        "labformer_train": bench_labformer_train,
         "labformer_decode": bench_labformer_decode,
         "hw2_sort": bench_sort,
         "lab5_reduce": bench_reduce,

@@ -170,6 +170,20 @@ class TestSharded:
         got = np.asarray(jax.jit(lambda p, t: forward(p, t, uly, mesh=mesh))(sharded, tok_sh))
         np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
 
+    def test_ulysses_flash_local_parity(self, mesh, rng):
+        """Ulysses sp with the Pallas flash kernel as the gathered-sequence
+        local attention (attn_impl=flash) == single-device dense."""
+        import dataclasses
+
+        uly = dataclasses.replace(CFG, sp_impl="ulysses", attn_impl="flash")
+        params = init_params(CFG, seed=0)
+        tokens = _tokens(rng, b=4, s=32)
+        want = np.asarray(forward(params, tokens, CFG, mesh=None))
+        sharded = shard_params(params, CFG, mesh)
+        tok_sh = jax.device_put(tokens, NamedSharding(mesh, _restrict(P("dp", None), mesh)))
+        got = np.asarray(jax.jit(lambda p, t: forward(p, t, uly, mesh=mesh))(sharded, tok_sh))
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
     def test_dispatch_moe_parity(self, mesh, rng):
         """all_to_all expert dispatch == dense-gate MoE at full capacity."""
         import dataclasses

@@ -228,11 +228,18 @@ def _attention(x, layer, cfg: LabformerConfig, mesh: Optional[Mesh], positions):
                     f"ulysses needs local heads divisible by sp: "
                     f"{h} heads / tp={tp} over sp={sp}"
                 )
-            body = functools.partial(_ulysses_body, axis="sp", causal=True)
+            # the gathered-sequence local attention inherits attn_impl:
+            # flash keeps sp long-context training O(seq) per device
+            body = functools.partial(
+                _ulysses_body, axis="sp", causal=True, local_impl=cfg.attn_impl
+            )
         else:
             body = functools.partial(_ring_body, axis="sp", causal=True)
+        # check_vma=False: the ulysses body may lower a pallas_call
+        # (flash local attention), which carries no vma metadata
         o = jax.shard_map(
-            body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec
+            body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+            check_vma=False,
         )(q, k, v)
     else:
         use_flash = cfg.attn_impl == "flash" or (cfg.attn_impl == "auto" and s >= 1024)

@@ -156,7 +156,21 @@ def ring_attention(
     return _ring_attention_sharded(q, k, v, mesh=mesh, axis=axis, causal=causal)
 
 
-def _ulysses_body(q, k, v, *, axis: str, causal: bool):
+def _ulysses_local_attention(q, k, v, causal: bool, local_impl: str):
+    """The per-head-group full-sequence attention inside Ulysses.
+
+    ``flash`` streams the gathered sequence through the Pallas kernel —
+    O(seq) memory where the dense reference materializes the (h/p, s, s)
+    score tensor; trainable via the kernel's custom_vjp.  ``auto`` picks
+    flash from 1024 gathered tokens (mirrors labformer's attn_impl)."""
+    if local_impl == "flash" or (local_impl == "auto" and q.shape[1] >= 1024):
+        from tpulab.ops.pallas.attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal)
+    return attention_reference(q, k, v, causal=causal)
+
+
+def _ulysses_body(q, k, v, *, axis: str, causal: bool, local_impl: str = "dense"):
     """Per-device Ulysses attention (runs in shard_map).
 
     In: (batch, seq/p, heads, d) sequence-sharded.  all_to_all re-shards
@@ -167,17 +181,25 @@ def _ulysses_body(q, k, v, *, axis: str, causal: bool):
     qh = jax.lax.all_to_all(q, axis, split_axis=2, concat_axis=1, tiled=True)
     kh = jax.lax.all_to_all(k, axis, split_axis=2, concat_axis=1, tiled=True)
     vh = jax.lax.all_to_all(v, axis, split_axis=2, concat_axis=1, tiled=True)
-    o = attention_reference(qh, kh, vh, causal=causal)
+    o = _ulysses_local_attention(qh, kh, vh, causal, local_impl)
     return jax.lax.all_to_all(o, axis, split_axis=1, concat_axis=2, tiled=True)
 
 
-@functools.partial(jax.jit, static_argnames=("mesh", "axis", "causal"))
-def _ulysses_sharded(q, k, v, *, mesh: Mesh, axis: str, causal: bool):
+@functools.partial(
+    jax.jit, static_argnames=("mesh", "axis", "causal", "local_impl")
+)
+def _ulysses_sharded(q, k, v, *, mesh: Mesh, axis: str, causal: bool,
+                     local_impl: str = "dense"):
     spec = P(None, axis, None, None)
-    body = functools.partial(_ulysses_body, axis=axis, causal=causal)
-    return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)(
-        q, k, v
+    body = functools.partial(
+        _ulysses_body, axis=axis, causal=causal, local_impl=local_impl
     )
+    # check_vma=False: pallas_call (the flash local attention) does not
+    # annotate varying-mesh-axes metadata on its out_shape
+    return jax.shard_map(
+        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v)
 
 
 def ulysses_attention(
@@ -188,11 +210,14 @@ def ulysses_attention(
     mesh: Optional[Mesh] = None,
     axis: str = "sp",
     causal: bool = True,
+    local_impl: str = "dense",
 ) -> jax.Array:
     """Exact attention via all-to-all head/sequence transposition.
 
     Requires ``heads % axis_size == 0`` (each device owns a head group
     during the local attention) and ``seq % axis_size == 0``.
+    ``local_impl``: "dense" | "flash" | "auto" — the per-head-group
+    attention over the gathered sequence (flash = O(seq) memory).
     """
     mesh = mesh or make_mesh(axes=(axis,))
     p = mesh.shape[axis]
@@ -202,4 +227,6 @@ def ulysses_attention(
         raise ValueError(f"seq {q.shape[1]} not divisible by mesh axis {p}")
     spec = NamedSharding(mesh, P(None, axis, None, None))
     q, k, v = (jax.device_put(commit(x, mesh_anchor(mesh)), spec) for x in (q, k, v))
-    return _ulysses_sharded(q, k, v, mesh=mesh, axis=axis, causal=causal)
+    return _ulysses_sharded(
+        q, k, v, mesh=mesh, axis=axis, causal=causal, local_impl=local_impl
+    )

@@ -45,6 +45,11 @@ def commit(values, target, dtype=None) -> jax.Array:
     the host (NumPy), which also protects f64 values from the default
     TPU device's silent f32 degradation.
     """
+    if isinstance(values, jax.core.Tracer):
+        # under a jit/grad trace there is no placement to do (the trace
+        # has no devices); keep the value symbolic so transformed code
+        # can flow through commit-staging entry points
+        return values if dtype is None else values.astype(dtype)
     if isinstance(values, jax.Array) and not values.is_deleted():
         src = {d.platform for d in values.devices()}
         if src == {_target_platform(target)}:

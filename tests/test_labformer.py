@@ -184,6 +184,20 @@ class TestSharded:
         got = np.asarray(jax.jit(lambda p, t: forward(p, t, uly, mesh=mesh))(sharded, tok_sh))
         np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
 
+    def test_ring_flash_local_parity(self, mesh, rng):
+        """Ring sp with flash per-step block attention (attn_impl=flash)
+        == single-device dense."""
+        import dataclasses
+
+        rf = dataclasses.replace(CFG, sp_impl="ring", attn_impl="flash")
+        params = init_params(CFG, seed=0)
+        tokens = _tokens(rng, b=4, s=32)
+        want = np.asarray(forward(params, tokens, CFG, mesh=None))
+        sharded = shard_params(params, CFG, mesh)
+        tok_sh = jax.device_put(tokens, NamedSharding(mesh, _restrict(P("dp", None), mesh)))
+        got = np.asarray(jax.jit(lambda p, t: forward(p, t, rf, mesh=mesh))(sharded, tok_sh))
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
     def test_dispatch_moe_parity(self, mesh, rng):
         """all_to_all expert dispatch == dense-gate MoE at full capacity."""
         import dataclasses

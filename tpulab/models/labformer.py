@@ -234,7 +234,14 @@ def _attention(x, layer, cfg: LabformerConfig, mesh: Optional[Mesh], positions):
                 _ulysses_body, axis="sp", causal=True, local_impl=cfg.attn_impl
             )
         else:
-            body = functools.partial(_ring_body, axis="sp", causal=True)
+            from tpulab.parallel.ring import _ring_body_flash
+
+            s_local = s // mesh.shape["sp"]
+            use_flash = cfg.attn_impl == "flash" or (
+                cfg.attn_impl == "auto" and s_local >= 1024
+            )
+            ring_fn = _ring_body_flash if use_flash else _ring_body
+            body = functools.partial(ring_fn, axis="sp", causal=True)
         # check_vma=False: the ulysses body may lower a pallas_call
         # (flash local attention), which carries no vma metadata
         o = jax.shard_map(

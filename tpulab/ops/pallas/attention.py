@@ -246,7 +246,7 @@ def _bwd_block(block: int, cap: int = 512) -> int:
 
 
 def _flash_bwd_call(q, k, v, o, lse, do, block_q: int, block_k: int,
-                    causal: bool, interpret: bool):
+                    causal: bool, interpret: bool, dlse=None):
     bh, s, d = q.shape
     bq = _bwd_block(block_q)
     bk = _bwd_block(block_k)
@@ -254,8 +254,13 @@ def _flash_bwd_call(q, k, v, o, lse, do, block_q: int, block_k: int,
     n_q = s // bq
     n_k = s // bk
     scale = 1.0 / np.sqrt(d)
-    # delta = rowsum(do * o): one cheap fused XLA pass, f32
+    # delta = rowsum(do * o): one cheap fused XLA pass, f32.  When the
+    # caller also consumes lse (ring merge), its cotangent folds in here:
+    # d lse / d s_ij = p_ij, so ds = p*(dp - delta + dlse) — i.e. the
+    # kernels run unchanged with delta' = delta - dlse.
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)
 
     q_spec = pl.BlockSpec(
         (1, bq, d), lambda b, i, j: (b, i, jnp.int32(0)), memory_space=pltpu.VMEM
@@ -307,40 +312,38 @@ def _flash_bwd_call(q, k, v, o, lse, do, block_q: int, block_k: int,
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash_bhsd_core(q, k, v, block_q: int, block_k: int, causal: bool,
-                     interpret: bool):
-    return _flash_fwd_call(q, k, v, block_q, block_k, causal, interpret)[0]
+def _flash_bhsd_lse(q, k, v, block_q: int, block_k: int, causal: bool,
+                    interpret: bool):
+    """(bh, s, d) attention returning ``(o, lse)``; both differentiable
+    (the lse cotangent folds into the delta term of the backward)."""
+    return _flash_fwd_call(q, k, v, block_q, block_k, causal, interpret)
 
 
-def _flash_bhsd_fwd(q, k, v, block_q, block_k, causal, interpret):
+def _flash_bhsd_lse_fwd(q, k, v, block_q, block_k, causal, interpret):
     o, lse = _flash_fwd_call(q, k, v, block_q, block_k, causal, interpret)
-    return o, (q, k, v, o, lse)
+    return (o, lse), (q, k, v, o, lse)
 
 
-def _flash_bhsd_bwd(block_q, block_k, causal, interpret, res, do):
+def _flash_bhsd_lse_bwd(block_q, block_k, causal, interpret, res, ct):
+    do, dlse = ct
     q, k, v, o, lse = res
-    return _flash_bwd_call(q, k, v, o, lse, do, block_q, block_k, causal, interpret)
+    return _flash_bwd_call(q, k, v, o, lse, do, block_q, block_k, causal,
+                           interpret, dlse=dlse)
 
 
-_flash_bhsd_core.defvjp(_flash_bhsd_fwd, _flash_bhsd_bwd)
-
-_flash_bhsd = jax.jit(_flash_bhsd_core, static_argnums=(3, 4, 5, 6))
+_flash_bhsd_lse.defvjp(_flash_bhsd_lse_fwd, _flash_bhsd_lse_bwd)
 
 
-def flash_attention(
-    q: jax.Array,
-    k: jax.Array,
-    v: jax.Array,
-    *,
-    causal: bool = True,
-    block_q: int = 1024,
-    block_k: int = 1024,
-    interpret: Optional[bool] = None,
-) -> jax.Array:
-    """Exact attention over (batch, seq, heads, head_dim), O(seq) memory.
+@functools.partial(jax.jit, static_argnums=(3, 4, 5, 6))
+def _flash_bhsd(q, k, v, block_q: int, block_k: int, causal: bool,
+                interpret: bool):
+    # dropping lse makes its cotangent a zeros array — delta' == delta
+    return _flash_bhsd_lse(q, k, v, block_q, block_k, causal, interpret)[0]
 
-    ``seq`` is padded to a block multiple internally (padded K columns
-    are masked off; padded Q rows are cropped)."""
+
+def _flash_bshd(q, k, v, causal: bool, block_q: int, block_k: int,
+                interpret: Optional[bool], with_lse: bool):
+    """Shared (batch, seq, heads, d) wrapper: padding + layout + kernel."""
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
     b, s, h, d = q.shape
@@ -376,6 +379,48 @@ def flash_attention(
     qb = jnp.moveaxis(q, 2, 1).reshape(b * h, sp, d)
     kb = jnp.moveaxis(k, 2, 1).reshape(b * h, sp, d)
     vb = jnp.moveaxis(v, 2, 1).reshape(b * h, sp, d)
+    if with_lse:
+        ob, lseb = _flash_bhsd_lse(qb, kb, vb, block_q, block_k, causal, interpret)
+        o = jnp.moveaxis(ob.reshape(b, h, sp, d), 1, 2)[:, :s]
+        lse = jnp.moveaxis(lseb.reshape(b, h, sp), 1, 2)[:, :s]  # (b, s, h)
+        return o, lse
     ob = _flash_bhsd(qb, kb, vb, block_q, block_k, causal, interpret)
-    o = jnp.moveaxis(ob.reshape(b, h, sp, d), 1, 2)
-    return o[:, :s]
+    return jnp.moveaxis(ob.reshape(b, h, sp, d), 1, 2)[:, :s]
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    block_q: int = 1024,
+    block_k: int = 1024,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Exact attention over (batch, seq, heads, head_dim), O(seq) memory.
+
+    ``seq`` is padded to a block multiple internally (padded K columns
+    are masked off; padded Q rows are cropped)."""
+    return _flash_bshd(q, k, v, causal, block_q, block_k, interpret,
+                       with_lse=False)
+
+
+def flash_attention_with_lse(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = True,
+    block_q: int = 1024,
+    block_k: int = 1024,
+    interpret: Optional[bool] = None,
+):
+    """Like :func:`flash_attention` but also returns the per-row
+    logsumexp, shape (batch, seq, heads) f32 — the merge state for
+    combining partial attentions over key shards (ring attention):
+    ``o = sum_i o_i * exp(lse_i - logaddexp_i lse_i)``.  Both outputs
+    are differentiable (the lse cotangent folds into the backward's
+    delta term)."""
+    return _flash_bshd(q, k, v, causal, block_q, block_k, interpret,
+                       with_lse=True)

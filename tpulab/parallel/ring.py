@@ -125,13 +125,83 @@ def _ring_body(q, k, v, *, axis: str, causal: bool):
     return out.astype(q.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("mesh", "axis", "causal"))
-def _ring_attention_sharded(q, k, v, *, mesh: Mesh, axis: str, causal: bool):
-    spec = P(None, axis, None, None)  # (batch, seq, heads, d): seq sharded
-    body = functools.partial(_ring_body, axis=axis, causal=causal)
-    return jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)(
-        q, k, v
+def _pick_flash_block(s: int, cap: int = 512) -> int:
+    """Largest divisor of ``s`` at most ``cap`` (trace-time ints) — the
+    flash inner call must not pad (non-causal pad is rejected, and pad
+    rows would corrupt the ring lse merge)."""
+    for b in range(min(cap, s), 0, -1):
+        if s % b == 0:
+            return b
+    return s
+
+
+def _ring_body_flash(q, k, v, *, axis: str, causal: bool):
+    """Ring attention with the Pallas flash kernel as the per-step local
+    attention (runs in shard_map; requires (batch, seq/p, heads, d)).
+
+    Where :func:`_ring_body` materializes a (heads, s/p, s/p) score
+    block per step, this streams each visiting K/V block through flash
+    and folds the (o, lse) partials: O(s/p * d) memory per device.
+    Trainable end to end — flash's custom_vjp handles both the o and
+    lse cotangents, and the p-step loop is a scan.
+    """
+    from tpulab.ops.pallas.attention import flash_attention_with_lse
+
+    p = jax.lax.axis_size(axis)
+    idx = jax.lax.axis_index(axis)
+    s_local = q.shape[1]
+    blk = _pick_flash_block(s_local)
+    attend = functools.partial(
+        flash_attention_with_lse, block_q=blk, block_k=blk
     )
+
+    # step 0: the device's own block — causal within when causal
+    o, lse = attend(q, k, v, causal=causal)
+    o = o.astype(jnp.float32)
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    def step(t, carry):
+        o, lse, kt, vt = carry
+        kt = jax.lax.ppermute(kt, axis, perm)
+        vt = jax.lax.ppermute(vt, axis, perm)
+        src = (idx - t) % p  # origin rank of the visiting block
+        o2, lse2 = attend(q, kt, vt, causal=False)
+        lse_new = jnp.logaddexp(lse, lse2)
+        o_new = (
+            o * jnp.exp(lse - lse_new)[..., None]
+            + o2.astype(jnp.float32) * jnp.exp(lse2 - lse_new)[..., None]
+        )
+        if causal:
+            # visiting blocks strictly earlier in the sequence merge;
+            # later ones are fully masked (select keeps control flow
+            # uniform across devices — the ppermute must always run)
+            take = src < idx
+            o_new = jnp.where(take, o_new, o)
+            lse_new = jnp.where(take, lse_new, lse)
+        return o_new, lse_new, kt, vt
+
+    o, lse, _, _ = jax.lax.fori_loop(1, p, step, (o, lse, k, v))
+    return o.astype(q.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("mesh", "axis", "causal", "local_impl")
+)
+def _ring_attention_sharded(q, k, v, *, mesh: Mesh, axis: str, causal: bool,
+                            local_impl: str = "dense"):
+    spec = P(None, axis, None, None)  # (batch, seq, heads, d): seq sharded
+    if local_impl == "flash" or (
+        local_impl == "auto" and q.shape[1] // mesh.shape[axis] >= 1024
+    ):
+        body = functools.partial(_ring_body_flash, axis=axis, causal=causal)
+    else:
+        body = functools.partial(_ring_body, axis=axis, causal=causal)
+    # check_vma=False: the flash body lowers a pallas_call, which carries
+    # no varying-mesh-axes metadata
+    return jax.shard_map(
+        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(q, k, v)
 
 
 def ring_attention(
@@ -142,18 +212,24 @@ def ring_attention(
     mesh: Optional[Mesh] = None,
     axis: str = "sp",
     causal: bool = True,
+    local_impl: str = "dense",
 ) -> jax.Array:
     """Exact attention over a sequence-sharded (batch, seq, heads, d) input.
 
     Host arrays are committed to the mesh backend and sharded over
-    ``axis``; sequence length must divide the axis size.
+    ``axis``; sequence length must divide the axis size.  ``local_impl``:
+    "dense" | "flash" | "auto" — the per-step block attention ("flash"
+    streams visiting K/V blocks through the Pallas kernel: O(seq/p * d)
+    memory instead of (seq/p)^2 score blocks).
     """
     mesh = mesh or make_mesh(axes=(axis,))
     spec = NamedSharding(mesh, P(None, axis, None, None))
     q, k, v = (jax.device_put(commit(x, mesh_anchor(mesh)), spec) for x in (q, k, v))
     if q.shape[1] % mesh.shape[axis]:
         raise ValueError(f"seq {q.shape[1]} not divisible by mesh axis {mesh.shape[axis]}")
-    return _ring_attention_sharded(q, k, v, mesh=mesh, axis=axis, causal=causal)
+    return _ring_attention_sharded(
+        q, k, v, mesh=mesh, axis=axis, causal=causal, local_impl=local_impl
+    )
 
 
 def _ulysses_local_attention(q, k, v, causal: bool, local_impl: str):

@@ -32,38 +32,52 @@ import time
 ROOT = pathlib.Path(__file__).resolve().parent.parent
 REFERENCE = pathlib.Path("/root/reference")
 
-# tiny fixtures only: the whitelist in the reference Lab2Processor skips
+# tiny fixtures only: the whitelists in the reference processors skip
 # missing files, and the multi-MB PNGs would spend minutes in the
 # reference's per-pixel pack loops (converter.py:100-115) for no extra
 # compatibility signal (the goldens cover the .txt fixtures)
-TINY_FIXTURES = (
-    "02.data", "57.data", "95.data", "96.data", "97.data", "98.data",
-    "99.data", "test_01.txt", "test_02.txt",
-)
+TINY_FIXTURES = {
+    "lab2": (
+        "02.data", "57.data", "95.data", "96.data", "97.data", "98.data",
+        "99.data", "test_01.txt", "test_02.txt",
+    ),
+    # the reference Lab3Processor pins every image's class-definition
+    # points to MAP_TO_INIT_POINTS["test_01_lab3.txt"] (reference
+    # lab3_processor.py:117) whose coordinates live in a 3x3 box, so any
+    # staged image works; the golden covers test_01_lab3
+    "lab3": ("04.data", "09.data", "test_01_lab3.txt", "test_02_lab3.txt"),
+}
+
+# reference kernel_sizes grammar per lab (reference tester.py:113-121):
+# lab2 = [[bx,by],[gx,gy]] pairs; lab3 = [blocks, threads] ints
+DEFAULT_KERNEL_SIZES = {
+    "lab2": "[[[32, 32], [16, 16]], [[16, 16], [32, 32]], [[8, 8], [64, 64]]]",
+    "lab3": "[[256, 256], [1024, 256], [32, 32]]",
+}
 
 
-def stage_workdir(workdir: pathlib.Path) -> pathlib.Path:
-    data = workdir / "lab2" / "data"
+def stage_workdir(workdir: pathlib.Path, lab: str) -> pathlib.Path:
+    data = workdir / lab / "data"
     data.mkdir(parents=True, exist_ok=True)  # --workdir may be reused
-    for fn in TINY_FIXTURES:
-        src = REFERENCE / "lab2" / "data" / fn
+    for fn in TINY_FIXTURES[lab]:
+        src = REFERENCE / lab / "data" / fn
         if src.exists():
             shutil.copy(src, data / fn)
     shutil.copytree(
-        REFERENCE / "lab2" / "data_out_gt",
-        workdir / "lab2" / "data_out_gt",
+        REFERENCE / lab / "data_out_gt",
+        workdir / lab / "data_out_gt",
         dirs_exist_ok=True,
     )
-    srcdir = workdir / "lab2" / "src"
+    srcdir = workdir / lab / "src"
     srcdir.mkdir(exist_ok=True)
     client = ROOT / "native" / "bin" / "tpulab_client"
     if not client.exists():
         raise SystemExit("native client missing; run tools/build_native.py first")
     shim = srcdir / "to_plot_tpu"
-    shim.write_text(f"#!/bin/sh\nexec {client} lab2 --to-plot\n")
+    shim.write_text(f"#!/bin/sh\nexec {client} {lab} --to-plot\n")
     shim.chmod(0o755)
     shim_cpu = srcdir / "main_tpu_cpu"
-    shim_cpu.write_text(f"#!/bin/sh\nexec {client} lab2 --backend cpu\n")
+    shim_cpu.write_text(f"#!/bin/sh\nexec {client} {lab} --backend cpu\n")
     shim_cpu.chmod(0o755)
     return srcdir
 
@@ -100,18 +114,24 @@ def start_daemon(workdir: pathlib.Path, env: dict) -> tuple:
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
+    ap.add_argument("--lab", default="lab2", choices=sorted(TINY_FIXTURES))
     ap.add_argument("--k-times", type=int, default=5)
     ap.add_argument(
         "--kernel-sizes",
-        default="[[[32, 32], [16, 16]], [[16, 16], [32, 32]], [[8, 8], [64, 64]]]",
-        help="lab2 JSON: [[block_xy, grid_xy], ...] (reference tester.py:115-121)",
+        default=None,
+        help="per-lab JSON (reference tester.py:113-121); defaults per lab",
     )
-    ap.add_argument("--out", default=str(ROOT / "results" / "reference_harness"))
+    ap.add_argument("--out", default=None)
     ap.add_argument("--workdir", default=None)
     args = ap.parse_args(argv)
+    kernel_sizes = args.kernel_sizes or DEFAULT_KERNEL_SIZES[args.lab]
+    out_default = ROOT / "results" / (
+        "reference_harness" if args.lab == "lab2"
+        else f"reference_harness_{args.lab}"
+    )
 
     workdir = pathlib.Path(args.workdir or tempfile.mkdtemp(prefix="refharness_"))
-    srcdir = stage_workdir(workdir)
+    srcdir = stage_workdir(workdir, args.lab)
 
     env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
     daemon, sock = start_daemon(workdir, env)
@@ -123,7 +143,7 @@ def main(argv=None) -> int:
             "--binary_path_cuda", str(srcdir / "to_plot_tpu"),
             "--binary_path_cpu", str(srcdir / "main_tpu_cpu"),
             "--k_times", str(args.k_times),
-            "--kernel_sizes", args.kernel_sizes,
+            "--kernel_sizes", kernel_sizes,
             "--metadata_columns2plot", '["filename"]',
         ]
         print("+", " ".join(cmd), flush=True)
@@ -140,7 +160,7 @@ def main(argv=None) -> int:
         daemon.terminate()
         daemon.wait(timeout=10)
 
-    out = pathlib.Path(args.out)
+    out = pathlib.Path(args.out) if args.out else out_default
     out.mkdir(parents=True, exist_ok=True)
     copied = []
     for pat in ("stats_*.csv", "failed_*.csv", "*.png"):

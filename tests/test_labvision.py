@@ -1,0 +1,86 @@
+"""labvision CNN: learns the lab3 color-class task; dp-sharded training."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tpulab.parallel.mesh import cpu_test_mesh
+from tpulab.models.labvision import (
+    LabvisionConfig,
+    accuracy,
+    class_color_means,
+    forward,
+    init_params,
+    init_train_state,
+    shard_batch,
+    synth_batch,
+)
+
+CFG = LabvisionConfig(n_classes=4, img_size=16, channels=(8, 16))
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)
+
+
+class TestForward:
+    def test_shapes_and_dtype(self, rng):
+        params = init_params(CFG, seed=0)
+        imgs, _ = synth_batch(CFG, 4, rng)
+        logits = forward(params, jnp.asarray(imgs), CFG)
+        assert logits.shape == (4, CFG.n_classes)
+        assert logits.dtype == jnp.float32
+
+    def test_uint8_and_float_agree(self, rng):
+        params = init_params(CFG, seed=0)
+        imgs, _ = synth_batch(CFG, 4, rng)
+        a = np.asarray(forward(params, jnp.asarray(imgs), CFG))
+        b = np.asarray(
+            forward(params, jnp.asarray(imgs.astype(np.float32) / 255.0), CFG)
+        )
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-4)
+
+
+class TestTraining:
+    def test_learns_the_lab3_task(self, rng):
+        """The CNN must learn what lab3 computes analytically: which
+        Gaussian color class produced the image."""
+        params, opt_state, step = init_train_state(CFG, seed=0)
+        for _ in range(150):
+            imgs, labels = synth_batch(CFG, 64, rng)
+            params, opt_state, loss = step(
+                params, opt_state, jnp.asarray(imgs), jnp.asarray(labels)
+            )
+        assert np.isfinite(float(loss))
+        imgs, labels = synth_batch(CFG, 256, rng)
+        acc = accuracy(params, imgs, labels, CFG)
+        assert acc > 0.9, f"accuracy {acc}"
+
+    def test_class_means_separated(self):
+        mus = class_color_means(LabvisionConfig(n_classes=8))
+        d = np.linalg.norm(mus[:, None] - mus[None], axis=-1)
+        np.fill_diagonal(d, np.inf)
+        assert d.min() > 10.0  # distinct classes
+
+
+class TestSharded:
+    def test_dp_training_matches_single_device(self, rng):
+        mesh = cpu_test_mesh({"dp": 8})
+        cfg = CFG
+        imgs, labels = synth_batch(cfg, 64, rng)
+
+        params_s, opt_s, step_s = init_train_state(cfg, mesh, seed=0)
+        im_s, lb_s = shard_batch(jnp.asarray(imgs), jnp.asarray(labels), mesh)
+        params_s, opt_s, loss_s = step_s(params_s, opt_s, im_s, lb_s)
+
+        params_1, opt_1, step_1 = init_train_state(cfg, seed=0)
+        params_1, opt_1, loss_1 = step_1(
+            params_1, opt_1, jnp.asarray(imgs), jnp.asarray(labels)
+        )
+        np.testing.assert_allclose(float(loss_s), float(loss_1), rtol=1e-5)
+        w_s = np.asarray(jax.device_get(params_s["head"]["w"]))
+        w_1 = np.asarray(jax.device_get(params_1["head"]["w"]))
+        np.testing.assert_allclose(w_s, w_1, rtol=1e-4, atol=1e-6)

@@ -174,6 +174,46 @@ def bench_labformer_train(
     }
 
 
+def bench_labvision_train(b: int = 256, reps: int = 10) -> Dict[str, Any]:
+    """Vision model family: CNN train step, images/s + MFU on one chip.
+
+    FLOPs from XLA's cost model — valid here (no scan hides the conv
+    stack, unlike the labformer's layer loop)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpulab.models.labvision import LabvisionConfig, init_train_state, synth_batch
+    from tpulab.runtime.device import commit, default_device
+    from tpulab.runtime.timing import measure_ms
+
+    cfg = LabvisionConfig(n_classes=8, img_size=64, channels=(64, 128, 256))
+    device = default_device()
+    params, opt_state, step = init_train_state(cfg, seed=0)
+    params = jax.device_put(params, device)
+    opt_state = jax.device_put(opt_state, device)
+    imgs, labels = synth_batch(cfg, b, np.random.default_rng(0))
+    imgs = commit(imgs, device)
+    labels = commit(labels, device)
+    fn = lambda p, o, i, l: step(p, o, i, l)[2]
+    ms, _ = measure_ms(fn, (params, opt_state, imgs, labels), warmup=3, reps=reps)
+    try:
+        compiled = jax.jit(fn).lower(params, opt_state, imgs, labels).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0]
+        flops = float(ca.get("flops", 0.0))
+    except Exception:
+        flops = 0.0
+    return {
+        "metric": f"labvision_train_b{b}_64x64_images_per_s",
+        "value": round(b / (ms / 1e3), 1),
+        "unit": "images/s",
+        "vs_baseline": None,
+        "device": device.platform,
+        **_mfu_fields(flops, ms, device),
+    }
+
+
 def bench_labformer_decode(
     b: int = 8, steps: int = 128, reps: int = 3, dtype: str = "bfloat16"
 ) -> Dict[str, Any]:
@@ -299,6 +339,7 @@ def run_benchmarks(only: Optional[str] = None, **kw) -> List[Dict[str, Any]]:
         "labformer_fwd": bench_labformer,
         "labformer_train": bench_labformer_train,
         "labformer_decode": bench_labformer_decode,
+        "labvision_train": bench_labvision_train,
         "hw2_sort": bench_sort,
         "lab5_reduce": bench_reduce,
         "flash_attention": bench_flash_attention,

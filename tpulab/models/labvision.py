@@ -1,0 +1,165 @@
+"""labvision: a convolutional classifier for the lab suite's image domain.
+
+The reference suite is image processing end to end — Roberts edges
+(lab2/src/main.cu:15-52) and per-pixel Mahalanobis classification
+(lab3/src/main.cu:40-76) — but has no *learned* tier.  labvision is the
+second model family next to the labformer LM: a small CNN that learns
+the lab3 task family (which color-class distribution produced an image
+patch) instead of computing it from hand-built statistics.
+
+TPU-first design choices:
+* NHWC layout with channel counts padded to MXU-friendly multiples —
+  ``lax.conv_general_dilated`` lowers convs onto the systolic array.
+* bf16 compute, f32 loss/softmax, static shapes, one jitted train step.
+* dp sharding over a mesh batch axis via NamedSharding (the model is
+  small; tensor parallelism would waste ICI on sub-MXU matmuls).
+
+The synthetic task generator reuses the framework's own lab3 oracle
+semantics: each class is a Gaussian color distribution (the exact model
+behind lab3's per-class mean/covariance statistics), so the learned
+classifier and the analytic classifier answer the same question.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class LabvisionConfig:
+    n_classes: int = 8
+    img_size: int = 32          # square input, NHWC
+    channels: Tuple[int, ...] = (32, 64, 128)  # per stage, stride-2 each
+    dtype: Optional[object] = None  # default bf16 on TPU, f32 elsewhere
+
+    @property
+    def compute_dtype(self):
+        if self.dtype is not None:
+            return self.dtype
+        return jnp.bfloat16 if jax.devices()[0].platform == "tpu" else jnp.float32
+
+
+def init_params(cfg: LabvisionConfig, seed: int = 0):
+    """He-initialized conv stack + linear head (f32 master weights)."""
+    rng = np.random.default_rng(seed)
+    params = {"convs": [], "head": None}
+    c_in = 3
+    for c_out in cfg.channels:
+        fan_in = 3 * 3 * c_in
+        params["convs"].append({
+            "w": jnp.asarray(
+                rng.standard_normal((3, 3, c_in, c_out)) * np.sqrt(2.0 / fan_in),
+                jnp.float32,
+            ),
+            "b": jnp.zeros((c_out,), jnp.float32),
+        })
+        c_in = c_out
+    params["head"] = {
+        "w": jnp.asarray(
+            rng.standard_normal((c_in, cfg.n_classes)) * np.sqrt(1.0 / c_in),
+            jnp.float32,
+        ),
+        "b": jnp.zeros((cfg.n_classes,), jnp.float32),
+    }
+    return params
+
+
+def forward(params, images, cfg: LabvisionConfig):
+    """(b, H, W, 3) uint8/float images -> (b, n_classes) f32 logits."""
+    dt = cfg.compute_dtype
+    x = images.astype(dt) / np.float32(255.0) if images.dtype == jnp.uint8 else images.astype(dt)
+    for conv in params["convs"]:
+        x = jax.lax.conv_general_dilated(
+            x,
+            conv["w"].astype(dt),
+            window_strides=(2, 2),
+            padding="SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        x = jax.nn.gelu(x + conv["b"].astype(dt))
+    x = jnp.mean(x, axis=(1, 2))  # global average pool -> (b, C)
+    head = params["head"]
+    return (x @ head["w"].astype(dt) + head["b"].astype(dt)).astype(jnp.float32)
+
+
+def loss_fn(params, images, labels, cfg: LabvisionConfig):
+    logits = forward(params, images, cfg)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))
+
+
+def make_train_step(cfg: LabvisionConfig, mesh: Optional[Mesh] = None,
+                    optimizer=None):
+    """Jitted (params, opt_state, images, labels) -> (params, opt_state, loss).
+
+    With a mesh, batch inputs shard over the ``dp`` axis and params
+    replicate — XLA inserts the psum for the gradient all-reduce.
+    """
+    import optax
+
+    optimizer = optimizer or optax.adamw(1e-3)
+
+    @jax.jit
+    def step(params, opt_state, images, labels):
+        loss, grads = jax.value_and_grad(loss_fn)(params, images, labels, cfg)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    return optimizer, step
+
+
+def shard_batch(images, labels, mesh: Mesh):
+    """Place a host batch dp-sharded on the mesh (params replicate)."""
+    spec = NamedSharding(mesh, P("dp"))
+    return (
+        jax.device_put(images, spec),
+        jax.device_put(labels, spec),
+    )
+
+
+def init_train_state(cfg: LabvisionConfig, mesh: Optional[Mesh] = None,
+                     seed: int = 0, optimizer=None):
+    params = init_params(cfg, seed)
+    optimizer, step = make_train_step(cfg, mesh, optimizer)
+    if mesh is not None:
+        params = jax.device_put(params, NamedSharding(mesh, P()))
+    return params, optimizer.init(params), step
+
+
+def synth_batch(cfg: LabvisionConfig, batch: int, rng: np.random.Generator,
+                spread: float = 24.0):
+    """The lab3 generative model as a classification dataset.
+
+    Each class c is a Gaussian color distribution N(mu_c, spread^2 I) in
+    RGB — exactly the per-class statistics lab3 estimates from sample
+    points (reference lab3/src/main.cu:106-139).  A sample image is
+    class-colored noise; the label is the generating class.
+    """
+    mus = class_color_means(cfg)
+    labels = rng.integers(0, cfg.n_classes, batch)
+    noise = rng.standard_normal((batch, cfg.img_size, cfg.img_size, 3)) * spread
+    images = np.clip(mus[labels][:, None, None, :] + noise, 0, 255).astype(np.uint8)
+    return images, labels.astype(np.int32)
+
+
+@functools.lru_cache(maxsize=None)
+def _color_means_cached(n_classes: int) -> tuple:
+    rng = np.random.default_rng(1234)
+    return tuple(map(tuple, rng.uniform(30, 225, size=(n_classes, 3))))
+
+
+def class_color_means(cfg: LabvisionConfig) -> np.ndarray:
+    """Deterministic per-class RGB means, well-separated in [30, 225]."""
+    return np.asarray(_color_means_cached(cfg.n_classes), np.float64)
+
+
+def accuracy(params, images, labels, cfg: LabvisionConfig) -> float:
+    pred = np.asarray(jnp.argmax(forward(params, jnp.asarray(images), cfg), axis=-1))
+    return float((pred == labels).mean())

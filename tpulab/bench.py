@@ -215,9 +215,14 @@ def bench_labvision_train(b: int = 256, reps: int = 10) -> Dict[str, Any]:
 
 
 def bench_labformer_decode(
-    b: int = 8, steps: int = 128, reps: int = 3, dtype: str = "bfloat16"
+    b: int = 8, steps: int = 128, reps: int = 3, dtype: str = "bfloat16",
+    int8: bool = False,
 ) -> Dict[str, Any]:
-    """KV-cache autoregressive decode: tokens/s (whole loop is one jit)."""
+    """KV-cache autoregressive decode: tokens/s (whole loop is one jit).
+
+    ``int8=True`` runs the weight-only quantized path (models/quant.py)
+    — decode is HBM-bound on weight reads, so int8 targets ~the weight
+    fraction of step traffic."""
     import jax
     import jax.numpy as jnp
 
@@ -235,15 +240,21 @@ def bench_labformer_decode(
         dtype={"bfloat16": jnp.bfloat16, "float32": jnp.float32}[dtype],
     )
     device = default_device()
-    params = jax.device_put(init_params(cfg, seed=0), device)
+    params = init_params(cfg, seed=0)
+    if int8:
+        from tpulab.models.quant import quantize_decode_params
+
+        params = quantize_decode_params(params, cfg)
+    params = jax.device_put(params, device)
     prompt = commit(
         np.random.default_rng(0).integers(0, cfg.vocab, (b, 8)).astype(np.int32), device
     )
     key = jax.random.PRNGKey(0)
     fn = lambda p, t: generate_jit(p, t, key, cfg, steps, 1.0)
     ms, _ = measure_ms(fn, (params, prompt), warmup=2, reps=reps)
+    tag = "_int8" if int8 else ""
     return {
-        "metric": f"labformer_decode_b{b}_{steps}steps_{dtype}_tokens_per_s",
+        "metric": f"labformer_decode_b{b}_{steps}steps_{dtype}{tag}_tokens_per_s",
         "value": round(b * steps / (ms / 1e3), 1),
         "unit": "tokens/s",
         "vs_baseline": None,
@@ -339,6 +350,7 @@ def run_benchmarks(only: Optional[str] = None, **kw) -> List[Dict[str, Any]]:
         "labformer_fwd": bench_labformer,
         "labformer_train": bench_labformer_train,
         "labformer_decode": bench_labformer_decode,
+        "labformer_decode_int8": functools.partial(bench_labformer_decode, int8=True),
         "labvision_train": bench_labvision_train,
         "hw2_sort": bench_sort,
         "lab5_reduce": bench_reduce,

@@ -23,6 +23,7 @@ from tpulab.models.labformer import (
     _rmsnorm,
     _rope,
 )
+from tpulab.models.quant import embed_lookup, qmat, unembed
 from tpulab.parallel.ring import NEG_INF
 
 
@@ -51,16 +52,16 @@ def _decode_block(x, layer, k_cache, v_cache, pos, cfg: LabformerConfig):
     b = x.shape[0]
     h, dh = cfg.n_heads, cfg.head_dim
     xn = _rmsnorm(x, layer["ln1"])
-    q = (xn @ layer["wq"]).reshape(b, 1, h, dh)
-    k = (xn @ layer["wk"]).reshape(b, 1, h, dh)
-    v = (xn @ layer["wv"]).reshape(b, 1, h, dh)
+    q = qmat(xn, layer["wq"]).reshape(b, 1, h, dh)
+    k = qmat(xn, layer["wk"]).reshape(b, 1, h, dh)
+    v = qmat(xn, layer["wv"]).reshape(b, 1, h, dh)
     positions = jnp.full((1,), pos)
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
     k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
     v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
     o = _attend_cached(q, k_cache, v_cache, pos)
-    x = x + o.reshape(b, 1, cfg.d_model) @ layer["wo"]
+    x = x + qmat(o.reshape(b, 1, cfg.d_model), layer["wo"])
     y, _ = _mlp(_rmsnorm(x, layer["ln2"]), layer, cfg)  # aux unused at decode
     x = x + y
     return x, k_cache, v_cache
@@ -68,7 +69,7 @@ def _decode_block(x, layer, k_cache, v_cache, pos, cfg: LabformerConfig):
 
 def _forward_step(params, token, k_caches, v_caches, pos, cfg: LabformerConfig):
     """token (b,) int32 at position ``pos`` -> (logits (b, vocab), caches)."""
-    x = params["embed"][token][:, None, :]  # (b, 1, d)
+    x = embed_lookup(params["embed"], token, cfg.dtype)[:, None, :]  # (b, 1, d)
 
     def layer_step(carry, inputs):
         x = carry
@@ -80,7 +81,7 @@ def _forward_step(params, token, k_caches, v_caches, pos, cfg: LabformerConfig):
         layer_step, x, (params["blocks"], k_caches, v_caches)
     )
     x = _rmsnorm(x, params["final_norm"])
-    logits = (x @ params["embed"].T)[:, 0, :]
+    logits = unembed(x, params["embed"])[:, 0, :]
     return logits, k_caches, v_caches
 
 

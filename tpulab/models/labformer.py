@@ -320,7 +320,11 @@ def _mlp(x, layer, cfg: LabformerConfig, mesh: Optional[Mesh] = None):
         out = jnp.einsum("bsef,efd->bsed", hidden, layer["w2"])
         out = jnp.einsum("bsed,bse->bsd", out, onehot)
         return out * weight[..., None], aux
-    return jax.nn.gelu(x @ layer["w1"]) @ layer["w2"], aux
+    from tpulab.models.quant import qmat
+
+    # qmat == plain matmul for arrays; int8 QTensor weights (decode
+    # path, models/quant.py) dequantize after the dot
+    return qmat(jax.nn.gelu(qmat(x, layer["w1"])), layer["w2"]), aux
 
 
 def _forward_scan(params, tokens, cfg: LabformerConfig, mesh: Optional[Mesh]):

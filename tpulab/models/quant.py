@@ -1,0 +1,83 @@
+"""Weight-only int8 quantization for the decode path.
+
+KV-cache decode is HBM-bandwidth-bound: every step re-reads all weights
+(measured on chip: step time == bytes/HBM-BW to within noise, see
+RESULTS.md).  Storing weights as int8 with per-output-channel f32 scales
+halves that traffic; the dequantize folds AFTER the matmul —
+``x @ (q * s) == (x @ q) * s`` for a per-column scale — so XLA fuses the
+int8→bf16 convert into the matmul's weight read and the full-precision
+weight never materializes.
+
+Symmetric per-channel scheme: ``s_c = max|w_c| / 127``, ``q = round(w/s)``
+— elementwise error ≤ s_c/2.  Weight-only: activations and the KV cache
+stay in the model dtype (their traffic is already small at decode).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class QTensor(NamedTuple):
+    """int8 weight + f32 scale with the quantized (input) axis reduced.
+
+    For a (d_in, d_out) matmul weight: ``q`` (d_in, d_out) int8, ``s``
+    (d_out,).  For the (vocab, d) embedding: per-row, ``s`` (vocab,).
+    """
+
+    q: jax.Array
+    s: jax.Array
+
+
+def quantize_tensor(w, axis: int = 0) -> QTensor:
+    """Symmetric per-channel int8: scale computed over ``axis``."""
+    w = jnp.asarray(w)
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=axis)
+    s = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.round(w.astype(jnp.float32) / jnp.expand_dims(s, axis))
+    return QTensor(q.astype(jnp.int8), s.astype(jnp.float32))
+
+
+def qmat(x, w):
+    """``x @ w`` where ``w`` is a plain array or a per-column QTensor."""
+    if isinstance(w, QTensor):
+        return (x @ w.q.astype(x.dtype)) * w.s.astype(x.dtype)
+    return x @ w
+
+
+def embed_lookup(embed, tokens, dtype):
+    """``embed[tokens]`` for a plain or per-row-quantized embedding."""
+    if isinstance(embed, QTensor):
+        return embed.q[tokens].astype(dtype) * embed.s[tokens][..., None].astype(dtype)
+    return embed[tokens]
+
+
+def unembed(x, embed):
+    """``x @ embed.T`` (logits) for a plain or per-row-quantized embedding."""
+    if isinstance(embed, QTensor):
+        return (x @ embed.q.T.astype(x.dtype)) * embed.s.astype(x.dtype)
+    return x @ embed.T
+
+
+def quantize_decode_params(params, cfg):
+    """int8-quantize the decode-path weights of a dense labformer.
+
+    Projections and MLP weights go per-output-channel; the tied
+    embedding goes per-vocab-row (serving both lookup and unembed).
+    Norms and biases stay full precision (negligible bytes).  MoE
+    configs are rejected — the expert einsums are not wired for QTensor.
+    """
+    if getattr(cfg, "n_experts", 0):
+        raise NotImplementedError("int8 decode supports dense models only")
+    out = dict(params)
+    out["embed"] = quantize_tensor(params["embed"], axis=1)
+    blocks = dict(params["blocks"])
+    for name in ("wq", "wk", "wv", "wo", "w1", "w2"):
+        if name in blocks:
+            # stacked (L, d_in, d_out): scale over the input axis
+            blocks[name] = quantize_tensor(blocks[name], axis=1)
+    out["blocks"] = blocks
+    return out

@@ -126,3 +126,46 @@ class TestCLI:
         assert r.returncode == 0, r.stderr
         out = json.loads(r.stdout.strip().splitlines()[-1])
         assert out["final_step"] == 2
+
+
+class TestVisionModel:
+    """The train driver runs the labvision family with the same
+    checkpoint/resume machinery as the labformer."""
+
+    _CFG = None
+
+    @classmethod
+    def _cfg(cls):
+        from tpulab.models.labvision import LabvisionConfig
+
+        if cls._CFG is None:
+            cls._CFG = LabvisionConfig(n_classes=4, img_size=16, channels=(8, 16))
+        return cls._CFG
+
+    def test_loss_decreases(self):
+        _, l20 = train(model="labvision", steps=20, batch=32, cfg=self._cfg(),
+                       log=_quiet)
+        _, l1 = train(model="labvision", steps=1, batch=32, cfg=self._cfg(),
+                      log=_quiet)
+        assert l20 < l1
+
+    def test_resume_matches_uninterrupted(self, tmp_path):
+        d = str(tmp_path / "vck")
+        train(model="labvision", steps=4, batch=8, cfg=self._cfg(), ckpt_dir=d,
+              save_every=4, log=_quiet)
+        _, resumed = train(model="labvision", steps=8, batch=8, cfg=self._cfg(),
+                           ckpt_dir=d, save_every=4, resume=True, log=_quiet)
+        _, straight = train(model="labvision", steps=8, batch=8, cfg=self._cfg(),
+                            log=_quiet)
+        assert abs(resumed - straight) < 1e-5, (resumed, straight)
+
+    def test_dp_mesh(self):
+        _, loss = train(model="labvision", steps=2, batch=16, cfg=self._cfg(),
+                        mesh_devices=8, log=_quiet)
+        assert np.isfinite(loss)
+
+    def test_unknown_model_raises(self):
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError, match="unknown model"):
+            train(model="labaudio", steps=1, log=_quiet)

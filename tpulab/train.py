@@ -68,34 +68,72 @@ def train(
     experts: int = 0,
     moe_impl: str = "dense",
     moe_aux_weight: float = 0.01,
+    model: str = "labformer",
 ):
-    """Run the loop; returns (final_step, last_loss)."""
+    """Run the loop; returns (final_step, last_loss).
+
+    ``model``: "labformer" (byte LM, the default) or "labvision" (CNN on
+    the synthetic lab3 color-class task) — both share the checkpoint/
+    resume, fail-fast, sanitize and tracing machinery below.
+    """
     import jax
 
     if sanitize:
         jax.config.update("jax_debug_nans", True)
 
-    from tpulab.models.labformer import LabformerConfig, init_train_state
     from tpulab.parallel.mesh import make_mesh
     from tpulab.runtime.trace import maybe_trace
 
-    cfg = cfg or LabformerConfig(
-        d_model=128,
-        n_heads=8,
-        n_layers=4,
-        d_ff=512,
-        max_seq=seq,
-        remat=remat,
-        n_experts=experts,
-        moe_impl=moe_impl,
-        moe_aux_weight=moe_aux_weight,
-    )
-    mesh = None
-    if mesh_devices:
-        mesh = make_mesh(n_devices=mesh_devices, axes=("dp", "sp", "tp", "pp"))
-    params, opt_state, train_step = init_train_state(
-        cfg, mesh, seed=seed, optimizer=optimizer, accum=accum
-    )
+    if model == "labvision":
+        from tpulab.models.labvision import (
+            LabvisionConfig,
+            init_train_state as vision_train_state,
+            shard_batch,
+            synth_batch,
+        )
+
+        cfg = cfg or LabvisionConfig()
+        mesh = make_mesh({"dp": mesh_devices}) if mesh_devices else None
+        params, opt_state, vstep = vision_train_state(
+            cfg, mesh, seed=seed, optimizer=optimizer
+        )
+
+        def batch_at(step: int):
+            rng = np.random.default_rng((seed << 20) ^ step)
+            return synth_batch(cfg, batch, rng)
+
+        def do_step(params, opt_state, data):
+            imgs, labels = data
+            import jax.numpy as jnp
+
+            imgs, labels = jnp.asarray(imgs), jnp.asarray(labels)
+            if mesh is not None:
+                imgs, labels = shard_batch(imgs, labels, mesh)
+            return vstep(params, opt_state, imgs, labels)
+    elif model == "labformer":
+        from tpulab.models.labformer import LabformerConfig, init_train_state
+
+        cfg = cfg or LabformerConfig(
+            d_model=128,
+            n_heads=8,
+            n_layers=4,
+            d_ff=512,
+            max_seq=seq,
+            remat=remat,
+            n_experts=experts,
+            moe_impl=moe_impl,
+            moe_aux_weight=moe_aux_weight,
+        )
+        mesh = None
+        if mesh_devices:
+            mesh = make_mesh(n_devices=mesh_devices, axes=("dp", "sp", "tp", "pp"))
+        params, opt_state, train_step = init_train_state(
+            cfg, mesh, seed=seed, optimizer=optimizer, accum=accum
+        )
+        batch_at = batches(cfg.vocab, batch, seq, seed)
+        do_step = train_step
+    else:
+        raise ValueError(f"unknown model {model!r}")
 
     start_step = 0
     manager = None
@@ -123,13 +161,12 @@ def train(
             opt_state = restored.state["opt_state"]
             log(f"[train] resumed from step {start_step}")
 
-    batch_at = batches(cfg.vocab, batch, seq, seed)
     loss = float("nan")
     with maybe_trace(trace_dir):
         for step in range(start_step, steps):
-            tokens = batch_at(step)
+            data = batch_at(step)
             t0 = time.perf_counter()
-            params, opt_state, loss = train_step(params, opt_state, tokens)
+            params, opt_state, loss = do_step(params, opt_state, data)
             loss = float(loss)
             dt = (time.perf_counter() - t0) * 1e3
             if not np.isfinite(loss):  # fail fast — the CSC-macro analog
@@ -175,8 +212,13 @@ def main(argv=None) -> int:
         "--moe-aux-weight", type=float, default=0.01,
         help="switch-transformer router load-balancing loss weight",
     )
+    ap.add_argument(
+        "--model", default="labformer", choices=("labformer", "labvision"),
+        help="model family: byte LM or the lab3-task CNN",
+    )
     args = ap.parse_args(argv)
     step, loss = train(
+        model=args.model,
         steps=args.steps,
         batch=args.batch,
         seq=args.seq,

@@ -54,3 +54,17 @@ class TestGenerate:
     def test_cache_shapes(self):
         kc, vc = init_kv_cache(CFG, batch=3, max_seq=16)
         assert kc.shape == (2, 3, 16, 4, 8) and vc.shape == kc.shape
+
+    def test_flash_prefill_matches_dense(self, rng):
+        """Batched prefill through the Pallas flash kernel (attn_impl=
+        flash) must sample the same greedy tokens as the dense prefill."""
+        import dataclasses
+
+        params = init_params(CFG, seed=0)
+        prompt = rng.integers(0, 256, (2, 32)).astype(np.int32)
+        dense = generate(params, prompt, CFG, steps=6, temperature=0.0)
+        fl = generate(
+            params, prompt, dataclasses.replace(CFG, attn_impl="flash"),
+            steps=6, temperature=0.0,
+        )
+        np.testing.assert_array_equal(dense, fl)

@@ -85,6 +85,50 @@ def _forward_step(params, token, k_caches, v_caches, pos, cfg: LabformerConfig):
     return logits, k_caches, v_caches
 
 
+def _prefill(params, prompt, cfg: LabformerConfig, cache_len: int):
+    """One batched forward over the whole prompt, filling the KV caches.
+
+    Serving-grade prefill: where a token-by-token loop pays ``p``
+    sequential full-weight reads, this is a single forward pass — the
+    prompt becomes compute-bound MXU work instead of latency-bound
+    steps.  Returns ``(last_logits, k_caches, v_caches)`` with caches
+    zero-padded to ``cache_len``.
+    """
+    b, p = prompt.shape
+    h, dh = cfg.n_heads, cfg.head_dim
+    x = embed_lookup(params["embed"], prompt, cfg.dtype)  # (b, p, d)
+    positions = jnp.arange(p)
+    use_flash = cfg.attn_impl == "flash" or (cfg.attn_impl == "auto" and p >= 1024)
+
+    def attend(q, k, v):
+        if use_flash:
+            from tpulab.ops.pallas.attention import flash_attention
+
+            return flash_attention(q, k, v, causal=True)
+        from tpulab.parallel.ring import attention_reference
+
+        return attention_reference(q, k, v, causal=True)
+
+    def layer_step(x, layer):
+        xn = _rmsnorm(x, layer["ln1"])
+        q = qmat(xn, layer["wq"]).reshape(b, p, h, dh)
+        k = qmat(xn, layer["wk"]).reshape(b, p, h, dh)
+        v = qmat(xn, layer["wv"]).reshape(b, p, h, dh)
+        q = _rope(q, positions, cfg.rope_theta)
+        k = _rope(k, positions, cfg.rope_theta)
+        o = attend(q, k, v)
+        x = x + qmat(o.reshape(b, p, cfg.d_model), layer["wo"])
+        y, _ = _mlp(_rmsnorm(x, layer["ln2"]), layer, cfg)
+        x = x + y
+        pad = [(0, 0), (0, cache_len - p), (0, 0), (0, 0)]
+        return x, (jnp.pad(k, pad), jnp.pad(v, pad))
+
+    x, (k_caches, v_caches) = jax.lax.scan(layer_step, x, params["blocks"])
+    x = _rmsnorm(x[:, -1:], params["final_norm"])
+    logits = unembed(x, params["embed"])[:, 0, :]
+    return logits, k_caches, v_caches
+
+
 @functools.partial(jax.jit, static_argnames=("cfg", "steps", "temperature"))
 def generate_jit(
     params,
@@ -94,37 +138,33 @@ def generate_jit(
     steps: int,
     temperature: float = 1.0,
 ):
-    """Prefill the prompt token-by-token, then sample ``steps`` tokens.
+    """Batched prompt prefill, then sample ``steps`` tokens from the
+    KV-cached decode loop.
 
     Greedy when ``temperature == 0``; categorical sampling otherwise.
     Returns (b, steps) int32.  One jitted program end to end.
     """
     b, p = prompt.shape
-    kc, vc = init_kv_cache(cfg, b, p + steps)
-
-    def prefill_step(carry, i):
-        kc, vc = carry
-        _, kc, vc = _forward_step(params, prompt[:, i], kc, vc, i, cfg)
-        return (kc, vc), None
-
-    # all but the last prompt token just populate the cache
-    (kc, vc), _ = jax.lax.scan(prefill_step, (kc, vc), jnp.arange(p - 1))
 
     def sample(logits, key):
         if temperature == 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
         return jax.random.categorical(key, logits / temperature, axis=-1).astype(jnp.int32)
 
+    logits0, kc, vc = _prefill(params, prompt, cfg, p + steps)
+    rng_key, sub = jax.random.split(rng_key)
+    tok0 = sample(logits0, sub)
+
     def decode_step(carry, i):
         kc, vc, tok, key = carry
         key, sub = jax.random.split(key)
-        logits, kc, vc = _forward_step(params, tok, kc, vc, p - 1 + i, cfg)
-        nxt = sample(logits, sub)
-        return (kc, vc, nxt, key), nxt
+        logits, kc, vc = _forward_step(params, tok, kc, vc, p + i, cfg)
+        return (kc, vc, sample(logits, sub), key), tok
 
-    (_, _, _, _), out = jax.lax.scan(
-        decode_step, (kc, vc, prompt[:, -1], rng_key), jnp.arange(steps)
+    (_, _, last, _), out = jax.lax.scan(
+        decode_step, (kc, vc, tok0, rng_key), jnp.arange(steps - 1)
     )
+    out = jnp.concatenate([out, last[None]], axis=0)
     return out.T  # (b, steps)
 
 

@@ -68,3 +68,23 @@ class TestGenerate:
             steps=6, temperature=0.0,
         )
         np.testing.assert_array_equal(dense, fl)
+
+    def test_tp_sharded_decode_matches_single_device(self, rng):
+        """Distributed inference: shard_params' tp layout partitions the
+        whole jitted generate loop (projections column-sharded, caches
+        head-sharded, wo row-sharded + psum — all inserted by GSPMD)
+        and must reproduce the single-device tokens exactly."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from tpulab.models.labformer import shard_params
+        from tpulab.parallel.mesh import cpu_test_mesh
+
+        params = init_params(CFG, seed=0)
+        prompt = rng.integers(0, 256, (2, 8)).astype(np.int32)
+        key = jax.random.PRNGKey(0)
+        want = np.asarray(generate_jit(params, jnp.asarray(prompt), key, CFG, 6, 0.0))
+        mesh = cpu_test_mesh({"tp": 4})
+        sp = shard_params(params, CFG, mesh)
+        tok = jax.device_put(jnp.asarray(prompt), NamedSharding(mesh, P()))
+        got = np.asarray(generate_jit(sp, tok, key, CFG, 6, 0.0))
+        np.testing.assert_array_equal(got, want)

@@ -88,3 +88,19 @@ class TestGenerate:
         tok = jax.device_put(jnp.asarray(prompt), NamedSharding(mesh, P()))
         got = np.asarray(generate_jit(sp, tok, key, CFG, 6, 0.0))
         np.testing.assert_array_equal(got, want)
+
+    def test_moe_decode_matches_full_forward(self, rng):
+        """KV-cache decode with the dense-gate MoE block (the decode
+        path's expert execution) must agree with the full forward."""
+        import dataclasses
+
+        cfg = dataclasses.replace(CFG, n_experts=4)
+        params = init_params(cfg, seed=0)
+        prompt = rng.integers(0, 256, (2, 8)).astype(np.int32)
+        got = generate(params, prompt, cfg, steps=5, temperature=0.0)
+        ctx = prompt.copy()
+        for _ in range(5):
+            logits = np.asarray(forward(params, jnp.asarray(ctx), cfg))
+            nxt = logits[:, -1].argmax(-1).astype(np.int32)
+            ctx = np.concatenate([ctx, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(got, ctx[:, 8:])

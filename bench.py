@@ -23,6 +23,30 @@ import json
 import sys
 
 
+def _backend_alive(timeout_s: int = 240) -> str | None:
+    """Probe jax backend init in a THROWAWAY subprocess.
+
+    On the tunneled-TPU environment a dead relay makes backend init
+    block indefinitely at the chip claim — inside this process that
+    would mean zero output for the driver to record.  A subprocess probe
+    converts the hang into an error string.  (The kill can orphan a
+    pending claim, but the relay is already unhealthy in that branch.)
+    """
+    import subprocess
+    import sys
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices(); print('ok')"],
+            capture_output=True, text=True, timeout=timeout_s,
+        )
+        if r.returncode == 0 and "ok" in r.stdout:
+            return None
+        return (r.stderr.strip().splitlines() or ["backend init failed"])[-1][:300]
+    except subprocess.TimeoutExpired:
+        return f"backend init exceeded {timeout_s}s (TPU relay unreachable?)"
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument(
@@ -30,7 +54,21 @@ def main(argv=None) -> int:
     )
     ap.add_argument("--only", default=None, help="substring filter for the registry")
     ap.add_argument("--reps", type=int, default=30)
+    ap.add_argument("--skip-probe", action="store_true",
+                    help="skip the backend-liveness subprocess probe")
     args = ap.parse_args(argv)
+
+    if not args.skip_probe:
+        err = _backend_alive()
+        if err:
+            print(json.dumps({
+                "metric": "lab2_roberts_1024x1024_median_ms",
+                "value": None,
+                "unit": "ms",
+                "vs_baseline": None,
+                "error": err,
+            }), flush=True)
+            return 0
 
     from tpulab.bench_image import bench_lab2
 

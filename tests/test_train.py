@@ -169,3 +169,30 @@ class TestVisionModel:
 
         with _pytest.raises(ValueError, match="unknown model"):
             train(model="labaudio", steps=1, log=_quiet)
+
+
+class TestEval:
+    def test_eval_lines_logged_and_finite(self):
+        lines = []
+        train(steps=4, batch=2, seq=32, cfg=TINY, eval_every=2, eval_batches=2,
+              log=lines.append)
+        evals = [l for l in lines if l.startswith("[eval]")]
+        assert len(evals) == 2, lines
+        vals = [float(l.split()[-1]) for l in evals]
+        assert all(np.isfinite(v) for v in vals)
+
+    def test_eval_on_mesh(self):
+        lines = []
+        train(steps=2, batch=4, seq=32, cfg=TINY, mesh_devices=8, eval_every=2,
+              eval_batches=1, log=lines.append)
+        assert any(l.startswith("[eval]") for l in lines)
+
+    def test_vision_eval(self):
+        from tpulab.models.labvision import LabvisionConfig
+
+        cfg = LabvisionConfig(n_classes=4, img_size=16, channels=(8, 16))
+        lines = []
+        train(model="labvision", steps=2, batch=8, cfg=cfg, eval_every=2,
+              eval_batches=2, log=lines.append)
+        evals = [l for l in lines if l.startswith("[eval]")]
+        assert len(evals) == 1 and np.isfinite(float(evals[0].split()[-1]))

@@ -69,8 +69,15 @@ def train(
     moe_impl: str = "dense",
     moe_aux_weight: float = 0.01,
     model: str = "labformer",
+    eval_every: int = 0,
+    eval_batches: int = 4,
 ):
     """Run the loop; returns (final_step, last_loss).
+
+    ``eval_every > 0`` computes a held-out loss every that many steps on
+    a deterministic validation stream disjoint from training (different
+    seed space), logged as ``[eval]`` lines — the generalization signal
+    next to the training loss.
 
     ``model``: "labformer" (byte LM, the default) or "labvision" (CNN on
     the synthetic lab3 color-class task) — both share the checkpoint/
@@ -110,6 +117,20 @@ def train(
             if mesh is not None:
                 imgs, labels = shard_batch(imgs, labels, mesh)
             return vstep(params, opt_state, imgs, labels)
+
+        from tpulab.models.labvision import loss_fn as _vision_loss
+
+        _eval_fn = jax.jit(_vision_loss, static_argnums=(3,))
+
+        def eval_loss(params):
+            import jax.numpy as jnp
+
+            tot = 0.0
+            for j in range(eval_batches):
+                rng = np.random.default_rng((seed << 21) ^ (7919 + j))
+                imgs, labels = synth_batch(cfg, batch, rng)
+                tot += float(_eval_fn(params, jnp.asarray(imgs), jnp.asarray(labels), cfg))
+            return tot / eval_batches
     elif model == "labformer":
         from tpulab.models.labformer import LabformerConfig, init_train_state
 
@@ -132,6 +153,18 @@ def train(
         )
         batch_at = batches(cfg.vocab, batch, seq, seed)
         do_step = train_step
+
+        from tpulab.models.labformer import loss_fn as _lm_loss
+
+        _eval_fn = jax.jit(_lm_loss, static_argnums=(2, 3))
+        # disjoint seed space: the training stream hashes (seed<<20)^step
+        val_at = batches(cfg.vocab, batch, seq, seed + 104729)
+
+        def eval_loss(params):
+            return sum(
+                float(_eval_fn(params, val_at(j), cfg, mesh))
+                for j in range(eval_batches)
+            ) / eval_batches
     else:
         raise ValueError(f"unknown model {model!r}")
 
@@ -172,6 +205,9 @@ def train(
             if not np.isfinite(loss):  # fail fast — the CSC-macro analog
                 raise FloatingPointError(f"non-finite loss {loss} at step {step}")
             log(f"[train] step {step} loss {loss:.4f} ({dt:.1f} ms)")
+            if eval_every and (step + 1) % eval_every == 0:
+                val = eval_loss(params)
+                log(f"[eval] step {step} val_loss {val:.4f}")
             if manager and (step + 1) % save_every == 0:
                 import orbax.checkpoint as ocp
 
@@ -216,9 +252,12 @@ def main(argv=None) -> int:
         "--model", default="labformer", choices=("labformer", "labvision"),
         help="model family: byte LM or the lab3-task CNN",
     )
+    ap.add_argument("--eval-every", type=int, default=0,
+                    help="held-out loss every N steps (0 = off)")
     args = ap.parse_args(argv)
     step, loss = train(
         model=args.model,
+        eval_every=args.eval_every,
         steps=args.steps,
         batch=args.batch,
         seq=args.seq,

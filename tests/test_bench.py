@@ -55,3 +55,15 @@ def test_mfu_fields_empty_without_peak_or_flops():
 
     assert _mfu_fields(1e9, 1.0, Unknown()) == {}
     assert _mfu_fields(0, 1.0, _Dev()) == {}
+
+
+def test_run_benchmarks_isolates_failures(monkeypatch):
+    """One broken bench becomes an error row; the rest still run."""
+    import tpulab.bench as tb
+
+    def boom(**kw):
+        raise RuntimeError("synthetic failure")
+
+    monkeypatch.setattr(tb, "bench_sort", boom)
+    rows = tb.run_benchmarks(only="hw2_sort")
+    assert rows == [{"metric": "hw2_sort", "error": "RuntimeError: synthetic failure"}]

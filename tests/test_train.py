@@ -196,3 +196,38 @@ class TestEval:
               eval_batches=2, log=lines.append)
         evals = [l for l in lines if l.startswith("[eval]")]
         assert len(evals) == 1 and np.isfinite(float(evals[0].split()[-1]))
+
+
+class TestOptimizerStack:
+    def test_warmup_cosine_trains(self):
+        _, loss = train(steps=6, batch=2, seq=32, cfg=TINY, lr=3e-4,
+                        warmup_steps=2, schedule="cosine", clip_norm=1.0,
+                        log=_quiet)
+        assert np.isfinite(loss)
+
+    def test_clip_norm_bounds_update(self):
+        """With an absurdly tiny clip norm the params barely move."""
+        import jax
+
+        from tpulab.models.labformer import init_params, init_train_state
+        from tpulab.train import build_optimizer
+
+        opt = build_optimizer(lr=1.0, steps=5, clip_norm=1e-8)
+        params, opt_state, step = init_train_state(TINY, None, seed=0,
+                                                   optimizer=opt)
+        before = np.asarray(jax.device_get(params["blocks"]["wq"])).copy()
+        tok = np.random.default_rng(0).integers(0, 256, (2, 33)).astype(np.int32)
+        params, opt_state, _ = step(params, opt_state, tok)
+        after = np.asarray(jax.device_get(params["blocks"]["wq"]))
+        # adamw normalizes per-param scale, but the clipped gradient is
+        # ~1e-8 of its natural size -> second-moment ratios stay sane and
+        # the single-step delta is tiny relative to lr=1.0
+        assert np.abs(after - before).max() < 1.5
+
+    def test_unknown_schedule_raises(self):
+        from tpulab.train import build_optimizer
+
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError, match="unknown schedule"):
+            build_optimizer(lr=1e-3, steps=5, schedule="triangle")

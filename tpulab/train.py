@@ -49,6 +49,38 @@ def batches(vocab: int, batch: int, seq: int, seed: int):
     return batch_at
 
 
+def build_optimizer(
+    lr: float,
+    steps: int,
+    warmup_steps: int = 0,
+    schedule: str = "const",
+    clip_norm: float = 0.0,
+):
+    """Standard LLM-trainer optimizer stack: optional global-norm
+    clipping → adamw on a constant or linear-warmup + cosine-decay
+    schedule."""
+    import optax
+
+    if schedule == "cosine":
+        sched = optax.warmup_cosine_decay_schedule(
+            init_value=0.0 if warmup_steps else lr,
+            peak_value=lr,
+            warmup_steps=warmup_steps,
+            decay_steps=max(steps, warmup_steps + 1),
+        )
+    elif schedule == "const":
+        sched = (
+            optax.linear_schedule(0.0, lr, warmup_steps) if warmup_steps else lr
+        )
+    else:
+        raise ValueError(f"unknown schedule {schedule!r}")
+    chain = []
+    if clip_norm:
+        chain.append(optax.clip_by_global_norm(clip_norm))
+    chain.append(optax.adamw(sched))
+    return optax.chain(*chain)
+
+
 def train(
     steps: int = 50,
     batch: int = 8,
@@ -71,6 +103,10 @@ def train(
     model: str = "labformer",
     eval_every: int = 0,
     eval_batches: int = 4,
+    lr: float = 0.0,
+    warmup_steps: int = 0,
+    schedule: str = "const",
+    clip_norm: float = 0.0,
 ):
     """Run the loop; returns (final_step, last_loss).
 
@@ -90,6 +126,15 @@ def train(
 
     from tpulab.parallel.mesh import make_mesh
     from tpulab.runtime.trace import maybe_trace
+
+    if optimizer is None and (lr or warmup_steps or schedule != "const" or clip_norm):
+        optimizer = build_optimizer(
+            lr=lr or (1e-3 if model == "labvision" else 3e-4),
+            steps=steps,
+            warmup_steps=warmup_steps,
+            schedule=schedule,
+            clip_norm=clip_norm,
+        )
 
     if model == "labvision":
         from tpulab.models.labvision import (
@@ -254,10 +299,19 @@ def main(argv=None) -> int:
     )
     ap.add_argument("--eval-every", type=int, default=0,
                     help="held-out loss every N steps (0 = off)")
+    ap.add_argument("--lr", type=float, default=0.0, help="peak learning rate")
+    ap.add_argument("--warmup-steps", type=int, default=0)
+    ap.add_argument("--schedule", default="const", choices=("const", "cosine"))
+    ap.add_argument("--clip-norm", type=float, default=0.0,
+                    help="global gradient-norm clip (0 = off)")
     args = ap.parse_args(argv)
     step, loss = train(
         model=args.model,
         eval_every=args.eval_every,
+        lr=args.lr,
+        warmup_steps=args.warmup_steps,
+        schedule=args.schedule,
+        clip_norm=args.clip_norm,
         steps=args.steps,
         batch=args.batch,
         seq=args.seq,

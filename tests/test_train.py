@@ -231,3 +231,30 @@ class TestOptimizerStack:
 
         with _pytest.raises(ValueError, match="unknown schedule"):
             build_optimizer(lr=1e-3, steps=5, schedule="triangle")
+
+
+class TestElasticResume:
+    def test_mesh_checkpoint_restores_on_single_device(self, tmp_path):
+        """A snapshot taken while training on the 8-device mesh must
+        restore and continue on a single device (and vice versa) — the
+        elastic-topology half of checkpoint/resume."""
+        d = str(tmp_path / "elastic")
+        train(steps=4, batch=4, seq=32, cfg=TINY, mesh_devices=8, ckpt_dir=d,
+              save_every=4, log=_quiet)
+        _, resumed_single = train(
+            steps=8, batch=4, seq=32, cfg=TINY, mesh_devices=0, ckpt_dir=d,
+            save_every=8, resume=True, log=_quiet,
+        )
+        _, straight = train(steps=8, batch=4, seq=32, cfg=TINY, log=_quiet)
+        assert abs(resumed_single - straight) < 1e-4, (resumed_single, straight)
+
+    def test_single_checkpoint_restores_on_mesh(self, tmp_path):
+        d = str(tmp_path / "elastic2")
+        train(steps=4, batch=4, seq=32, cfg=TINY, ckpt_dir=d, save_every=4,
+              log=_quiet)
+        _, resumed_mesh = train(
+            steps=8, batch=4, seq=32, cfg=TINY, mesh_devices=8, ckpt_dir=d,
+            save_every=8, resume=True, log=_quiet,
+        )
+        _, straight = train(steps=8, batch=4, seq=32, cfg=TINY, log=_quiet)
+        assert abs(resumed_mesh - straight) < 1e-4, (resumed_mesh, straight)

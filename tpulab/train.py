@@ -235,8 +235,33 @@ def train(
                     state=ocp.args.StandardRestore({"params": params, "opt_state": opt_state})
                 ),
             )
-            params = restored.state["params"]
-            opt_state = restored.state["opt_state"]
+            # re-place onto the LIVE template's placement: the snapshot
+            # may come from a different topology (mesh <-> single
+            # device), and orbax returns COMMITTED single-device arrays
+            # that a mesh-sharded jitted step rejects.  Committed
+            # template leaves get their sharding back; uncommitted /
+            # numpy template leaves stay uncommitted (jnp.asarray) so
+            # jit keeps the freedom to place them.
+            import jax.numpy as _jnp
+
+            def _replace(t, r):
+                if isinstance(t, jax.Array) and getattr(t, "committed", False):
+                    return jax.device_put(r, t.sharding)
+                # jnp.asarray would keep a committed restored array
+                # committed — round-trip through host to truly uncommit
+                return _jnp.asarray(jax.device_get(r))
+
+            template = {"params": params, "opt_state": opt_state}
+            state = jax.tree_util.tree_map(
+                _replace,
+                template,
+                {
+                    "params": restored.state["params"],
+                    "opt_state": restored.state["opt_state"],
+                },
+            )
+            params = state["params"]
+            opt_state = state["opt_state"]
             log(f"[train] resumed from step {start_step}")
 
     loss = float("nan")

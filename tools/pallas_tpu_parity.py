@@ -29,19 +29,25 @@ ROOT = pathlib.Path(__file__).resolve().parent.parent
 
 
 def _naive_attention(q, k, v, causal: bool):
-    """O(s^2) reference attention in f32 over (b, s, h, d)."""
+    """O(s^2) reference attention in f32 over (b, s, h, d).
+
+    ``precision=HIGHEST``: TPU einsum default routes f32 matmuls through
+    bf16 passes (~1e-2 error at these shapes) — the *reference* would be
+    the noisy side of the comparison, dominating the parity bound."""
+    import jax
     import jax.numpy as jnp
 
+    hi = jax.lax.Precision.HIGHEST
     qf, kf, vf = (x.astype(jnp.float32) for x in (q, k, v))
     scale = 1.0 / np.sqrt(q.shape[-1])
-    logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kf) * scale
+    logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kf, precision=hi) * scale
     if causal:
         s = q.shape[1]
         mask = np.tril(np.ones((s, s), bool))
         logits = jnp.where(mask[None, None], logits, -jnp.inf)
     w = jnp.exp(logits - logits.max(-1, keepdims=True))
     w = w / w.sum(-1, keepdims=True)
-    return jnp.einsum("bhqk,bkhd->bqhd", w, vf)
+    return jnp.einsum("bhqk,bkhd->bqhd", w, vf, precision=hi)
 
 
 def run_checks() -> list:
@@ -139,8 +145,6 @@ def run_checks() -> list:
         for _ in range(3)
     )
     tgt = jnp.asarray(rng.standard_normal((b_, s, nh, d)).astype(np.float32))
-    import jax
-
     loss_f = lambda q, k, v: jnp.sum(
         (flash_attention(q, k, v, causal=True, block_q=512, block_k=512,
                          interpret=False) - tgt) ** 2

@@ -74,7 +74,13 @@ def init_params(cfg: LabvisionConfig, seed: int = 0):
 def forward(params, images, cfg: LabvisionConfig):
     """(b, H, W, 3) uint8/float images -> (b, n_classes) f32 logits."""
     dt = cfg.compute_dtype
-    x = images.astype(dt) / np.float32(255.0) if images.dtype == jnp.uint8 else images.astype(dt)
+    # normalize in f32 THEN cast: dividing a bf16 array by an np.float32
+    # scalar promotes the result back to f32, which conv_general_dilated
+    # rejects against bf16 weights (strict same-dtype requirement)
+    if images.dtype == jnp.uint8:
+        x = (images.astype(jnp.float32) / np.float32(255.0)).astype(dt)
+    else:
+        x = images.astype(dt)
     for conv in params["convs"]:
         x = jax.lax.conv_general_dilated(
             x,

@@ -59,12 +59,19 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
     active = (kb * block_k <= qb * block_q + block_q - 1) if causal else None
 
     def _compute():
-        # np.float32 scale, not np.float64: under the global x64 a float64
-        # scalar would promote the product and poison the f32 scratch refs
-        q = q_ref[0].astype(jnp.float32) * np.float32(scale)  # (bq, d)
-        k = k_ref[0].astype(jnp.float32)                  # (bk, d)
-        v = v_ref[0].astype(jnp.float32)                  # (bk, d)
-        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())))  # (bq, bk) f32
+        # dots take NATIVE-dtype operands with f32 accumulation
+        # (preferred_element_type): bf16xbf16->f32 is one MXU pass where
+        # upcast-then-f32xf32 costs several.  The scale folds into the
+        # f32 scores, not the operands (np.float32, not np.float64: under
+        # the global x64 a float64 scalar would poison the f32 scratch).
+        q = q_ref[0]                                      # (bq, d)
+        k = k_ref[0]                                      # (bk, d)
+        v = v_ref[0]                                      # (bk, d)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        ) * np.float32(scale)                             # (bq, bk) f32
 
         if causal:
             q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
@@ -80,8 +87,16 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)                             # (bq, bk)
         l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        # p rides in v's dtype (bf16 when the model is bf16): exp outputs
+        # lie in [0, 1] where bf16's 8 mantissa bits keep the p@v dot
+        # within flash's usual tolerance, at one MXU pass.  For f32
+        # operands, precision=HIGHEST forces the exact multi-pass f32
+        # matmul (DEFAULT would round f32 through bf16 passes); for bf16
+        # operands it is a no-op (bf16 is already a single native pass).
         acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ()))
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
         )
         m_ref[:] = m_new
         l_ref[:] = l_new
@@ -95,8 +110,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
     def _finish():
         o_ref[0] = (acc_ref[:] / l_ref[:]).astype(o_ref.dtype)
         # logsumexp per q row — the backward pass's softmax residual
-        # (p = exp(s - lse) reconstructs exact probabilities blockwise)
-        lse_ref[0] = (m_ref[:] + jnp.log(l_ref[:]))[:, 0]
+        # (p = exp(s - lse) reconstructs exact probabilities blockwise).
+        # lse rides a trailing-singleton lane dim: a (1, block_q) block
+        # over a (bh, s) array has sublane 1, which Mosaic rejects
+        # (tiling needs sublane % 8 == 0 or == array dim); (block_q, 1)
+        # over (bh, s, 1) satisfies both rules and matches the (bq, 1)
+        # scratch layout with no relayout.
+        lse_ref[0] = m_ref[:] + jnp.log(l_ref[:])
 
 
 def _check_blocks(s: int, block_q: int, block_k: int) -> None:
@@ -124,17 +144,18 @@ def _flash_fwd_call(q, k, v, block_q: int, block_k: int, causal: bool,
         (1, block_k, d), lambda b, i, j: (b, j, jnp.int32(0)), memory_space=pltpu.VMEM
     )
     lse_spec = pl.BlockSpec(
-        (1, block_q), lambda b, i, j: (b, i), memory_space=pltpu.VMEM
+        (1, block_q, 1), lambda b, i, j: (b, i, jnp.int32(0)),
+        memory_space=pltpu.VMEM,
     )
     kernel = functools.partial(
         _flash_kernel, block_q=block_q, block_k=block_k, n_k=n_k,
         causal=causal, scale=scale,
     )
-    return pl.pallas_call(
+    o, lse = pl.pallas_call(
         kernel,
         out_shape=(
             jax.ShapeDtypeStruct(q.shape, q.dtype),
-            jax.ShapeDtypeStruct((bh, s), jnp.float32),
+            jax.ShapeDtypeStruct((bh, s, 1), jnp.float32),
         ),
         grid=grid,
         in_specs=[q_spec, kv_spec, kv_spec],
@@ -146,6 +167,7 @@ def _flash_fwd_call(q, k, v, block_q: int, block_k: int, causal: bool,
         ],
         interpret=interpret,
     )(q, k, v)
+    return o, lse[..., 0]
 
 
 def _causal_p_mask(p, qb, kb, block_q: int, block_k: int):
@@ -169,20 +191,32 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
     def _compute():
-        qs = q_ref[0].astype(jnp.float32) * np.float32(scale)  # (bq, d)
-        k = k_ref[0].astype(jnp.float32)                       # (bk, d)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)                     # (bq, d)
-        lse = lse_ref[0][:, None]                              # (bq, 1)
-        delta = delta_ref[0][:, None]                          # (bq, 1)
-        s = jax.lax.dot_general(qs, k, (((1,), (1,)), ((), ())))  # (bq, bk)
+        # native-dtype operands + f32 accumulation throughout (see
+        # _flash_kernel._compute): one MXU pass per dot for bf16 models
+        q = q_ref[0]                                           # (bq, d)
+        k = k_ref[0]                                           # (bk, d)
+        v = v_ref[0]
+        do = do_ref[0]                                         # (bq, d)
+        lse = lse_ref[0]                                       # (bq, 1)
+        delta = delta_ref[0]                                   # (bq, 1)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        ) * np.float32(scale)                                  # (bq, bk)
         p = jnp.exp(s - lse)
         if causal:
             p = _causal_p_mask(p, qb, kb, block_q, block_k)
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))  # (bq, bk)
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )                                                      # (bq, bk)
         ds = p * (dp - delta)
         dq_acc[:] += jax.lax.dot_general(
-            ds, k, (((1,), (0,)), ((), ()))
+            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
         ) * np.float32(scale)
 
     if causal:
@@ -207,21 +241,38 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
     def _compute():
-        qs = q_ref[0].astype(jnp.float32) * np.float32(scale)  # (bq, d)
-        k = k_ref[0].astype(jnp.float32)                       # (bk, d)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)                     # (bq, d)
-        lse = lse_ref[0][:, None]
-        delta = delta_ref[0][:, None]
-        s = jax.lax.dot_general(qs, k, (((1,), (1,)), ((), ())))  # (bq, bk)
+        # native-dtype operands + f32 accumulation (see _flash_kernel)
+        q = q_ref[0]                                           # (bq, d)
+        k = k_ref[0]                                           # (bk, d)
+        v = v_ref[0]
+        do = do_ref[0]                                         # (bq, d)
+        lse = lse_ref[0]                                       # (bq, 1)
+        delta = delta_ref[0]                                   # (bq, 1)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        ) * np.float32(scale)                                  # (bq, bk)
         p = jnp.exp(s - lse)
         if causal:
             p = _causal_p_mask(p, qb, kb, block_q, block_k)
-        dv_acc[:] += jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())))
-        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())))  # (bq, bk)
+        dv_acc[:] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        )                                                      # (bq, bk)
         ds = p * (dp - delta)
         # ds^T @ (q*scale) == (ds^T @ q) * scale: the fold is linear
-        dk_acc[:] += jax.lax.dot_general(ds, qs, (((0,), (0,)), ((), ())))
+        dk_acc[:] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+            precision=jax.lax.Precision.HIGHEST,
+        ) * np.float32(scale)
 
     if causal:
         # a K block only sees gradient from Q blocks reaching it
@@ -268,7 +319,14 @@ def _flash_bwd_call(q, k, v, o, lse, do, block_q: int, block_k: int,
     kv_spec_i = pl.BlockSpec(
         (1, bk, d), lambda b, i, j: (b, j, jnp.int32(0)), memory_space=pltpu.VMEM
     )
-    row_spec = pl.BlockSpec((1, bq), lambda b, i, j: (b, i), memory_space=pltpu.VMEM)
+    # lse/delta ride a trailing-singleton lane dim (see _flash_kernel's
+    # _finish note): (1, bq) blocks over (bh, s) have sublane 1, which
+    # Mosaic's tiling rules reject on real TPUs
+    lse3 = lse[..., None]
+    delta3 = delta[..., None]
+    row_spec = pl.BlockSpec(
+        (1, bq, 1), lambda b, i, j: (b, i, jnp.int32(0)), memory_space=pltpu.VMEM
+    )
     dq = pl.pallas_call(
         functools.partial(
             _flash_bwd_dq_kernel, block_q=bq, block_k=bk, n_k=n_k,
@@ -280,7 +338,7 @@ def _flash_bwd_call(q, k, v, o, lse, do, block_q: int, block_k: int,
         out_specs=q_spec,
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse3, delta3)
 
     # dkv grid: K blocks outer, Q blocks inner (scratch accumulates per K)
     q_spec_j = pl.BlockSpec(
@@ -289,7 +347,9 @@ def _flash_bwd_call(q, k, v, o, lse, do, block_q: int, block_k: int,
     kv_spec_j = pl.BlockSpec(
         (1, bk, d), lambda b, j, i: (b, j, jnp.int32(0)), memory_space=pltpu.VMEM
     )
-    row_spec_j = pl.BlockSpec((1, bq), lambda b, j, i: (b, i), memory_space=pltpu.VMEM)
+    row_spec_j = pl.BlockSpec(
+        (1, bq, 1), lambda b, j, i: (b, i, jnp.int32(0)), memory_space=pltpu.VMEM
+    )
     dk, dv = pl.pallas_call(
         functools.partial(
             _flash_bwd_dkv_kernel, block_q=bq, block_k=bk, n_q=n_q,
@@ -307,7 +367,7 @@ def _flash_bwd_call(q, k, v, o, lse, do, block_q: int, block_k: int,
             pltpu.VMEM((bk, d), jnp.float32),
         ],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse3, delta3)
     return dq, dk, dv
 
 

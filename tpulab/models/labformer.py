@@ -191,6 +191,79 @@ def shard_params(params, cfg: LabformerConfig, mesh: Mesh):
     )
 
 
+def _zero1_spec(shape, spec: P, mesh: Mesh) -> P:
+    """The ZeRO-1 sharding for an optimizer-moment leaf: the param's
+    (mesh-restricted) spec with ``"dp"`` added on the first axis that is
+    unsharded and divisible by the dp size.
+
+    The reference world implements optimizer-state sharding with manual
+    reduce-scatter / all-gather choreography (ZeRO stage 1); under GSPMD
+    the same schedule falls out of a sharding constraint: moments sharded
+    over dp make XLA slice the (dp-replicated) grads before the moment
+    update and all-gather the parameter updates after it.
+    """
+    spec = _restrict(spec, mesh)
+    if "dp" not in mesh.axis_names or mesh.shape["dp"] <= 1:
+        return spec
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    used = set()
+    for e in entries:
+        used.update(e if isinstance(e, tuple) else (e,) if e else ())
+    if "dp" in used:  # e.g. MoE expert axis already consumes dp
+        return spec
+    dp = mesh.shape["dp"]
+    for i, (dim, e) in enumerate(zip(shape, entries)):
+        if e is None and dim % dp == 0 and dim >= dp:
+            entries[i] = "dp"
+            return P(*entries)
+    return spec  # no shardable axis: leave the leaf replicated
+
+
+def zero1_shardings(params, cfg: LabformerConfig, mesh: Mesh):
+    """Params-shaped tree of the ZeRO-1 NamedShardings for the moments."""
+    specs = param_specs(cfg)
+    return jax.tree_util.tree_map(
+        lambda p, s: NamedSharding(mesh, _zero1_spec(np.shape(p), s, mesh)),
+        params,
+        specs,
+    )
+
+
+def _map_moment_trees(opt_state, params, shardings, place):
+    """Apply ``place(leaf, sharding)`` across every params-shaped subtree
+    of an optax state.
+
+    Adam's mu/nu (and any other per-param accumulator) carry exactly the
+    params' pytree structure, so moment subtrees are recognized by
+    treedef equality — unambiguous even when distinct params share a
+    shape (e.g. wo vs w1 at d_ff == d_model, whose tp layouts differ).
+    Everything else (step counters, empty chain states) passes through.
+    """
+    pdef = jax.tree_util.tree_structure(params)
+    is_moment = lambda node: jax.tree_util.tree_structure(node) == pdef
+    def one(node):
+        if is_moment(node):
+            return jax.tree_util.tree_map(place, node, shardings)
+        return node
+    return jax.tree_util.tree_map(one, opt_state, is_leaf=is_moment)
+
+
+def _zero1_constrain(opt_state, params, shardings):
+    """Pin moment subtrees to their ZeRO-1 shardings (inside jit)."""
+    return _map_moment_trees(
+        opt_state, params, shardings, jax.lax.with_sharding_constraint
+    )
+
+
+def shard_opt_state(opt_state, params, cfg: LabformerConfig, mesh: Mesh):
+    """Eagerly place an optimizer state into its ZeRO-1 shardings (the
+    init-time analog of the in-step constraint, so full-size replicated
+    moments never materialize past ``optimizer.init``)."""
+    return _map_moment_trees(
+        opt_state, params, zero1_shardings(params, cfg, mesh), commit
+    )
+
+
 def _rmsnorm(x, scale):
     var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
     return (x * jax.lax.rsqrt(var + 1e-6).astype(x.dtype)) * scale
@@ -394,17 +467,25 @@ def loss_fn(params, tokens, cfg: LabformerConfig, mesh: Optional[Mesh] = None):
 
 
 def make_train_step(
-    cfg: LabformerConfig, mesh: Optional[Mesh], optimizer=None, accum: int = 1
+    cfg: LabformerConfig, mesh: Optional[Mesh], optimizer=None, accum: int = 1,
+    zero1: bool = False,
 ):
     """Jitted (params, opt_state, tokens) -> (params, opt_state, loss).
 
     ``accum > 1`` splits the batch into ``accum`` microbatches and
     averages their gradients inside one jitted step (``lax.scan``) —
     the effective batch grows without growing activation memory.
+
+    ``zero1`` shards optimizer moments over the dp axis (ZeRO stage 1):
+    each dp rank stores and updates 1/dp of the Adam state, XLA slicing
+    the grads before the moment update and all-gathering the parameter
+    updates after — the optimizer-memory term stops scaling with model
+    replication.
     """
     import optax
 
     optimizer = optimizer or optax.adamw(3e-4)
+    use_zero1 = bool(zero1 and mesh is not None)
 
     @jax.jit
     def train_step(params, opt_state, tokens):
@@ -426,6 +507,10 @@ def make_train_step(
             grads = jax.tree_util.tree_map(lambda g: g * inv.astype(g.dtype), grads)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
+        if use_zero1:
+            opt_state = _zero1_constrain(
+                opt_state, params, zero1_shardings(params, cfg, mesh)
+            )
         return params, opt_state, loss
 
     return optimizer, train_step
@@ -437,9 +522,12 @@ def init_train_state(
     seed: int = 0,
     optimizer=None,
     accum: int = 1,
+    zero1: bool = False,
 ):
     params = init_params(cfg, seed)
-    optimizer, train_step = make_train_step(cfg, mesh, optimizer, accum=accum)
+    optimizer, train_step = make_train_step(
+        cfg, mesh, optimizer, accum=accum, zero1=zero1
+    )
     if mesh is not None:
         params = shard_params(params, cfg, mesh)
         # optax's init eagerly creates its step counter; anchor it to the
@@ -448,6 +536,8 @@ def init_train_state(
         # later cross-backend-transfers — on the default device
         with jax.default_device(mesh_anchor(mesh)):
             opt_state = optimizer.init(params)
+        if zero1:
+            opt_state = shard_opt_state(opt_state, params, cfg, mesh)
     else:
         opt_state = optimizer.init(params)
     return params, opt_state, train_step
@@ -471,8 +561,9 @@ def dryrun_train_step(n_devices: int, backend: Optional[str] = None) -> None:
     """One sharded training step on tiny shapes over an n-device mesh.
 
     Mesh axes (dp, sp, tp, pp) factored from ``n_devices``; the MoE
-    config exercises ep (experts over the fused dp*sp submesh).  Loss
-    must be finite and params must change.
+    config exercises ep (experts over the fused dp*sp submesh) and the
+    optimizer runs ZeRO-1 (moments sharded over dp).  Loss must be
+    finite and params must change.
     """
     mesh = make_mesh(n_devices=n_devices, axes=("dp", "sp", "tp", "pp"), backend=backend)
     sp = mesh.shape["sp"]
@@ -487,7 +578,7 @@ def dryrun_train_step(n_devices: int, backend: Optional[str] = None) -> None:
         max_seq=64,
         moe_impl="dispatch",  # real all_to_all ep dispatch in the dryrun
     )
-    params, opt_state, train_step = init_train_state(cfg, mesh, seed=0)
+    params, opt_state, train_step = init_train_state(cfg, mesh, seed=0, zero1=True)
     rng = np.random.default_rng(1)
     seq = 8 * sp + 1  # +1: loss shifts tokens/targets
     tokens = jax.device_put(

@@ -107,6 +107,7 @@ def train(
     warmup_steps: int = 0,
     schedule: str = "const",
     clip_norm: float = 0.0,
+    zero1: bool = False,
 ):
     """Run the loop; returns (final_step, last_loss).
 
@@ -123,6 +124,17 @@ def train(
 
     if sanitize:
         jax.config.update("jax_debug_nans", True)
+
+    # refuse rather than silently no-op: a user asking for ZeRO-1 is
+    # counting on the optimizer-memory shard — running replicated and
+    # reporting success would be a lie
+    if zero1 and model != "labformer":
+        raise ValueError("zero1 is implemented for the labformer trainer")
+    if zero1 and not mesh_devices:
+        raise ValueError(
+            "zero1 requires a device mesh (--mesh N): optimizer moments "
+            "shard over the dp axis"
+        )
 
     from tpulab.parallel.mesh import make_mesh
     from tpulab.runtime.trace import maybe_trace
@@ -194,7 +206,7 @@ def train(
         if mesh_devices:
             mesh = make_mesh(n_devices=mesh_devices, axes=("dp", "sp", "tp", "pp"))
         params, opt_state, train_step = init_train_state(
-            cfg, mesh, seed=seed, optimizer=optimizer, accum=accum
+            cfg, mesh, seed=seed, optimizer=optimizer, accum=accum, zero1=zero1
         )
         batch_at = batches(cfg.vocab, batch, seq, seed)
         do_step = train_step
@@ -329,6 +341,8 @@ def main(argv=None) -> int:
     ap.add_argument("--schedule", default="const", choices=("const", "cosine"))
     ap.add_argument("--clip-norm", type=float, default=0.0,
                     help="global gradient-norm clip (0 = off)")
+    ap.add_argument("--zero1", action="store_true",
+                    help="shard optimizer state over the dp axis (ZeRO-1)")
     args = ap.parse_args(argv)
     step, loss = train(
         model=args.model,
@@ -352,6 +366,7 @@ def main(argv=None) -> int:
         experts=args.experts,
         moe_impl=args.moe_impl,
         moe_aux_weight=args.moe_aux_weight,
+        zero1=args.zero1,
     )
     print(json.dumps({"final_step": step, "loss": loss}))
     return 0

@@ -22,39 +22,48 @@ from tpulab.models.labformer import (
     _mlp,
     _rmsnorm,
     _rope,
+    repeat_kv,
 )
 from tpulab.models.quant import embed_lookup, qmat, unembed
 from tpulab.parallel.ring import NEG_INF
 
 
 def init_kv_cache(cfg: LabformerConfig, batch: int, max_seq: int):
-    shape = (cfg.n_layers, batch, max_seq, cfg.n_heads, cfg.head_dim)
+    # kv_heads, not n_heads: under GQA the cache (decode's HBM-bandwidth
+    # bill) shrinks by the group factor
+    shape = (cfg.n_layers, batch, max_seq, cfg.kv_heads, cfg.head_dim)
     return jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype)
 
 
 def _attend_cached(q, k_cache, v_cache, pos):
-    """q: (b, 1, h, d); caches (b, S, h, d); attends keys [0, pos].
+    """q: (b, 1, h, d); caches (b, S, kv, d); attends keys [0, pos].
 
-    Same numeric recipe as attention_reference (q scaled in model dtype
-    BEFORE the matmul, scores/softmax in f32) so cached decode matches
-    the full forward."""
-    q = q / np.sqrt(q.shape[-1]).astype(q.dtype)
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache).astype(jnp.float32)
-    valid = jnp.arange(k_cache.shape[1])[None, None, None, :] <= pos
+    Grouped: query head i reads cache head ``i // (h // kv)`` (the
+    contiguous-group layout labformer._attention's training-side repeat
+    uses).  Same numeric recipe as attention_reference (q scaled in
+    model dtype BEFORE the matmul, scores/softmax in f32) so cached
+    decode matches the full forward."""
+    b, _, h, dh = q.shape
+    kvh = k_cache.shape[2]
+    g = h // kvh
+    q = q / np.sqrt(dh).astype(q.dtype)
+    qg = q.reshape(b, 1, kvh, g, dh)
+    s = jnp.einsum("bqcgd,bkcd->bcgqk", qg, k_cache).astype(jnp.float32)
+    valid = jnp.arange(k_cache.shape[1])[None, None, None, None, :] <= pos
     s = jnp.where(valid, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
-    o = jnp.einsum("bhqk,bkhd->bqhd", p, v_cache.astype(jnp.float32))
-    return o.astype(q.dtype)
+    o = jnp.einsum("bcgqk,bkcd->bqcgd", p, v_cache.astype(jnp.float32))
+    return o.reshape(b, 1, h, dh).astype(q.dtype)
 
 
 def _decode_block(x, layer, k_cache, v_cache, pos, cfg: LabformerConfig):
     """One transformer block for a single-token slice with cache update."""
     b = x.shape[0]
-    h, dh = cfg.n_heads, cfg.head_dim
+    h, dh, kvh = cfg.n_heads, cfg.head_dim, cfg.kv_heads
     xn = _rmsnorm(x, layer["ln1"])
     q = qmat(xn, layer["wq"]).reshape(b, 1, h, dh)
-    k = qmat(xn, layer["wk"]).reshape(b, 1, h, dh)
-    v = qmat(xn, layer["wv"]).reshape(b, 1, h, dh)
+    k = qmat(xn, layer["wk"]).reshape(b, 1, kvh, dh)
+    v = qmat(xn, layer["wv"]).reshape(b, 1, kvh, dh)
     positions = jnp.full((1,), pos)
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
@@ -95,7 +104,7 @@ def _prefill(params, prompt, cfg: LabformerConfig, cache_len: int):
     zero-padded to ``cache_len``.
     """
     b, p = prompt.shape
-    h, dh = cfg.n_heads, cfg.head_dim
+    h, dh, kvh = cfg.n_heads, cfg.head_dim, cfg.kv_heads
     x = embed_lookup(params["embed"], prompt, cfg.dtype)  # (b, p, d)
     positions = jnp.arange(p)
     use_flash = cfg.attn_impl == "flash" or (cfg.attn_impl == "auto" and p >= 1024)
@@ -112,11 +121,13 @@ def _prefill(params, prompt, cfg: LabformerConfig, cache_len: int):
     def layer_step(x, layer):
         xn = _rmsnorm(x, layer["ln1"])
         q = qmat(xn, layer["wq"]).reshape(b, p, h, dh)
-        k = qmat(xn, layer["wk"]).reshape(b, p, h, dh)
-        v = qmat(xn, layer["wv"]).reshape(b, p, h, dh)
+        k = qmat(xn, layer["wk"]).reshape(b, p, kvh, dh)
+        v = qmat(xn, layer["wv"]).reshape(b, p, kvh, dh)
         q = _rope(q, positions, cfg.rope_theta)
         k = _rope(k, positions, cfg.rope_theta)
-        o = attend(q, k, v)
+        # caches store the narrow kv-width k/v below; only the attend
+        # sees the repeated full-head view
+        o = attend(q, *repeat_kv(k, v, h))
         x = x + qmat(o.reshape(b, p, cfg.d_model), layer["wo"])
         y, _ = _mlp(_rmsnorm(x, layer["ln2"]), layer, cfg)
         x = x + y

@@ -204,7 +204,29 @@ def train(
         )
         mesh = None
         if mesh_devices:
-            mesh = make_mesh(n_devices=mesh_devices, axes=("dp", "sp", "tp", "pp"))
+            axes = ("dp", "sp", "tp", "pp")
+            if zero1:
+                # best_factorization fills the innermost axes first, so
+                # dp lands at 1 for small device counts — which would
+                # make the ZeRO-1 shard a silent no-op.  Steal a factor
+                # of 2 for dp from the least train-critical axis.
+                from tpulab.parallel.mesh import best_factorization
+
+                sizes = best_factorization(mesh_devices, axes)
+                if sizes["dp"] == 1:
+                    for a in ("pp", "tp", "sp"):
+                        if sizes[a] % 2 == 0:
+                            sizes[a] //= 2
+                            sizes["dp"] = 2
+                            break
+                    else:
+                        raise ValueError(
+                            f"zero1 needs a mesh with dp > 1; cannot "
+                            f"factor one out of {mesh_devices} devices"
+                        )
+                mesh = make_mesh(sizes)
+            else:
+                mesh = make_mesh(n_devices=mesh_devices, axes=axes)
         params, opt_state, train_step = init_train_state(
             cfg, mesh, seed=seed, optimizer=optimizer, accum=accum, zero1=zero1
         )

@@ -1,0 +1,186 @@
+// tpulab native data loader: threaded, deterministic, step-ordered.
+//
+// The training driver consumes (batch, row_tokens) int32 batches of
+// byte-level tokens streamed from arbitrary files (the byte LM treats
+// any file as training data).  Worker threads claim step numbers with
+// an atomic counter, synthesize their batch with pread (no shared file
+// offsets), and publish into an ordered buffer; the consumer always
+// receives step k before step k+1, so a run is bit-reproducible for a
+// given (files, seed, start_step) regardless of thread count — the
+// property the reference world's CUDA pipelines get from single-stream
+// loaders, kept here under real prefetch concurrency.
+//
+// Row sampling is stateless: row r of step s reads file/offset derived
+// from splitmix64(seed, s, r).  Resume == reopen with start_step.
+//
+// C API (ctypes-friendly, no C++ types across the boundary):
+//   tl_open(paths, n, batch, row_tokens, prefetch, threads, seed,
+//           start_step, err, errlen) -> handle | NULL
+//   tl_next(handle, out) -> step number delivered, or -1 after close
+//   tl_close(handle)
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+struct File {
+  int fd;
+  int64_t size;
+};
+
+static inline uint64_t splitmix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ULL;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ULL;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebULL;
+  return x ^ (x >> 31);
+}
+
+struct Loader {
+  std::vector<File> files;
+  int batch = 0;
+  int row_tokens = 0;
+  int prefetch = 0;
+  uint64_t seed = 0;
+
+  std::vector<std::thread> workers;
+  std::atomic<uint64_t> claim{0};   // next step a worker takes
+  std::atomic<bool> stop{false};
+
+  std::mutex mu;
+  std::condition_variable cv_room;  // producers: buffer has room
+  std::condition_variable cv_data;  // consumer: next step is present
+  std::map<uint64_t, std::vector<int32_t>> ready;
+  uint64_t next_out = 0;            // step the consumer needs next
+
+  ~Loader() {
+    {
+      // store+notify under mu: without the lock a worker between its
+      // predicate check and blocking would miss the wakeup (lost
+      // notify) and t.join() below would hang forever
+      std::lock_guard<std::mutex> lk(mu);
+      stop.store(true);
+    }
+    cv_room.notify_all();
+    cv_data.notify_all();
+    for (auto& t : workers)
+      if (t.joinable()) t.join();
+    for (auto& f : files) close(f.fd);
+  }
+
+  void fill_batch(uint64_t step, std::vector<int32_t>& out) const {
+    const int64_t row_bytes = row_tokens;
+    std::vector<unsigned char> buf(row_bytes);
+    for (int r = 0; r < batch; ++r) {
+      uint64_t h = splitmix64(seed ^ splitmix64(step * 0x10001ULL + r));
+      const File& f = files[h % files.size()];
+      int64_t span = f.size - row_bytes;
+      int64_t off = span > 0 ? (int64_t)(splitmix64(h) % (uint64_t)(span + 1)) : 0;
+      int64_t got = 0;
+      while (got < row_bytes) {
+        ssize_t n = pread(f.fd, buf.data() + got, row_bytes - got, off + got);
+        if (n <= 0) {  // unexpected shrink: zero-fill rather than hang
+          std::memset(buf.data() + got, 0, row_bytes - got);
+          break;
+        }
+        got += n;
+      }
+      int32_t* dst = out.data() + (size_t)r * row_tokens;
+      for (int64_t i = 0; i < row_bytes; ++i) dst[i] = buf[i];
+    }
+  }
+
+  void worker() {
+    while (!stop.load()) {
+      uint64_t step = claim.fetch_add(1);
+      std::vector<int32_t> out((size_t)batch * row_tokens);
+      fill_batch(step, out);
+      std::unique_lock<std::mutex> lk(mu);
+      // bounded: don't run more than `prefetch` steps past the consumer
+      cv_room.wait(lk, [&] {
+        return stop.load() || step < next_out + (uint64_t)prefetch;
+      });
+      if (stop.load()) return;
+      ready.emplace(step, std::move(out));
+      cv_data.notify_all();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* tl_open(const char** paths, int n_files, int batch, int row_tokens,
+              int prefetch, int threads, uint64_t seed, uint64_t start_step,
+              char* err, int errlen) {
+  auto fail = [&](const std::string& m) -> void* {
+    if (err && errlen > 0) {
+      std::snprintf(err, errlen, "%s", m.c_str());
+    }
+    return nullptr;
+  };
+  if (n_files <= 0) return fail("no input files");
+  if (batch <= 0 || row_tokens <= 0) return fail("batch/row_tokens must be > 0");
+  auto ld = new Loader();
+  ld->batch = batch;
+  ld->row_tokens = row_tokens;
+  ld->prefetch = prefetch > 0 ? prefetch : 2;
+  ld->seed = seed;
+  ld->claim.store(start_step);
+  ld->next_out = start_step;
+  for (int i = 0; i < n_files; ++i) {
+    int fd = open(paths[i], O_RDONLY);
+    if (fd < 0) {
+      delete ld;
+      return fail(std::string("cannot open ") + paths[i]);
+    }
+    struct stat st;
+    if (fstat(fd, &st) != 0 || st.st_size < row_tokens) {
+      close(fd);
+      continue;  // too small to yield one full row
+    }
+    ld->files.push_back({fd, (int64_t)st.st_size});
+  }
+  if (ld->files.empty()) {
+    delete ld;
+    return fail("no file holds a full row of row_tokens bytes");
+  }
+  int nt = threads > 0 ? threads : 2;
+  for (int i = 0; i < nt; ++i)
+    ld->workers.emplace_back([ld] { ld->worker(); });
+  return ld;
+}
+
+long long tl_next(void* handle, int32_t* out) {
+  auto ld = static_cast<Loader*>(handle);
+  std::unique_lock<std::mutex> lk(ld->mu);
+  ld->cv_data.wait(lk, [&] {
+    return ld->stop.load() || ld->ready.count(ld->next_out) > 0;
+  });
+  if (ld->stop.load()) return -1;
+  auto it = ld->ready.find(ld->next_out);
+  std::memcpy(out, it->second.data(), it->second.size() * sizeof(int32_t));
+  uint64_t step = it->first;
+  ld->ready.erase(it);
+  ld->next_out = step + 1;
+  ld->cv_room.notify_all();
+  return (long long)step;
+}
+
+void tl_close(void* handle) { delete static_cast<Loader*>(handle); }
+
+}  // extern "C"

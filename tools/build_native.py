@@ -54,6 +54,18 @@ def build_fastcodec() -> pathlib.Path:
     return out
 
 
+def build_loader() -> pathlib.Path:
+    LIB.mkdir(parents=True, exist_ok=True)
+    out = LIB / "libtpulab_loader.so"
+    src = NATIVE / "loader" / "tpulab_loader.cpp"
+    cmd = [
+        "g++", "-std=c++17", "-shared", "-fPIC", "-O2", "-Wall",
+        "-pthread", "-o", str(out), str(src),
+    ]
+    subprocess.run(cmd, check=True)
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--clean", action="store_true")
@@ -65,8 +77,10 @@ def main(argv=None) -> int:
         return 0
     client = build_client()
     ext = build_fastcodec()
+    loader = build_loader()
     print(f"built {client.relative_to(ROOT)}")
     print(f"built {ext.relative_to(ROOT)}")
+    print(f"built {loader.relative_to(ROOT)}")
     return 0
 
 

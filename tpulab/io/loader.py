@@ -1,0 +1,123 @@
+"""ctypes binding for the native prefetching token loader.
+
+``native/loader/tpulab_loader.cpp`` (built by ``tools/build_native.py``
+into ``native/lib/libtpulab_loader.so``) streams (batch, row_tokens)
+int32 byte-token batches from arbitrary files with worker threads and a
+step-ordered bounded buffer — deterministic for a given (files, seed,
+start_step) regardless of thread count, so checkpoint resume replays
+the exact token stream.
+
+The reference's data path is Python-side file IO per run
+(`/root/reference/utils/converter.py`, lab processors); this is the
+framework-tier replacement: native IO threads overlap disk reads with
+accelerator steps, the way its CUDA world overlaps H2D copies.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import pathlib
+from typing import Optional, Sequence
+
+import numpy as np
+
+_LIB_PATH = (
+    pathlib.Path(__file__).resolve().parent.parent.parent
+    / "native" / "lib" / "libtpulab_loader.so"
+)
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is None:
+        if not _LIB_PATH.exists():
+            raise RuntimeError(
+                f"native loader not built ({_LIB_PATH}); run "
+                "`python tools/build_native.py`"
+            )
+        lib = ctypes.CDLL(str(_LIB_PATH))
+        lib.tl_open.restype = ctypes.c_void_p
+        lib.tl_open.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_uint64,
+            ctypes.c_uint64, ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.tl_next.restype = ctypes.c_longlong
+        lib.tl_next.argtypes = [ctypes.c_void_p,
+                                ctypes.POINTER(ctypes.c_int32)]
+        lib.tl_close.restype = None
+        lib.tl_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+    return _lib
+
+
+class TokenLoader:
+    """Step-ordered prefetching byte-token stream over files."""
+
+    def __init__(
+        self,
+        paths: Sequence[str],
+        batch: int,
+        row_tokens: int,
+        *,
+        prefetch: int = 4,
+        threads: int = 2,
+        seed: int = 0,
+        start_step: int = 0,
+    ):
+        lib = _load()
+        arr = (ctypes.c_char_p * len(paths))(
+            *[str(p).encode() for p in paths]
+        )
+        err = ctypes.create_string_buffer(256)
+        self._h = lib.tl_open(
+            arr, len(paths), batch, row_tokens, prefetch, threads,
+            seed, start_step, err, len(err),
+        )
+        if not self._h:
+            raise RuntimeError(f"tl_open failed: {err.value.decode()}")
+        self._lib = lib
+        self.batch = batch
+        self.row_tokens = row_tokens
+        self._buf = np.empty((batch, row_tokens), np.int32)
+
+    @classmethod
+    def from_dir(cls, data_dir: str, batch: int, row_tokens: int, **kw
+                 ) -> "TokenLoader":
+        """All regular files under ``data_dir`` (sorted, recursive)."""
+        root = pathlib.Path(data_dir)
+        paths = sorted(str(p) for p in root.rglob("*") if p.is_file())
+        if not paths:
+            raise RuntimeError(f"no files under {data_dir}")
+        return cls(paths, batch, row_tokens, **kw)
+
+    def next(self) -> np.ndarray:
+        """The next batch, in step order; a fresh (batch, row_tokens)
+        int32 array of byte tokens in [0, 256)."""
+        if self._h is None:
+            raise RuntimeError("loader is closed")
+        step = self._lib.tl_next(
+            self._h, self._buf.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+        )
+        if step < 0:
+            raise RuntimeError("loader stopped")
+        self.last_step = int(step)
+        return self._buf.copy()
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.tl_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

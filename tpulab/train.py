@@ -108,6 +108,7 @@ def train(
     schedule: str = "const",
     clip_norm: float = 0.0,
     zero1: bool = False,
+    data_dir: Optional[str] = None,
 ):
     """Run the loop; returns (final_step, last_loss).
 
@@ -130,6 +131,10 @@ def train(
     # reporting success would be a lie
     if zero1 and model != "labformer":
         raise ValueError("zero1 is implemented for the labformer trainer")
+    if data_dir and model != "labformer":
+        raise ValueError(
+            "data_dir streams byte tokens — only the labformer consumes it"
+        )
     if zero1 and not mesh_devices:
         raise ValueError(
             "zero1 requires a device mesh (--mesh N): optimizer moments "
@@ -138,6 +143,10 @@ def train(
 
     from tpulab.parallel.mesh import make_mesh
     from tpulab.runtime.trace import maybe_trace
+
+    # native-loader registry (train/eval streams): closed in the finally
+    # below so worker threads and fds never outlive the loop
+    _box = {}
 
     if optimizer is None and (lr or warmup_steps or schedule != "const" or clip_norm):
         optimizer = build_optimizer(
@@ -230,20 +239,53 @@ def train(
         params, opt_state, train_step = init_train_state(
             cfg, mesh, seed=seed, optimizer=optimizer, accum=accum, zero1=zero1
         )
-        batch_at = batches(cfg.vocab, batch, seq, seed)
+        if data_dir:
+            from tpulab.io.loader import TokenLoader
+
+            # lazy open: start_step is only known after checkpoint
+            # restore, and the loop consumes steps strictly in order —
+            # the first call's step seeds the native stream's cursor so
+            # resume replays the exact token sequence
+            def batch_at(step: int) -> np.ndarray:
+                if "l" not in _box:
+                    _box["l"] = TokenLoader.from_dir(
+                        data_dir, batch=batch, row_tokens=seq + 1,
+                        seed=seed, start_step=step,
+                    )
+                return _box["l"].next()
+        else:
+            batch_at = batches(cfg.vocab, batch, seq, seed)
         do_step = train_step
 
         from tpulab.models.labformer import loss_fn as _lm_loss
 
         _eval_fn = jax.jit(_lm_loss, static_argnums=(2, 3))
-        # disjoint seed space: the training stream hashes (seed<<20)^step
-        val_at = batches(cfg.vocab, batch, seq, seed + 104729)
+        if data_dir:
+            # validation from the SAME corpus, different sampling seed:
+            # fresh random windows the training stream almost surely
+            # never visited — without this, eval would score synthetic
+            # tokens unrelated to what the model trains on
+            def eval_loss(params):
+                if "val" not in _box:
+                    from tpulab.io.loader import TokenLoader
 
-        def eval_loss(params):
-            return sum(
-                float(_eval_fn(params, val_at(j), cfg, mesh))
-                for j in range(eval_batches)
-            ) / eval_batches
+                    _box["val"] = TokenLoader.from_dir(
+                        data_dir, batch=batch, row_tokens=seq + 1,
+                        seed=seed + 104729,
+                    )
+                return sum(
+                    float(_eval_fn(params, _box["val"].next(), cfg, mesh))
+                    for _ in range(eval_batches)
+                ) / eval_batches
+        else:
+            # disjoint seed space: the training stream hashes (seed<<20)^step
+            val_at = batches(cfg.vocab, batch, seq, seed + 104729)
+
+            def eval_loss(params):
+                return sum(
+                    float(_eval_fn(params, val_at(j), cfg, mesh))
+                    for j in range(eval_batches)
+                ) / eval_batches
     else:
         raise ValueError(f"unknown model {model!r}")
 
@@ -299,30 +341,34 @@ def train(
             log(f"[train] resumed from step {start_step}")
 
     loss = float("nan")
-    with maybe_trace(trace_dir):
-        for step in range(start_step, steps):
-            data = batch_at(step)
-            t0 = time.perf_counter()
-            params, opt_state, loss = do_step(params, opt_state, data)
-            loss = float(loss)
-            dt = (time.perf_counter() - t0) * 1e3
-            if not np.isfinite(loss):  # fail fast — the CSC-macro analog
-                raise FloatingPointError(f"non-finite loss {loss} at step {step}")
-            log(f"[train] step {step} loss {loss:.4f} ({dt:.1f} ms)")
-            if eval_every and (step + 1) % eval_every == 0:
-                val = eval_loss(params)
-                log(f"[eval] step {step} val_loss {val:.4f}")
-            if manager and (step + 1) % save_every == 0:
-                import orbax.checkpoint as ocp
+    try:
+        with maybe_trace(trace_dir):
+            for step in range(start_step, steps):
+                data = batch_at(step)
+                t0 = time.perf_counter()
+                params, opt_state, loss = do_step(params, opt_state, data)
+                loss = float(loss)
+                dt = (time.perf_counter() - t0) * 1e3
+                if not np.isfinite(loss):  # fail fast — the CSC-macro analog
+                    raise FloatingPointError(f"non-finite loss {loss} at step {step}")
+                log(f"[train] step {step} loss {loss:.4f} ({dt:.1f} ms)")
+                if eval_every and (step + 1) % eval_every == 0:
+                    val = eval_loss(params)
+                    log(f"[eval] step {step} val_loss {val:.4f}")
+                if manager and (step + 1) % save_every == 0:
+                    import orbax.checkpoint as ocp
 
-                manager.save(
-                    step + 1,
-                    args=ocp.args.Composite(
-                        state=ocp.args.StandardSave(
-                            {"params": params, "opt_state": opt_state}
-                        )
-                    ),
-                )
+                    manager.save(
+                        step + 1,
+                        args=ocp.args.Composite(
+                            state=ocp.args.StandardSave(
+                                {"params": params, "opt_state": opt_state}
+                            )
+                        ),
+                    )
+    finally:
+        for _ld in _box.values():
+            _ld.close()
     if manager:
         manager.wait_until_finished()
         manager.close()
@@ -365,6 +411,9 @@ def main(argv=None) -> int:
                     help="global gradient-norm clip (0 = off)")
     ap.add_argument("--zero1", action="store_true",
                     help="shard optimizer state over the dp axis (ZeRO-1)")
+    ap.add_argument("--data-dir", default=None,
+                    help="stream byte tokens from files via the native "
+                         "prefetching loader (default: synthetic stream)")
     args = ap.parse_args(argv)
     step, loss = train(
         model=args.model,
@@ -389,6 +438,7 @@ def main(argv=None) -> int:
         moe_impl=args.moe_impl,
         moe_aux_weight=args.moe_aux_weight,
         zero1=args.zero1,
+        data_dir=args.data_dir,
     )
     print(json.dumps({"final_step": step, "loss": loss}))
     return 0

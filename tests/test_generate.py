@@ -104,3 +104,69 @@ class TestGenerate:
             nxt = logits[:, -1].argmax(-1).astype(np.int32)
             ctx = np.concatenate([ctx, nxt[:, None]], axis=1)
         np.testing.assert_array_equal(got, ctx[:, 8:])
+
+
+class TestSamplingFilters:
+    """top-k / top-p logit filtering (generate._filter_logits)."""
+
+    def _logits(self):
+        # a known distribution: token i has logit i (vocab 8)
+        return jnp.asarray(np.arange(8.0)[None, :], jnp.float32)
+
+    def test_top_k_masks_all_but_k(self):
+        from tpulab.models.generate import _filter_logits
+
+        out = np.asarray(_filter_logits(self._logits(), top_k=3, top_p=1.0))
+        kept = np.nonzero(out[0] > -1e29)[0]
+        assert kept.tolist() == [5, 6, 7]
+
+    def test_top_p_keeps_nucleus_with_boundary_token(self):
+        from tpulab.models.generate import _filter_logits
+
+        # probs ~ softmax(0..7): top token holds ~63% of the mass, so
+        # top_p=0.5 keeps exactly the boundary-crossing top token
+        out = np.asarray(_filter_logits(self._logits(), top_k=0, top_p=0.5))
+        kept = np.nonzero(out[0] > -1e29)[0]
+        assert kept.tolist() == [7]
+        # a generous mass keeps several; filters compose with top_k
+        out = np.asarray(_filter_logits(self._logits(), top_k=4, top_p=0.99))
+        kept = np.nonzero(out[0] > -1e29)[0]
+        assert 1 <= len(kept) <= 4 and 7 in kept
+
+    def test_filters_off_are_identity(self):
+        from tpulab.models.generate import _filter_logits
+
+        logits = self._logits()
+        out = np.asarray(_filter_logits(logits, top_k=0, top_p=1.0))
+        assert np.array_equal(out, np.asarray(logits))
+
+    def test_generate_with_filters_runs_and_respects_top_k1(self, rng):
+        from tpulab.models.generate import generate
+        from tpulab.models.labformer import init_params
+
+        cfg = CFG
+        params = init_params(cfg, seed=0)
+        prompt = rng.integers(0, cfg.vocab, (2, 4)).astype(np.int32)
+        # top_k=1 at any temperature must equal greedy
+        hot = generate(params, prompt, cfg, steps=5, temperature=5.0,
+                       top_k=1, seed=3)
+        greedy = generate(params, prompt, cfg, steps=5, temperature=0.0)
+        assert np.array_equal(hot, greedy)
+
+    def test_top_p_zero_is_top1(self):
+        from tpulab.models.generate import _filter_logits
+
+        out = np.asarray(_filter_logits(self._logits(), top_k=0, top_p=0.0))
+        kept = np.nonzero(out[0] > -1e29)[0]
+        assert kept.tolist() == [7]
+
+    def test_top_p_zero_sampling_equals_greedy(self, rng):
+        from tpulab.models.generate import generate
+        from tpulab.models.labformer import init_params
+
+        params = init_params(CFG, seed=0)
+        prompt = rng.integers(0, CFG.vocab, (2, 4)).astype(np.int32)
+        out = generate(params, prompt, CFG, steps=5, temperature=3.0,
+                       top_p=0.0, seed=1)
+        greedy = generate(params, prompt, CFG, steps=5, temperature=0.0)
+        assert np.array_equal(out, greedy)

@@ -140,7 +140,38 @@ def _prefill(params, prompt, cfg: LabformerConfig, cache_len: int):
     return logits, k_caches, v_caches
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "steps", "temperature"))
+def _filter_logits(logits, top_k: int, top_p: float):
+    """Mask logits outside the top-k set and/or the top-p nucleus.
+
+    Static-shape, sort-based (XLA-friendly: no data-dependent shapes):
+    top-k thresholds on the k-th largest logit; top-p keeps the smallest
+    prefix of the probability-sorted vocab whose mass reaches ``top_p``
+    (the token that crosses the boundary stays, nucleus-sampling
+    convention)."""
+    if top_k:
+        kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
+        logits = jnp.where(logits < kth, NEG_INF, logits)
+    if top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits.astype(jnp.float32), axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # a token is kept iff the mass strictly BEFORE it is <= top_p:
+        # the boundary-crossing token stays (nucleus convention), and
+        # the strict > means top_p=0 keeps exactly the top token rather
+        # than degenerating to the identity filter
+        exceeded = (cum - probs) > jnp.float32(max(float(top_p), 0.0))
+        cutoff = jnp.max(
+            jnp.where(exceeded, jnp.float32(NEG_INF),
+                      sorted_logits.astype(jnp.float32)),
+            axis=-1, keepdims=True,
+        )
+        logits = jnp.where(logits.astype(jnp.float32) < cutoff, NEG_INF, logits)
+    return logits
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "steps", "temperature", "top_k", "top_p")
+)
 def generate_jit(
     params,
     prompt: jax.Array,  # (b, p) int32
@@ -148,11 +179,15 @@ def generate_jit(
     cfg: LabformerConfig,
     steps: int,
     temperature: float = 1.0,
+    top_k: int = 0,
+    top_p: float = 1.0,
 ):
     """Batched prompt prefill, then sample ``steps`` tokens from the
     KV-cached decode loop.
 
-    Greedy when ``temperature == 0``; categorical sampling otherwise.
+    Greedy when ``temperature == 0``; categorical over the
+    temperature-scaled, top-k/top-p-filtered distribution otherwise
+    (``top_k=0`` / ``top_p=1.0`` disable the filters).
     Returns (b, steps) int32.  One jitted program end to end.
     """
     b, p = prompt.shape
@@ -160,7 +195,12 @@ def generate_jit(
     def sample(logits, key):
         if temperature == 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-        return jax.random.categorical(key, logits / temperature, axis=-1).astype(jnp.int32)
+        # temperature BEFORE top-p (the HF-transformers convention): the
+        # nucleus must hold top_p mass of the distribution actually
+        # sampled, not of the unscaled one
+        scaled = logits / temperature
+        scaled = _filter_logits(scaled, top_k, top_p)
+        return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
 
     logits0, kc, vc = _prefill(params, prompt, cfg, p + steps)
     rng_key, sub = jax.random.split(rng_key)
@@ -186,9 +226,12 @@ def generate(
     steps: int = 64,
     temperature: float = 1.0,
     seed: int = 0,
+    top_k: int = 0,
+    top_p: float = 1.0,
 ) -> np.ndarray:
     key = jax.random.PRNGKey(seed)
-    out = generate_jit(params, jnp.asarray(prompt, jnp.int32), key, cfg, steps, temperature)
+    out = generate_jit(params, jnp.asarray(prompt, jnp.int32), key, cfg, steps,
+                       temperature, top_k, top_p)
     return np.asarray(jax.device_get(out))
 
 
@@ -201,6 +244,10 @@ def main(argv=None) -> int:
     ap.add_argument("--prompt", default="hello")
     ap.add_argument("--steps", type=int, default=64)
     ap.add_argument("--temperature", type=float, default=1.0)
+    ap.add_argument("--top-k", type=int, default=0,
+                    help="keep only the k most likely tokens (0 = off)")
+    ap.add_argument("--top-p", type=float, default=1.0,
+                    help="nucleus sampling probability mass (1.0 = off)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--ckpt-dir", default=None)
     args = ap.parse_args(argv)
@@ -236,7 +283,7 @@ def main(argv=None) -> int:
 
     prompt = np.frombuffer(args.prompt.encode("utf-8"), np.uint8)[None, :].astype(np.int32)
     out = generate(params, prompt, cfg, steps=args.steps, temperature=args.temperature,
-                   seed=args.seed)
+                   seed=args.seed, top_k=args.top_k, top_p=args.top_p)
     text = bytes(int(t) & 0xFF for t in out[0]).decode("utf-8", errors="replace")
     print(args.prompt + text)
     return 0

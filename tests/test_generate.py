@@ -3,6 +3,7 @@
 import numpy as np
 import jax
 import jax.numpy as jnp
+import pytest
 
 from tpulab.models.generate import generate, generate_jit, init_kv_cache
 from tpulab.models.labformer import LabformerConfig, forward, init_params
@@ -123,15 +124,31 @@ class TestSamplingFilters:
     def test_top_p_keeps_nucleus_with_boundary_token(self):
         from tpulab.models.generate import _filter_logits
 
-        # probs ~ softmax(0..7): top token holds ~63% of the mass, so
-        # top_p=0.5 keeps exactly the boundary-crossing top token
+        # probs ~ softmax(0..7): mass-before per descending rank is
+        # 0, .632, .865, .950, .982, ... — exact expected sets, so a
+        # degenerate filter (e.g. one that always keeps only the argmax)
+        # cannot pass
         out = np.asarray(_filter_logits(self._logits(), top_k=0, top_p=0.5))
         kept = np.nonzero(out[0] > -1e29)[0]
-        assert kept.tolist() == [7]
-        # a generous mass keeps several; filters compose with top_k
+        assert kept.tolist() == [7]  # .632 > .5: top token alone crosses
+        out = np.asarray(_filter_logits(self._logits(), top_k=0, top_p=0.9))
+        kept = np.nonzero(out[0] > -1e29)[0]
+        assert kept.tolist() == [5, 6, 7]  # mass-before .865 <= .9 < .950
+        out = np.asarray(_filter_logits(self._logits(), top_k=0, top_p=0.99))
+        kept = np.nonzero(out[0] > -1e29)[0]
+        assert kept.tolist() == [3, 4, 5, 6, 7]
+        # composes with top_k: the nucleus renormalizes over the k kept
         out = np.asarray(_filter_logits(self._logits(), top_k=4, top_p=0.99))
         kept = np.nonzero(out[0] > -1e29)[0]
-        assert 1 <= len(kept) <= 4 and 7 in kept
+        assert kept.tolist() == [4, 5, 6, 7]
+
+    def test_top_k_overlarge_and_negative(self):
+        from tpulab.models.generate import _filter_logits
+
+        out = np.asarray(_filter_logits(self._logits(), top_k=300, top_p=1.0))
+        assert np.array_equal(out, np.asarray(self._logits()))  # clamped: all kept
+        with pytest.raises(ValueError, match="top_k"):
+            _filter_logits(self._logits(), top_k=-1, top_p=1.0)
 
     def test_filters_off_are_identity(self):
         from tpulab.models.generate import _filter_logits

@@ -36,49 +36,59 @@ def init_kv_cache(cfg: LabformerConfig, batch: int, max_seq: int):
 
 
 def _attend_cached(q, k_cache, v_cache, pos):
-    """q: (b, 1, h, d); caches (b, S, kv, d); attends keys [0, pos].
+    """q: (b, w, h, d) window at positions pos..pos+w-1; caches
+    (b, S, kv, d).  Window row r attends keys [0, pos+r] — causal within
+    the window and over the cache, so any stale cache KV PAST the
+    window (a rejected speculative draft, a shrunk re-decode) is masked
+    off by construction and never needs rollback.
 
     Grouped: query head i reads cache head ``i // (h // kv)`` (the
     contiguous-group layout labformer._attention's training-side repeat
     uses).  Same numeric recipe as attention_reference (q scaled in
     model dtype BEFORE the matmul, scores/softmax in f32) so cached
     decode matches the full forward."""
-    b, _, h, dh = q.shape
+    b, w, h, dh = q.shape
     kvh = k_cache.shape[2]
     g = h // kvh
     q = q / np.sqrt(dh).astype(q.dtype)
-    qg = q.reshape(b, 1, kvh, g, dh)
+    qg = q.reshape(b, w, kvh, g, dh)
     s = jnp.einsum("bqcgd,bkcd->bcgqk", qg, k_cache).astype(jnp.float32)
-    valid = jnp.arange(k_cache.shape[1])[None, None, None, None, :] <= pos
+    key_pos = jnp.arange(k_cache.shape[1])[None, :]            # (1, S)
+    q_pos = pos + jnp.arange(w)[:, None]                       # (w, 1)
+    valid = (key_pos <= q_pos)[None, None, None, :, :]         # (1,1,1,w,S)
     s = jnp.where(valid, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum("bcgqk,bkcd->bqcgd", p, v_cache.astype(jnp.float32))
-    return o.reshape(b, 1, h, dh).astype(q.dtype)
+    return o.reshape(b, w, h, dh).astype(q.dtype)
 
 
 def _decode_block(x, layer, k_cache, v_cache, pos, cfg: LabformerConfig):
-    """One transformer block for a single-token slice with cache update."""
-    b = x.shape[0]
+    """One transformer block for a (b, w, d) window slice with cache
+    update at positions pos..pos+w-1 (w == 1 is plain decode)."""
+    b, w, _ = x.shape
     h, dh, kvh = cfg.n_heads, cfg.head_dim, cfg.kv_heads
     xn = _rmsnorm(x, layer["ln1"])
-    q = qmat(xn, layer["wq"]).reshape(b, 1, h, dh)
-    k = qmat(xn, layer["wk"]).reshape(b, 1, kvh, dh)
-    v = qmat(xn, layer["wv"]).reshape(b, 1, kvh, dh)
-    positions = jnp.full((1,), pos)
+    q = qmat(xn, layer["wq"]).reshape(b, w, h, dh)
+    k = qmat(xn, layer["wk"]).reshape(b, w, kvh, dh)
+    v = qmat(xn, layer["wv"]).reshape(b, w, kvh, dh)
+    positions = pos + jnp.arange(w)
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
     k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
     v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
     o = _attend_cached(q, k_cache, v_cache, pos)
-    x = x + qmat(o.reshape(b, 1, cfg.d_model), layer["wo"])
+    x = x + qmat(o.reshape(b, w, cfg.d_model), layer["wo"])
     y, _ = _mlp(_rmsnorm(x, layer["ln2"]), layer, cfg)  # aux unused at decode
     x = x + y
     return x, k_cache, v_cache
 
 
-def _forward_step(params, token, k_caches, v_caches, pos, cfg: LabformerConfig):
-    """token (b,) int32 at position ``pos`` -> (logits (b, vocab), caches)."""
-    x = embed_lookup(params["embed"], token, cfg.dtype)[:, None, :]  # (b, 1, d)
+def _forward_window(params, tokens, k_caches, v_caches, pos,
+                    cfg: LabformerConfig):
+    """tokens (b, w) int32 at positions pos.. -> (logits (b, w, vocab),
+    caches).  The speculative verify: one pass scores every window
+    position against the cache + the window's own causal prefix."""
+    x = embed_lookup(params["embed"], tokens, cfg.dtype)  # (b, w, d)
 
     def layer_step(carry, inputs):
         x = carry
@@ -90,8 +100,15 @@ def _forward_step(params, token, k_caches, v_caches, pos, cfg: LabformerConfig):
         layer_step, x, (params["blocks"], k_caches, v_caches)
     )
     x = _rmsnorm(x, params["final_norm"])
-    logits = unembed(x, params["embed"])[:, 0, :]
-    return logits, k_caches, v_caches
+    return unembed(x, params["embed"]), k_caches, v_caches
+
+
+def _forward_step(params, token, k_caches, v_caches, pos, cfg: LabformerConfig):
+    """token (b,) int32 at position ``pos`` -> (logits (b, vocab), caches)."""
+    logits, k_caches, v_caches = _forward_window(
+        params, token[:, None], k_caches, v_caches, pos, cfg
+    )
+    return logits[:, 0, :], k_caches, v_caches
 
 
 def _prefill(params, prompt, cfg: LabformerConfig, cache_len: int):
@@ -148,9 +165,11 @@ def _filter_logits(logits, top_k: int, top_p: float):
     prefix of the probability-sorted vocab whose mass reaches ``top_p``
     (the token that crosses the boundary stays, nucleus-sampling
     convention)."""
+    if top_k < 0:
+        raise ValueError(f"top_k must be >= 0, got {top_k}")
     if top_k:
-        kth = jnp.sort(logits, axis=-1)[..., -top_k][..., None]
-        logits = jnp.where(logits < kth, NEG_INF, logits)
+        kth = jnp.sort(logits, axis=-1)[..., -min(top_k, logits.shape[-1])]
+        logits = jnp.where(logits < kth[..., None], NEG_INF, logits)
     if top_p < 1.0:
         sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
         probs = jax.nn.softmax(sorted_logits.astype(jnp.float32), axis=-1)
@@ -160,8 +179,11 @@ def _filter_logits(logits, top_k: int, top_p: float):
         # the strict > means top_p=0 keeps exactly the top token rather
         # than degenerating to the identity filter
         exceeded = (cum - probs) > jnp.float32(max(float(top_p), 0.0))
-        cutoff = jnp.max(
-            jnp.where(exceeded, jnp.float32(NEG_INF),
+        # threshold on the SMALLEST kept logit (+inf fill over the
+        # masked tail — a max over kept entries would always return the
+        # global top logit and collapse sampling to greedy)
+        cutoff = jnp.min(
+            jnp.where(exceeded, jnp.float32(np.inf),
                       sorted_logits.astype(jnp.float32)),
             axis=-1, keepdims=True,
         )

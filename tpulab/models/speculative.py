@@ -1,0 +1,146 @@
+"""Speculative decoding: a cheap draft model proposes, the target
+verifies k tokens per forward pass.
+
+Greedy (lossless) variant: the emitted stream is IDENTICAL to the
+target model decoding alone — the draft only changes how many target
+forward passes are needed.  Each round:
+
+1. the draft autoregressively proposes ``k`` tokens from the last
+   committed token (its own KV cache, one cheap pass per token);
+2. the target runs ONE windowed cached forward over
+   ``[committed, d_1 .. d_k]`` (k+1 positions) — logits at window row i
+   give the target's next-token choice after prefix ``d_1..d_i``;
+3. the longest prefix where the target agrees is committed, plus one
+   target token (the correction on disagreement, the bonus on full
+   acceptance) — every round commits between 1 and k+1 tokens.
+
+Cache discipline: neither cache is ever rolled back.  Rejected draft
+positions leave stale KV past the committed frontier, and the
+position-masked window attention (generate._attend_cached) never reads
+past a query's own position — the next round simply overwrites.
+
+The natural draft here is the int8-quantized target
+(tpulab.models.quant): same architecture, ~half the weight bytes per
+decode step, no second training run.  Any (params, cfg) pair with the
+same vocab works — e.g. a smaller labformer distilled separately.
+
+Reference frame: the reference suite has no serving tier at all
+(SURVEY.md section 0 — binaries are one-shot stdin/stdout); this is
+framework-tier machinery the TPU rebuild adds, designed around the MXU
+(the verify window turns k memory-bound single-token steps into one
+compute-dense pass).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpulab.models.generate import (
+    _forward_step,
+    _forward_window,
+    _prefill,
+)
+from tpulab.models.labformer import LabformerConfig
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "k"))
+def _draft_propose(params, last_token, k_caches, v_caches, pos, cfg, k: int):
+    """Greedy-decode ``k`` tokens from ``last_token`` at ``pos``.
+
+    Runs k+1 steps (the last output is discarded): each step writes its
+    INPUT token's KV, so the extra step is what lands ``d_k``'s KV at
+    pos+k — without it, a fully-accepted round leaves a silent hole in
+    the draft cache that every later position would attend as zeros."""
+    def one(carry, i):
+        tok, kc, vc = carry
+        logits, kc, vc = _forward_step(params, tok, kc, vc, pos + i, cfg)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        return (nxt, kc, vc), nxt
+
+    (_, k_caches, v_caches), drafts = jax.lax.scan(
+        one, (last_token, k_caches, v_caches), jnp.arange(k + 1)
+    )
+    return drafts.T[:, :k], k_caches, v_caches  # (b, k)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def _target_verify(params, window, k_caches, v_caches, pos, cfg):
+    """window (b, k+1) = [committed, drafts...] at positions pos.. ->
+    (choices (b, k+1), caches): the target's greedy next token after
+    each window prefix."""
+    logits, k_caches, v_caches = _forward_window(
+        params, window, k_caches, v_caches, pos, cfg
+    )
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32), k_caches, v_caches
+
+
+def speculative_generate(
+    draft_params,
+    draft_cfg: LabformerConfig,
+    target_params,
+    target_cfg: LabformerConfig,
+    prompt: np.ndarray,
+    steps: int = 64,
+    k: int = 4,
+) -> Tuple[np.ndarray, float]:
+    """Greedy speculative decode; returns ``(tokens (b, steps),
+    mean_accepted)`` where tokens are bit-identical to the target
+    decoding alone and ``mean_accepted`` is the average number of draft
+    tokens accepted per verify round (0..k — the speedup signal).
+
+    Host-side orchestration stitches two jitted programs (draft scan,
+    target verify window); acceptance is data-dependent, so it lives in
+    numpy between dispatches — the same split real serving stacks use.
+    """
+    if draft_cfg.vocab != target_cfg.vocab:
+        raise ValueError("draft and target must share a vocabulary")
+    prompt = np.asarray(prompt, np.int32)
+    b, p = prompt.shape
+    cache_len = p + steps + k + 2
+    prompt_j = jnp.asarray(prompt)
+
+    # prefill both models over the prompt; the target's prefill logits
+    # give the first committed token
+    t_logits, t_kc, t_vc = _prefill(target_params, prompt_j, target_cfg, cache_len)
+    _, d_kc, d_vc = _prefill(draft_params, prompt_j, draft_cfg, cache_len)
+    committed = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)  # (b,)
+
+    out = [np.asarray(committed)[:, None]]
+    n_out = 1
+    pos = p  # position of `committed` in the sequence
+    accepted_counts = []
+    while n_out < steps:
+        drafts, d_kc, d_vc = _draft_propose(
+            draft_params, committed, d_kc, d_vc, pos, draft_cfg, k
+        )
+        window = jnp.concatenate([committed[:, None], drafts], axis=1)
+        choices, t_kc, t_vc = _target_verify(
+            target_params, window, t_kc, t_vc, pos, target_cfg
+        )
+        drafts_np = np.asarray(drafts)        # (b, k)
+        choices_np = np.asarray(choices)      # (b, k+1)
+        # batch-wide acceptance: the window is shared across the batch,
+        # so commit the longest prefix accepted by EVERY row (per-row
+        # divergence would need per-row positions; batch=1 serving gets
+        # the full per-stream rate)
+        agree = drafts_np == choices_np[:, :k]
+        m = 0
+        while m < k and bool(agree[:, m].all()):
+            m += 1
+        accepted_counts.append(m)
+        # commit d_1..d_m plus the target's token after that prefix
+        emitted = np.concatenate(
+            [drafts_np[:, :m], choices_np[:, m][:, None]], axis=1
+        )
+        out.append(emitted)
+        n_out += m + 1
+        pos += m + 1
+        committed = jnp.asarray(emitted[:, -1])
+    tokens = np.concatenate(out, axis=1)[:, :steps]
+    mean_acc = float(np.mean(accepted_counts)) if accepted_counts else 0.0
+    return tokens, mean_acc

@@ -214,6 +214,75 @@ def bench_labvision_train(b: int = 256, reps: int = 10) -> Dict[str, Any]:
     }
 
 
+def bench_speculative_decode(
+    steps: int = 128, k: int = 4, reps: int = 3
+) -> Dict[str, Any]:
+    """Speculative decode (int8 draft verifying into the fp target) vs
+    the plain KV-cache loop, same model as bench_labformer_decode b=1.
+
+    Reported value is the speculative tokens/s; ``speedup_vs_plain`` and
+    ``mean_accepted`` qualify it.  The model is untrained, so accepted
+    counts reflect int8-vs-fp agreement on a random-init distribution —
+    a LOWER bound on trained-model acceptance (sharper logits agree
+    more)."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpulab.models.generate import generate_jit
+    from tpulab.models.labformer import LabformerConfig, init_params
+    from tpulab.models.quant import quantize_decode_params
+    from tpulab.models.speculative import speculative_generate
+    from tpulab.runtime.device import commit, default_device
+
+    cfg = LabformerConfig(
+        d_model=512, n_heads=8, n_layers=8, d_ff=2048, max_seq=1024,
+        dtype=jnp.bfloat16,
+    )
+    device = default_device()
+    params = jax.device_put(init_params(cfg, seed=0), device)
+    draft = jax.device_put(quantize_decode_params(
+        jax.device_get(params), cfg), device)
+    prompt = np.random.default_rng(0).integers(0, cfg.vocab, (1, 8)).astype(np.int32)
+
+    from tpulab.runtime.timing import measure_ms
+
+    key = jax.random.PRNGKey(0)
+    prompt_dev = commit(prompt, device)
+    # plain decode is one device program: suite-standard measure_ms
+    # (median, warmup, calibrated fetch) keeps it comparable with
+    # bench_labformer_decode
+    plain_ms, _ = measure_ms(
+        lambda p, t: generate_jit(p, t, key, cfg, steps, 0.0),
+        (params, prompt_dev), warmup=2, reps=max(reps, 3),
+    )
+    t_plain = plain_ms / 1e3
+
+    # the speculative loop is host-orchestrated (acceptance runs in
+    # numpy between dispatches), so host round-trips are PART of the
+    # algorithm, not measurement noise: wall-clock median over reps
+    spec = lambda: speculative_generate(draft, cfg, params, cfg, prompt,
+                                        steps=steps, k=k)
+    spec()  # compile draft scan + verify window + prefills
+    times, acc = [], 0.0
+    for _ in range(max(reps, 3)):
+        t0 = time.perf_counter()
+        _, acc = spec()
+        times.append(time.perf_counter() - t0)
+    t_spec = float(np.median(times))
+    return {
+        "metric": f"speculative_decode_b1_{steps}steps_k{k}_int8draft_tokens_per_s",
+        "value": round(steps / t_spec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": None,
+        "plain_tokens_per_s": round(steps / t_plain, 1),
+        "speedup_vs_plain": round(t_plain / t_spec, 3),
+        "mean_accepted": round(acc, 2),
+        "device": device.platform,
+    }
+
+
 def bench_labformer_decode(
     b: int = 8, steps: int = 128, reps: int = 3, dtype: str = "bfloat16",
     int8: bool = False,
@@ -351,6 +420,7 @@ def run_benchmarks(only: Optional[str] = None, **kw) -> List[Dict[str, Any]]:
         "labformer_train": bench_labformer_train,
         "labformer_decode": bench_labformer_decode,
         "labformer_decode_int8": functools.partial(bench_labformer_decode, int8=True),
+        "speculative_decode": bench_speculative_decode,
         "labvision_train": bench_labvision_train,
         "hw2_sort": bench_sort,
         "lab5_reduce": bench_reduce,

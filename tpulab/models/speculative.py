@@ -47,6 +47,10 @@ from tpulab.models.generate import (
 )
 from tpulab.models.labformer import LabformerConfig
 
+# module-level jit: repeated speculative_generate calls hit the compile
+# cache instead of re-tracing both prefill scans eagerly every call
+_prefill_jit = jax.jit(_prefill, static_argnums=(2, 3))
+
 
 @functools.partial(jax.jit, static_argnames=("cfg", "k"))
 def _draft_propose(params, last_token, k_caches, v_caches, pos, cfg, k: int):
@@ -106,8 +110,8 @@ def speculative_generate(
 
     # prefill both models over the prompt; the target's prefill logits
     # give the first committed token
-    t_logits, t_kc, t_vc = _prefill(target_params, prompt_j, target_cfg, cache_len)
-    _, d_kc, d_vc = _prefill(draft_params, prompt_j, draft_cfg, cache_len)
+    t_logits, t_kc, t_vc = _prefill_jit(target_params, prompt_j, target_cfg, cache_len)
+    _, d_kc, d_vc = _prefill_jit(draft_params, prompt_j, draft_cfg, cache_len)
     committed = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)  # (b,)
 
     out = [np.asarray(committed)[:, None]]

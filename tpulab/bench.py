@@ -285,13 +285,15 @@ def bench_speculative_decode(
 
 def bench_labformer_decode(
     b: int = 8, steps: int = 128, reps: int = 3, dtype: str = "bfloat16",
-    int8: bool = False,
+    int8: bool = False, kv_heads: int = 0,
 ) -> Dict[str, Any]:
     """KV-cache autoregressive decode: tokens/s (whole loop is one jit).
 
     ``int8=True`` runs the weight-only quantized path (models/quant.py)
     — decode is HBM-bound on weight reads, so int8 targets ~the weight
-    fraction of step traffic."""
+    fraction of step traffic.  ``kv_heads`` enables grouped-query
+    attention: the KV cache (the other big decode traffic term) shrinks
+    by n_heads/kv_heads."""
     import jax
     import jax.numpy as jnp
 
@@ -306,6 +308,7 @@ def bench_labformer_decode(
         n_layers=8,
         d_ff=2048,
         max_seq=1024,
+        n_kv_heads=kv_heads,
         dtype={"bfloat16": jnp.bfloat16, "float32": jnp.float32}[dtype],
     )
     device = default_device()
@@ -321,7 +324,7 @@ def bench_labformer_decode(
     key = jax.random.PRNGKey(0)
     fn = lambda p, t: generate_jit(p, t, key, cfg, steps, 1.0)
     ms, _ = measure_ms(fn, (params, prompt), warmup=2, reps=reps)
-    tag = "_int8" if int8 else ""
+    tag = ("_int8" if int8 else "") + (f"_gqa{kv_heads}" if kv_heads else "")
     return {
         "metric": f"labformer_decode_b{b}_{steps}steps_{dtype}{tag}_tokens_per_s",
         "value": round(b * steps / (ms / 1e3), 1),
@@ -420,6 +423,7 @@ def run_benchmarks(only: Optional[str] = None, **kw) -> List[Dict[str, Any]]:
         "labformer_train": bench_labformer_train,
         "labformer_decode": bench_labformer_decode,
         "labformer_decode_int8": functools.partial(bench_labformer_decode, int8=True),
+        "labformer_decode_gqa2": functools.partial(bench_labformer_decode, kv_heads=2),
         "speculative_decode": bench_speculative_decode,
         "labvision_train": bench_labvision_train,
         "hw2_sort": bench_sort,

@@ -11,6 +11,7 @@ keeps every step's shapes static (SURVEY-mandated jit discipline).
 from __future__ import annotations
 
 import functools
+import sys
 from typing import Optional, Tuple
 
 import jax
@@ -272,6 +273,12 @@ def main(argv=None) -> int:
                     help="nucleus sampling probability mass (1.0 = off)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--ckpt-dir", default=None)
+    ap.add_argument("--speculative", action="store_true",
+                    help="greedy speculative decode with the int8-"
+                         "quantized model as draft (lossless: same "
+                         "tokens as plain greedy)")
+    ap.add_argument("--draft-k", type=int, default=4,
+                    help="draft tokens proposed per verify round")
     args = ap.parse_args(argv)
 
     cfg = LabformerConfig(d_model=128, n_heads=8, n_layers=4, d_ff=512, max_seq=1024)
@@ -304,8 +311,27 @@ def main(argv=None) -> int:
         print(f"[generate] loaded checkpoint step {step}")
 
     prompt = np.frombuffer(args.prompt.encode("utf-8"), np.uint8)[None, :].astype(np.int32)
-    out = generate(params, prompt, cfg, steps=args.steps, temperature=args.temperature,
-                   seed=args.seed, top_k=args.top_k, top_p=args.top_p)
+    if args.speculative:
+        # greedy-only: refuse explicitly-requested sampling rather than
+        # silently dropping it (and silently flipping the run's mode)
+        if args.temperature != 1.0 or args.top_k or args.top_p != 1.0:
+            raise SystemExit(
+                "--speculative decodes greedily (lossless vs the target's "
+                "greedy stream); drop --temperature/--top-k/--top-p"
+            )
+        from tpulab.models.quant import quantize_decode_params
+        from tpulab.models.speculative import speculative_generate
+
+        draft = quantize_decode_params(params, cfg)
+        out, acc = speculative_generate(
+            draft, cfg, params, cfg, prompt, steps=args.steps, k=args.draft_k
+        )
+        print(f"[speculative] mean accepted {acc:.2f}/{args.draft_k} per round",
+              file=sys.stderr)
+    else:
+        out = generate(params, prompt, cfg, steps=args.steps,
+                       temperature=args.temperature, seed=args.seed,
+                       top_k=args.top_k, top_p=args.top_p)
     text = bytes(int(t) & 0xFF for t in out[0]).decode("utf-8", errors="replace")
     print(args.prompt + text)
     return 0

@@ -126,8 +126,10 @@ def speculative_generate(
         choices, t_kc, t_vc = _target_verify(
             target_params, window, t_kc, t_vc, pos, target_cfg
         )
-        drafts_np = np.asarray(drafts)        # (b, k)
-        choices_np = np.asarray(choices)      # (b, k+1)
+        # ONE coalesced fetch: on the tunneled TPU each blocking
+        # transfer pays the full host round-trip, and the per-round
+        # fetch is the loop's latency floor
+        drafts_np, choices_np = jax.device_get((drafts, choices))
         # batch-wide acceptance: the window is shared across the batch,
         # so commit the longest prefix accepted by EVERY row (per-row
         # divergence would need per-row positions; batch=1 serving gets

@@ -313,8 +313,8 @@ def main(argv=None) -> int:
     prompt = np.frombuffer(args.prompt.encode("utf-8"), np.uint8)[None, :].astype(np.int32)
     if args.speculative:
         # greedy-only: refuse explicitly-requested sampling rather than
-        # silently dropping it (and silently flipping the run's mode)
-        if args.temperature != 1.0 or args.top_k or args.top_p != 1.0:
+        # silently dropping it (temperature 0 IS greedy — honor it)
+        if args.temperature not in (0.0, 1.0) or args.top_k or args.top_p != 1.0:
             raise SystemExit(
                 "--speculative decodes greedily (lossless vs the target's "
                 "greedy stream); drop --temperature/--top-k/--top-p"

@@ -1,0 +1,300 @@
+"""Paged KV cache + continuous-batching engine.
+
+The rectangular KV cache (``generate.init_kv_cache``) reserves
+``batch * max_seq`` slots even when most requests are short — the
+serving-memory waste paged attention exists to fix.  Here the cache is
+a POOL of fixed-size blocks shared by every request slot:
+
+* pools ``(L, P, BS, kv, d)`` for K and V (P physical blocks of BS
+  positions each);
+* per-slot block tables ``(S, M)`` int32 mapping logical block j of
+  slot s to a physical block (M = max_seq // BS);
+* a host-side free-list hands blocks out at admission and reclaims
+  them the moment a request finishes.
+
+Physical block 0 is reserved as the TRASH block: writes that must not
+land anywhere (prefill padding, inactive slots) are routed there, so
+every scatter keeps a static shape under jit.  Reads are position-
+masked (key index < length), so trash/stale contents are never
+attended — the same no-rollback invariant as generate._attend_cached.
+
+Two compiled programs serve any workload: ONE fixed-shape batched
+decode step over all S slots, and one prefill-scatter per prompt-length
+bucket (dense prefill reuses generate._prefill on ``prompt[:-1]``, a
+static scatter moves its K/V into the pool, and the first engine step
+consumes the held-back last prompt token through the normal decode
+path — no per-length logits plumbing).
+
+Reference frame: the reference has no serving tier at all (SURVEY.md
+section 0); this is TPU-first serving infrastructure in the spirit of
+vLLM's PagedAttention, built on XLA gathers instead of custom CUDA.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpulab.models.generate import _prefill
+from tpulab.models.labformer import LabformerConfig, _mlp, _rmsnorm
+from tpulab.models.quant import embed_lookup, qmat, unembed
+from tpulab.parallel.ring import NEG_INF
+
+TRASH = 0  # physical block 0 swallows must-not-land writes
+
+
+def init_pools(cfg: LabformerConfig, n_blocks: int, block_size: int):
+    """K/V pools (L, P, BS, kv, d); block 0 is the trash block."""
+    shape = (cfg.n_layers, n_blocks, block_size, cfg.kv_heads, cfg.head_dim)
+    return jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype)
+
+
+def _rope_at(x, pos, theta: float):
+    """labformer._rope for one token per slot: x (S, 1, heads, d),
+    pos (S,) — identical freqs/halving so paged decode matches the
+    dense path bit-for-bit."""
+    d = x.shape[-1]
+    half = d // 2
+    freqs = (theta ** (-np.arange(0, half) / half)).astype(np.float32)
+    ang = pos[:, None].astype(jnp.float32) * freqs[None, :]     # (S, half)
+    cos = jnp.cos(ang)[:, None, None, :].astype(x.dtype)
+    sin = jnp.sin(ang)[:, None, None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+
+
+def _paged_attend(q, kpool_l, vpool_l, tables, lengths, block_size: int):
+    """q (S, 1, h, d); pools (P, BS, kv, d); tables (S, M); lengths (S,)
+    = number of valid logical positions.  Gathers each slot's logical
+    key space (M*BS positions) and masks to [0, length).  Grouped heads
+    as in generate._attend_cached."""
+    S, _, h, dh = q.shape
+    kvh = kpool_l.shape[2]
+    g = h // kvh
+    M = tables.shape[1]
+    k = kpool_l[tables].reshape(S, M * block_size, kvh, dh)
+    v = vpool_l[tables].reshape(S, M * block_size, kvh, dh)
+    q = q / np.sqrt(dh).astype(q.dtype)
+    qg = q.reshape(S, 1, kvh, g, dh)
+    s = jnp.einsum("bqcgd,bkcd->bcgqk", qg, k).astype(jnp.float32)
+    valid = jnp.arange(M * block_size)[None, :] < lengths[:, None]
+    s = jnp.where(valid[:, None, None, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    o = jnp.einsum("bcgqk,bkcd->bqcgd", p, v.astype(jnp.float32))
+    return o.reshape(S, 1, h, dh).astype(q.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "block_size"))
+def paged_decode_step(params, tokens, kpool, vpool, tables, lengths,
+                      cfg: LabformerConfig, block_size: int):
+    """One batched decode step for every slot.
+
+    tokens (S,) sit at logical positions ``lengths`` (the next free
+    position per slot); each layer writes the new K/V through the block
+    table and attends [0, lengths] inclusive.  Inactive slots must
+    point their table at TRASH.  Returns (logits (S, vocab), pools)."""
+    S = tokens.shape[0]
+    h, dh, kvh = cfg.n_heads, cfg.head_dim, cfg.kv_heads
+    x = embed_lookup(params["embed"], tokens, cfg.dtype)[:, None, :]
+
+    pos = lengths
+    blk = jnp.take_along_axis(
+        tables, (pos // block_size)[:, None].astype(jnp.int32), axis=1
+    )[:, 0]
+    off = (pos % block_size).astype(jnp.int32)
+
+    def layer_step(carry, inputs):
+        x = carry
+        layer, kpool_l, vpool_l = inputs
+        xn = _rmsnorm(x, layer["ln1"])
+        q = qmat(xn, layer["wq"]).reshape(S, 1, h, dh)
+        k = qmat(xn, layer["wk"]).reshape(S, 1, kvh, dh)
+        v = qmat(xn, layer["wv"]).reshape(S, 1, kvh, dh)
+        q = _rope_at(q, pos, cfg.rope_theta)
+        k = _rope_at(k, pos, cfg.rope_theta)
+        kpool_l = kpool_l.at[blk, off].set(k[:, 0])
+        vpool_l = vpool_l.at[blk, off].set(v[:, 0])
+        o = _paged_attend(q, kpool_l, vpool_l, tables, lengths + 1,
+                          block_size)
+        x = x + qmat(o.reshape(S, 1, cfg.d_model), layer["wo"])
+        y, _ = _mlp(_rmsnorm(x, layer["ln2"]), layer, cfg)
+        return x + y, (kpool_l, vpool_l)
+
+    x, (kpool, vpool) = jax.lax.scan(
+        layer_step, x, (params["blocks"], kpool, vpool)
+    )
+    x = _rmsnorm(x, params["final_norm"])
+    logits = unembed(x, params["embed"])[:, 0, :]
+    return logits, kpool, vpool
+
+
+@functools.partial(jax.jit, static_argnames=("bucket", "block_size"))
+def _scatter_prefill(kpool, vpool, k_seq, v_seq, table_row, p,
+                     bucket: int, block_size: int):
+    """Move dense prefill K/V (L, bucket, kv, d) into the pool along one
+    slot's block table; positions >= p route to the TRASH block (static
+    scatter shape — p is dynamic, bucket/block_size are compile keys)."""
+    j = jnp.arange(bucket)
+    blk = jnp.where(j < p, table_row[j // block_size], TRASH)
+    off = (j % block_size).astype(jnp.int32)
+
+    def one_layer(carry, seqs):
+        # pools stay whole in the carry (the scan axis is the SEQS'
+        # layer dim); the running layer index routes each K/V sheet
+        # into its own pool slice
+        kpool, vpool, i = carry
+        k_l, v_l = seqs
+        kpool = kpool.at[i, blk, off].set(k_l)
+        vpool = vpool.at[i, blk, off].set(v_l)
+        return (kpool, vpool, i + 1), None
+
+    (kpool, vpool, _), _ = jax.lax.scan(
+        one_layer, (kpool, vpool, jnp.int32(0)), (k_seq, v_seq)
+    )
+    return kpool, vpool
+
+
+def _bucket(n: int) -> int:
+    b = 16
+    while b < n:
+        b *= 2
+    return b
+
+
+@dataclass
+class _Request:
+    req_id: int
+    prompt: np.ndarray          # (p,) int32
+    max_new: int
+    out: List[int] = field(default_factory=list)
+
+
+class PagedEngine:
+    """Continuous-batching greedy decode over a paged KV pool.
+
+    ``slots`` concurrent sequences share ``n_blocks`` physical blocks
+    of ``block_size`` positions.  ``submit`` queues a request;
+    ``step()`` admits queued requests into free slots (when enough
+    blocks are free) and advances every active slot one token;
+    ``run()`` drains everything and returns {req_id: generated
+    tokens}.  Greedy decode; outputs match ``generate`` greedy
+    per-request."""
+
+    def __init__(self, params, cfg: LabformerConfig, *, slots: int = 4,
+                 n_blocks: int = 64, block_size: int = 16,
+                 max_seq: int = 256):
+        if max_seq % block_size:
+            raise ValueError("max_seq must be a multiple of block_size")
+        self.params = params
+        self.cfg = cfg
+        self.slots = slots
+        self.block_size = block_size
+        self.max_blocks = max_seq // block_size
+        self.kpool, self.vpool = init_pools(cfg, n_blocks, block_size)
+        self.n_usable_blocks = n_blocks - 1
+        self.free = list(range(1, n_blocks))  # block 0 is TRASH
+        self.tables = np.zeros((slots, self.max_blocks), np.int32)
+        self.lengths = np.zeros(slots, np.int32)
+        self.last_tok = np.zeros(slots, np.int32)
+        self.active: List[Optional[_Request]] = [None] * slots
+        self.pending: List[_Request] = []
+        self._done: Dict[int, np.ndarray] = {}
+        self._next_id = 0
+
+    # ------------------------------------------------------------- admission
+    def submit(self, prompt, max_new: int) -> int:
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if len(prompt) == 0:
+            raise ValueError("empty prompt")
+        need = self._blocks_needed(len(prompt) + max_new)
+        if need > min(self.max_blocks, self.n_usable_blocks):
+            raise ValueError(
+                f"request needs {need} blocks > capacity "
+                f"(max_seq {self.max_blocks}, pool {self.n_usable_blocks})"
+            )
+        rid = self._next_id
+        self._next_id += 1
+        self.pending.append(_Request(rid, prompt, max_new))
+        return rid
+
+    def _blocks_needed(self, n_positions: int) -> int:
+        return -(-n_positions // self.block_size)
+
+    def _admit(self):
+        for s in range(self.slots):
+            if self.active[s] is not None or not self.pending:
+                continue
+            req = self.pending[0]
+            need = self._blocks_needed(len(req.prompt) + req.max_new)
+            if need > len(self.free):
+                break  # FIFO: wait for releases rather than starve
+            self.pending.pop(0)
+            blocks = [self.free.pop() for _ in range(need)]
+            row = np.zeros(self.max_blocks, np.int32)
+            row[:need] = blocks
+            self.tables[s] = row
+            self._prefill_slot(s, req, row)
+            self.active[s] = req
+
+    def _prefill_slot(self, s: int, req: _Request, row: np.ndarray):
+        """Scatter KV for prompt[:-1]; hold the last prompt token back
+        so the first engine step produces the first generated token
+        through the one shared decode program."""
+        p = len(req.prompt) - 1
+        if p > 0:
+            bucket = _bucket(p)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :p] = req.prompt[:-1]
+            _, kc, vc = _prefill(
+                self.params, jnp.asarray(padded), self.cfg, bucket
+            )
+            self.kpool, self.vpool = _scatter_prefill(
+                self.kpool, self.vpool, kc[:, 0], vc[:, 0],
+                jnp.asarray(row), p, bucket, self.block_size,
+            )
+        self.lengths[s] = p
+        self.last_tok[s] = req.prompt[-1]
+
+    # ---------------------------------------------------------------- decode
+    def step(self) -> List[int]:
+        """One engine tick; returns req_ids finished this tick."""
+        self._admit()
+        if not any(r is not None for r in self.active):
+            return []
+        logits, self.kpool, self.vpool = paged_decode_step(
+            self.params, jnp.asarray(self.last_tok), self.kpool, self.vpool,
+            jnp.asarray(self.tables), jnp.asarray(self.lengths),
+            self.cfg, self.block_size,
+        )
+        nxt = np.asarray(jnp.argmax(logits, -1).astype(jnp.int32))
+        finished = []
+        for s, req in enumerate(self.active):
+            if req is None:
+                continue
+            req.out.append(int(nxt[s]))
+            self.lengths[s] += 1
+            self.last_tok[s] = nxt[s]
+            if len(req.out) >= req.max_new:
+                used = self._blocks_needed(len(req.prompt) + req.max_new)
+                self.free.extend(int(b) for b in self.tables[s, :used])
+                self.tables[s] = TRASH
+                self.lengths[s] = 0
+                self.active[s] = None
+                self._done[req.req_id] = np.asarray(req.out, np.int32)
+                finished.append(req.req_id)
+        return finished
+
+    def run(self) -> Dict[int, np.ndarray]:
+        """Drain queue + active slots; {req_id: generated tokens}."""
+        guard = 0
+        while self.pending or any(r is not None for r in self.active):
+            self.step()
+            guard += 1
+            if guard > 100_000:
+                raise RuntimeError("engine did not converge")
+        return dict(self._done)

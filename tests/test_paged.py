@@ -86,7 +86,7 @@ def test_oversized_request_rejected(trained):
         eng.submit(_cycle_prompt(20), max_new=20)
 
 
-def test_gqa_engine(trained):
+def test_gqa_engine():
     """The paged path honors grouped K/V (narrow pools)."""
     cfg = LabformerConfig(
         d_model=32, n_heads=4, n_kv_heads=2, n_layers=2, d_ff=64, max_seq=128
@@ -116,3 +116,14 @@ def test_single_token_prompt(trained):
     want = generate(trained, _cycle_prompt(1)[None, :], CFG, steps=4,
                     temperature=0.0)[0]
     assert np.array_equal(out[rid], want)
+
+
+def test_engine_reusable_across_runs(trained):
+    """A second run() returns only the second wave's results."""
+    eng = PagedEngine(trained, CFG, slots=1, n_blocks=8, block_size=8,
+                      max_seq=32)
+    a = eng.submit(_cycle_prompt(3), max_new=3)
+    first = eng.run()
+    b = eng.submit(_cycle_prompt(4), max_new=3)
+    second = eng.run()
+    assert set(first) == {a} and set(second) == {b}

@@ -215,7 +215,8 @@ class PagedEngine:
         if need > min(self.max_blocks, self.n_usable_blocks):
             raise ValueError(
                 f"request needs {need} blocks > capacity "
-                f"(max_seq {self.max_blocks}, pool {self.n_usable_blocks})"
+                f"({self.max_blocks} blocks/slot, pool "
+                f"{self.n_usable_blocks} blocks)"
             )
         rid = self._next_id
         self._next_id += 1
@@ -290,11 +291,15 @@ class PagedEngine:
         return finished
 
     def run(self) -> Dict[int, np.ndarray]:
-        """Drain queue + active slots; {req_id: generated tokens}."""
+        """Drain queue + active slots; {req_id: generated tokens} for
+        the requests completed by THIS call (earlier runs' results are
+        consumed by their own return — a long-lived engine doesn't
+        accumulate them)."""
         guard = 0
         while self.pending or any(r is not None for r in self.active):
             self.step()
             guard += 1
             if guard > 100_000:
                 raise RuntimeError("engine did not converge")
-        return dict(self._done)
+        done, self._done = self._done, {}
+        return done

@@ -127,3 +127,83 @@ def test_engine_reusable_across_runs(trained):
     b = eng.submit(_cycle_prompt(4), max_new=3)
     second = eng.run()
     assert set(first) == {a} and set(second) == {b}
+
+
+class TestPrefixSharing:
+    def _sys_prompt(self, tail):
+        # 17-token "system prompt" (2 full blocks at BS=8) + unique tail
+        return np.concatenate(
+            [(np.arange(17) % 7).astype(np.int32),
+             np.asarray(tail, np.int32)]
+        )
+
+    def test_concurrent_requests_share_prefix_blocks(self, trained):
+        eng = PagedEngine(trained, CFG, slots=2, n_blocks=32, block_size=8,
+                          max_seq=64)
+        a = eng.submit(self._sys_prompt([1, 2]), max_new=5)
+        b = eng.submit(self._sys_prompt([3, 4]), max_new=5)
+        eng._admit()
+        # both slots' first two blocks (the full shared region) are the
+        # SAME physical blocks, refcounted
+        assert np.array_equal(eng.tables[0][:2], eng.tables[1][:2])
+        shared = [int(x) for x in eng.tables[0][:2]]
+        assert all(eng.block_refs[x] >= 2 for x in shared)
+        out = eng.run()
+        for rid, tail in ((a, [1, 2]), (b, [3, 4])):
+            want = generate(trained, self._sys_prompt(tail)[None, :], CFG,
+                            steps=5, temperature=0.0)[0]
+            assert np.array_equal(out[rid], want), rid
+
+    def test_prefix_survives_across_waves(self, trained):
+        eng = PagedEngine(trained, CFG, slots=1, n_blocks=16, block_size=8,
+                          max_seq=64)
+        eng.submit(self._sys_prompt([1]), max_new=3)
+        eng.run()
+        cached = list(eng.prefix_cache.values())[0]
+        rid = eng.submit(self._sys_prompt([5]), max_new=4)
+        eng._admit()
+        assert [int(x) for x in eng.tables[0][:2]] == cached
+        out = eng.run()
+        want = generate(trained, self._sys_prompt([5])[None, :], CFG,
+                        steps=4, temperature=0.0)[0]
+        assert np.array_equal(out[rid], want)
+
+    def test_eviction_under_pressure_stays_correct(self, trained):
+        # pool of 3 usable blocks == exactly one request's need, so
+        # EVERY admission after the first must evict the previous
+        # request's cached prefix — the eviction-during-admission path
+        # (incl. pinning a matched entry against its own eviction: the
+        # repeated prompt 0 re-admits while its entry is eviction bait)
+        eng = PagedEngine(trained, CFG, slots=1, n_blocks=4, block_size=8,
+                          max_seq=64)
+        evictions = 0
+        orig = eng._evict_prefixes
+
+        def counting(want_free):
+            nonlocal evictions
+            evictions += 1
+            return orig(want_free)
+
+        eng._evict_prefixes = counting
+        reqs = {}
+        for seed in (0, 1, 2, 0):
+            prompt = ((np.arange(12) * (seed + 1)) % 7).astype(np.int32)
+            rid = eng.submit(prompt, max_new=4)
+            reqs[rid] = prompt
+        out = eng.run()
+        assert evictions > 0, "pool pressure never triggered eviction"
+        for rid, prompt in reqs.items():
+            want = generate(trained, prompt[None, :], CFG, steps=4,
+                            temperature=0.0)[0]
+            assert np.array_equal(out[rid], want), rid
+
+    def test_refcounts_balance(self, trained):
+        eng = PagedEngine(trained, CFG, slots=2, n_blocks=32, block_size=8,
+                          max_seq=64)
+        for tail in ([1], [2], [3]):
+            eng.submit(self._sys_prompt(tail), max_new=3)
+        eng.run()
+        # only the cache's own refs remain; evicting everything frees all
+        eng._evict_prefixes(want_free=eng.n_usable_blocks)
+        assert sorted(eng.free) == list(range(1, 32))
+        assert int(eng.block_refs.sum()) == 0

@@ -25,6 +25,16 @@ static scatter moves its K/V into the pool, and the first engine step
 consumes the held-back last prompt token through the normal decode
 path — no per-length logits plumbing).
 
+Prefix sharing: block-aligned prompt prefixes are cached (LRU, evicted
+under pool pressure) and their physical blocks reference-counted —
+requests repeating a system prompt share its KV blocks instead of
+duplicating them.  Causal KV depends only on the token prefix, so a
+cached block is valid for any prompt extending it, and decode writes
+land strictly past every full shared block (read-only by construction).
+Sharing currently dedups MEMORY; the prefill still recomputes the
+shared region's K/V (skipping that compute needs a paged windowed
+forward — future work).
+
 Reference frame: the reference has no serving tier at all (SURVEY.md
 section 0); this is TPU-first serving infrastructure in the spirit of
 vLLM's PagedAttention, built on XLA gathers instead of custom CUDA.
@@ -33,6 +43,7 @@ vLLM's PagedAttention, built on XLA gathers instead of custom CUDA.
 from __future__ import annotations
 
 import functools
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -134,13 +145,15 @@ def paged_decode_step(params, tokens, kpool, vpool, tables, lengths,
 
 
 @functools.partial(jax.jit, static_argnames=("bucket", "block_size"))
-def _scatter_prefill(kpool, vpool, k_seq, v_seq, table_row, p,
+def _scatter_prefill(kpool, vpool, k_seq, v_seq, table_row, start, p,
                      bucket: int, block_size: int):
     """Move dense prefill K/V (L, bucket, kv, d) into the pool along one
-    slot's block table; positions >= p route to the TRASH block (static
-    scatter shape — p is dynamic, bucket/block_size are compile keys)."""
+    slot's block table; positions outside [start, p) route to the TRASH
+    block — below ``start`` they already live in SHARED prefix blocks
+    that must not be rewritten, at/above ``p`` they are padding.  Static
+    scatter shape: start/p are dynamic, bucket/block_size compile keys."""
     j = jnp.arange(bucket)
-    blk = jnp.where(j < p, table_row[j // block_size], TRASH)
+    blk = jnp.where((j >= start) & (j < p), table_row[j // block_size], TRASH)
     off = (j % block_size).astype(jnp.int32)
 
     def one_layer(carry, seqs):
@@ -205,6 +218,16 @@ class PagedEngine:
         self.pending: List[_Request] = []
         self._done: Dict[int, np.ndarray] = {}
         self._next_id = 0
+        # prefix sharing: block-aligned prompt prefixes are cached and
+        # their physical blocks reference-counted — concurrent or
+        # repeated requests with a common prefix (system prompts) share
+        # KV memory instead of duplicating it.  KV at position i depends
+        # only on tokens [0, i], so blocks keyed by the token prefix are
+        # valid for ANY prompt extending it; decode writes always land
+        # at positions >= len(prompt) - 1, strictly past every full
+        # shared block, so shared blocks are read-only by construction.
+        self.block_refs = np.zeros(n_blocks, np.int64)
+        self.prefix_cache: "OrderedDict[bytes, List[int]]" = OrderedDict()
 
     # ------------------------------------------------------------- admission
     def submit(self, prompt, max_new: int) -> int:
@@ -226,28 +249,86 @@ class PagedEngine:
     def _blocks_needed(self, n_positions: int) -> int:
         return -(-n_positions // self.block_size)
 
+    def _lookup_prefix(self, prompt: np.ndarray):
+        """Longest cached block-aligned prefix of the prefill region
+        (prompt[:-1]); returns (shared_blocks, shared_positions)."""
+        nb_full = (len(prompt) - 1) // self.block_size
+        for j in range(nb_full, 0, -1):
+            key = prompt[: j * self.block_size].tobytes()
+            hit = self.prefix_cache.get(key)
+            if hit is not None:
+                self.prefix_cache.move_to_end(key)  # LRU freshen
+                return list(hit), j * self.block_size
+        return [], 0
+
+    def _evict_prefixes(self, want_free: int):
+        """Drop least-recently-used cached prefixes until ``want_free``
+        blocks are available (entries a live request still references
+        only lose the cache's own ref; blocks free when refs hit 0)."""
+        while len(self.free) < want_free and self.prefix_cache:
+            _, blocks = self.prefix_cache.popitem(last=False)
+            for b in blocks:
+                self._deref(b)
+
+    def _deref(self, block: int):
+        self.block_refs[block] -= 1
+        assert self.block_refs[block] >= 0, "block refcount underflow"
+        if self.block_refs[block] == 0:
+            self.free.append(int(block))
+
     def _admit(self):
         for s in range(self.slots):
             if self.active[s] is not None or not self.pending:
                 continue
             req = self.pending[0]
-            need = self._blocks_needed(len(req.prompt) + req.max_new)
-            if need > len(self.free):
-                break  # FIFO: wait for releases rather than starve
+            shared, shared_pos = self._lookup_prefix(req.prompt)
+            # pin shared blocks NOW: eviction below may drop the very
+            # cache entry we matched, and without our ref its blocks
+            # would land on the free list while also sitting in `shared`
+            for b in shared:
+                self.block_refs[b] += 1
+            need_total = self._blocks_needed(len(req.prompt) + req.max_new)
+            need_new = need_total - len(shared)
+            if need_new > len(self.free):
+                self._evict_prefixes(need_new)
+            if need_new > len(self.free):
+                for b in shared:  # unpin; retry after a release
+                    self._deref(b)
+                break  # FIFO: wait rather than starve the head request
             self.pending.pop(0)
-            blocks = [self.free.pop() for _ in range(need)]
+            fresh = [self.free.pop() for _ in range(need_new)]
+            for b in fresh:
+                self.block_refs[b] += 1
             row = np.zeros(self.max_blocks, np.int32)
-            row[:need] = blocks
+            row[:need_total] = shared + fresh
             self.tables[s] = row
-            self._prefill_slot(s, req, row)
+            self._prefill_slot(s, req, row, shared_pos)
+            self._register_prefix(req.prompt, row)
             self.active[s] = req
 
-    def _prefill_slot(self, s: int, req: _Request, row: np.ndarray):
-        """Scatter KV for prompt[:-1]; hold the last prompt token back
-        so the first engine step produces the first generated token
-        through the one shared decode program."""
+    def _register_prefix(self, prompt: np.ndarray, row: np.ndarray):
+        """Cache this request's full prefill blocks for future sharing
+        (the cache holds its own ref on each block, so they survive the
+        request and are reclaimed only by LRU eviction)."""
+        nb_full = (len(prompt) - 1) // self.block_size
+        if nb_full == 0:
+            return
+        key = prompt[: nb_full * self.block_size].tobytes()
+        if key in self.prefix_cache:
+            return
+        blocks = [int(b) for b in row[:nb_full]]
+        for b in blocks:
+            self.block_refs[b] += 1
+        self.prefix_cache[key] = blocks
+
+    def _prefill_slot(self, s: int, req: _Request, row: np.ndarray,
+                      shared_pos: int = 0):
+        """Scatter KV for prompt[:-1] (positions below ``shared_pos``
+        already live in shared prefix blocks and are skipped); hold the
+        last prompt token back so the first engine step produces the
+        first generated token through the one shared decode program."""
         p = len(req.prompt) - 1
-        if p > 0:
+        if p > shared_pos:
             bucket = _bucket(p)
             padded = np.zeros((1, bucket), np.int32)
             padded[0, :p] = req.prompt[:-1]
@@ -256,7 +337,7 @@ class PagedEngine:
             )
             self.kpool, self.vpool = _scatter_prefill(
                 self.kpool, self.vpool, kc[:, 0], vc[:, 0],
-                jnp.asarray(row), p, bucket, self.block_size,
+                jnp.asarray(row), shared_pos, p, bucket, self.block_size,
             )
         self.lengths[s] = p
         self.last_tok[s] = req.prompt[-1]
@@ -282,7 +363,8 @@ class PagedEngine:
             self.last_tok[s] = nxt[s]
             if len(req.out) >= req.max_new:
                 used = self._blocks_needed(len(req.prompt) + req.max_new)
-                self.free.extend(int(b) for b in self.tables[s, :used])
+                for b in self.tables[s, :used]:
+                    self._deref(int(b))
                 self.tables[s] = TRASH
                 self.lengths[s] = 0
                 self.active[s] = None

@@ -94,7 +94,9 @@ def daemon(tmp_path_factory, built_native):
     proc.wait(timeout=10)
 
 
-def _raw_request(sock_path, header: bytes, payload: bytes):
+def _raw_request_bytes(sock_path, header: bytes, payload: bytes):
+    """_raw_request without the utf-8 decode: generate responses are raw
+    byte-LM tokens, not text."""
     s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
     s.connect(sock_path)
     s.sendall(struct.pack("<I", len(header)) + header)
@@ -105,6 +107,11 @@ def _raw_request(sock_path, header: bytes, payload: bytes):
     while len(out) < n:
         out += s.recv(n - len(out))
     s.close()
+    return status, out
+
+
+def _raw_request(sock_path, header: bytes, payload: bytes):
+    status, out = _raw_request_bytes(sock_path, header, payload)
     return status, out.decode()
 
 
@@ -224,3 +231,45 @@ class TestHarnessDrivesClient:
         )
         assert r.returncode == 0, r.stdout + r.stderr
         assert (art / "stats_tpulab_client.csv").exists(), list(art.iterdir())
+
+
+class TestDaemonGenerate:
+    """The `generate` pseudo-lab: warm byte-LM serving over the socket."""
+
+    def test_generate_over_socket_matches_local_engine(self, daemon):
+        status, out = _raw_request_bytes(
+            daemon, b'{"lab": "generate", "config": {"steps": 6}}', b"hello"
+        )
+        assert status == 0 and len(out) == 6
+        # same code path locally (demo config, seed-0 params, PagedEngine)
+        # must produce the identical byte stream
+        import numpy as np
+
+        from tpulab.models.generate import demo_config, load_params
+        from tpulab.models.paged import PagedEngine
+
+        cfg = demo_config()
+        params, _ = load_params(cfg, None)
+        eng = PagedEngine(params, cfg, slots=4, n_blocks=128, block_size=16,
+                          max_seq=512)
+        rid = eng.submit(
+            np.frombuffer(b"hello", np.uint8).astype(np.int32), max_new=6
+        )
+        want = bytes(int(t) & 0xFF for t in eng.run()[rid])
+        assert out == want
+
+    def test_generate_is_deterministic_and_warm(self, daemon):
+        h = b'{"lab": "generate", "config": {"steps": 5}}'
+        s1, out1 = _raw_request_bytes(daemon, h, b"abcabc")
+        t0 = time.perf_counter()
+        s2, out2 = _raw_request_bytes(daemon, h, b"abcabc")
+        warm = time.perf_counter() - t0
+        assert s1 == 0 and s2 == 0 and out1 == out2 and len(out1) == 5
+        # a repeated request rides the cached engine + jit programs: it
+        # must come back in interactive time (cold compile is tens of s;
+        # a generous bound keeps this robust to CI noise)
+        assert warm < 5.0
+
+    def test_generate_empty_prompt_rejected(self, daemon):
+        status, out = _raw_request(daemon, b'{"lab": "generate"}', b"")
+        assert status == 1 and "empty prompt" in out

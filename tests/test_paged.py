@@ -171,9 +171,9 @@ class TestPrefixSharing:
     def test_eviction_under_pressure_stays_correct(self, trained):
         # pool of 3 usable blocks == exactly one request's need, so
         # EVERY admission after the first must evict the previous
-        # request's cached prefix — the eviction-during-admission path
-        # (incl. pinning a matched entry against its own eviction: the
-        # repeated prompt 0 re-admits while its entry is eviction bait)
+        # request's cached prefix (the pin-against-own-eviction guard is
+        # unit-tested directly in test_pin_protects_matched_entry —
+        # stop-early eviction makes it unreachable from this sequence)
         eng = PagedEngine(trained, CFG, slots=1, n_blocks=4, block_size=8,
                           max_seq=64)
         evictions = 0
@@ -206,4 +206,26 @@ class TestPrefixSharing:
         # only the cache's own refs remain; evicting everything frees all
         eng._evict_prefixes(want_free=eng.n_usable_blocks)
         assert sorted(eng.free) == list(range(1, 32))
+        assert int(eng.block_refs.sum()) == 0
+
+    def test_pin_protects_matched_entry(self, trained):
+        """The invariant _admit's pin provides: blocks of a matched
+        prefix entry must NOT reach the free list while pinned, even if
+        the entry itself is evicted (otherwise they could be handed out
+        as fresh blocks while still referenced by the admitting
+        request's `shared` list)."""
+        eng = PagedEngine(trained, CFG, slots=1, n_blocks=16, block_size=8,
+                          max_seq=64)
+        eng.submit(self._sys_prompt([1]), max_new=3)
+        eng.run()
+        shared, pos = eng._lookup_prefix(self._sys_prompt([9]))
+        assert pos == 16 and len(shared) == 2
+        for b in shared:            # _admit's pin phase
+            eng.block_refs[b] += 1
+        eng._evict_prefixes(want_free=eng.n_usable_blocks)  # drop everything
+        assert not eng.prefix_cache
+        assert all(b not in eng.free for b in shared)       # pin held
+        for b in shared:            # _admit's unpin (break path)
+            eng._deref(b)
+        assert all(b in eng.free for b in shared)           # now released
         assert int(eng.block_refs.sum()) == 0

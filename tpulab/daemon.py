@@ -43,7 +43,50 @@ def _recv_exact(conn: socket.socket, n: int) -> bytes:
     return buf
 
 
+_ENGINES: dict = {}
+
+
+def _handle_generate(header: dict, payload: bytes) -> bytes:
+    """``generate`` pseudo-lab: payload = UTF-8 prompt bytes (the byte
+    LM's tokens), response = generated continuation bytes.
+
+    The daemon is the natural serving surface: the model and its
+    PagedEngine stay warm across requests, so repeated system prompts
+    hit the engine's refcounted prefix cache and every request after
+    the first skips compilation entirely.  Config keys: ``steps``
+    (default 64), ``ckpt_dir`` (trainer snapshot; default random demo
+    weights).  Greedy decode (byte-stream reproducible)."""
+    import numpy as np
+
+    config = header.get("config") or {}
+    steps = int(config.get("steps", 64))
+    if steps < 1:
+        raise ValueError(f"steps must be >= 1, got {steps}")
+    if not payload:
+        # reject before paying model/engine construction on a cold cache
+        raise ValueError("empty prompt")
+    ckpt = config.get("ckpt_dir")
+    key = ckpt or "__random__"
+    if key not in _ENGINES:
+        from tpulab.models.generate import demo_config, load_params
+        from tpulab.models.paged import PagedEngine
+
+        cfg = demo_config()
+        params, _ = load_params(cfg, ckpt)
+        _ENGINES[key] = PagedEngine(
+            params, cfg, slots=4, n_blocks=128, block_size=16, max_seq=512
+        )
+    engine = _ENGINES[key]
+    prompt = np.frombuffer(payload, np.uint8).astype(np.int32)
+    rid = engine.submit(prompt, max_new=steps)
+    out = engine.run()[rid]
+    return bytes(int(t) & 0xFF for t in out)
+
+
 def handle_request(header: dict, payload: bytes) -> bytes:
+    if header.get("lab") == "generate":
+        return _handle_generate(header, payload)
+
     from tpulab.labs import get_workload
 
     mod = get_workload(header["lab"])

@@ -258,6 +258,45 @@ def generate(
     return np.asarray(jax.device_get(out))
 
 
+def demo_config() -> LabformerConfig:
+    """The byte-LM demo model every generation surface (CLI, daemon)
+    shares, matching tpulab.train's default architecture so checkpoints
+    from the trainer load directly."""
+    return LabformerConfig(d_model=128, n_heads=8, n_layers=4, d_ff=512,
+                           max_seq=1024)
+
+
+def load_params(cfg: LabformerConfig, ckpt_dir: Optional[str] = None,
+                seed: int = 0):
+    """Demo params: random init, or the latest trainer snapshot from
+    ``ckpt_dir``.  Returns (params, step|None)."""
+    from tpulab.models.labformer import init_params
+
+    params = init_params(cfg, seed=seed)
+    if not ckpt_dir:
+        return params, None
+    import os
+
+    import orbax.checkpoint as ocp
+
+    from tpulab.models.labformer import make_train_step
+
+    mgr = ocp.CheckpointManager(os.path.abspath(ckpt_dir))
+    step = mgr.latest_step()
+    if step is None:
+        raise FileNotFoundError(f"no checkpoint found in {ckpt_dir}")
+    optimizer, _ = make_train_step(cfg, None)
+    restored = mgr.restore(
+        step,
+        args=ocp.args.Composite(
+            state=ocp.args.StandardRestore(
+                {"params": params, "opt_state": optimizer.init(params)}
+            )
+        ),
+    )
+    return restored.state["params"], step
+
+
 def main(argv=None) -> int:
     """``tpulab generate``: byte-level sampling demo (random init unless
     ``--ckpt-dir`` points at a training snapshot)."""
@@ -281,33 +320,12 @@ def main(argv=None) -> int:
                     help="draft tokens proposed per verify round")
     args = ap.parse_args(argv)
 
-    cfg = LabformerConfig(d_model=128, n_heads=8, n_layers=4, d_ff=512, max_seq=1024)
-    from tpulab.models.labformer import init_params
-
-    params = init_params(cfg, seed=args.seed)
-    if args.ckpt_dir:
-        import os
-
-        import orbax.checkpoint as ocp
-
-        mgr = ocp.CheckpointManager(os.path.abspath(args.ckpt_dir))
-        step = mgr.latest_step()
-        if step is None:
-            raise SystemExit(f"no checkpoint found in {args.ckpt_dir}")
-        import optax
-
-        from tpulab.models.labformer import make_train_step
-
-        optimizer, _ = make_train_step(cfg, None)
-        restored = mgr.restore(
-            step,
-            args=ocp.args.Composite(
-                state=ocp.args.StandardRestore(
-                    {"params": params, "opt_state": optimizer.init(params)}
-                )
-            ),
-        )
-        params = restored.state["params"]
+    cfg = demo_config()
+    try:
+        params, step = load_params(cfg, args.ckpt_dir, seed=args.seed)
+    except FileNotFoundError as e:
+        raise SystemExit(str(e))
+    if step is not None:
         print(f"[generate] loaded checkpoint step {step}")
 
     prompt = np.frombuffer(args.prompt.encode("utf-8"), np.uint8)[None, :].astype(np.int32)

@@ -234,6 +234,10 @@ class PagedEngine:
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if len(prompt) == 0:
             raise ValueError("empty prompt")
+        if max_new < 1:
+            # step() appends before checking the budget, so 0 would
+            # still emit one token — refuse instead of off-by-one-ing
+            raise ValueError(f"max_new must be >= 1, got {max_new}")
         need = self._blocks_needed(len(prompt) + max_new)
         if need > min(self.max_blocks, self.n_usable_blocks):
             raise ValueError(

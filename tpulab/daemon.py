@@ -43,7 +43,44 @@ def _recv_exact(conn: socket.socket, n: int) -> bytes:
     return buf
 
 
-_ENGINES: dict = {}
+_ENGINES: "dict" = {}  # realpath|None -> (loaded_step, engine); LRU, max 2
+
+
+def _latest_ckpt_step(ckpt_dir: str):
+    """Cheap staleness probe: orbax lays out ``<dir>/<step>/``, so the
+    newest step is the largest integer-named subdirectory (no manager
+    construction per request)."""
+    try:
+        steps = [int(e) for e in os.listdir(ckpt_dir) if e.isdigit()]
+    except OSError:
+        return None
+    return max(steps) if steps else None
+
+
+def _engine_for(ckpt):
+    """Warm engine for the demo model (or a trainer snapshot), with the
+    cache problems a naive dict would have handled: keys are realpaths
+    (``ckpts`` and ``./ckpts`` alias), a newer checkpoint step evicts
+    the stale engine, and at most 2 engines stay resident (LRU)."""
+    from tpulab.models.generate import demo_config, load_params
+    from tpulab.models.paged import PagedEngine
+
+    key = os.path.realpath(ckpt) if ckpt else None
+    want_step = _latest_ckpt_step(key) if key else None
+    hit = _ENGINES.get(key)
+    if hit is not None and hit[0] == want_step:
+        _ENGINES[key] = _ENGINES.pop(key)  # LRU freshen
+        return hit[1]
+    cfg = demo_config()
+    params, step = load_params(cfg, key)
+    engine = PagedEngine(
+        params, cfg, slots=4, n_blocks=128, block_size=16, max_seq=512
+    )
+    _ENGINES.pop(key, None)
+    _ENGINES[key] = (step, engine)
+    while len(_ENGINES) > 2:
+        _ENGINES.pop(next(iter(_ENGINES)))
+    return engine
 
 
 def _handle_generate(header: dict, payload: bytes) -> bytes:
@@ -65,18 +102,7 @@ def _handle_generate(header: dict, payload: bytes) -> bytes:
     if not payload:
         # reject before paying model/engine construction on a cold cache
         raise ValueError("empty prompt")
-    ckpt = config.get("ckpt_dir")
-    key = ckpt or "__random__"
-    if key not in _ENGINES:
-        from tpulab.models.generate import demo_config, load_params
-        from tpulab.models.paged import PagedEngine
-
-        cfg = demo_config()
-        params, _ = load_params(cfg, ckpt)
-        _ENGINES[key] = PagedEngine(
-            params, cfg, slots=4, n_blocks=128, block_size=16, max_seq=512
-        )
-    engine = _ENGINES[key]
+    engine = _engine_for(config.get("ckpt_dir"))
     prompt = np.frombuffer(payload, np.uint8).astype(np.int32)
     rid = engine.submit(prompt, max_new=steps)
     out = engine.run()[rid]

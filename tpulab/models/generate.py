@@ -318,6 +318,9 @@ def main(argv=None) -> int:
                          "tokens as plain greedy)")
     ap.add_argument("--draft-k", type=int, default=4,
                     help="draft tokens proposed per verify round")
+    ap.add_argument("--beams", type=int, default=0,
+                    help="beam search width (0 = off; deterministic, "
+                         "exclusive with sampling and --speculative)")
     args = ap.parse_args(argv)
 
     cfg = demo_config()
@@ -329,7 +332,26 @@ def main(argv=None) -> int:
         print(f"[generate] loaded checkpoint step {step}")
 
     prompt = np.frombuffer(args.prompt.encode("utf-8"), np.uint8)[None, :].astype(np.int32)
-    if args.speculative:
+    if args.beams:
+        if args.speculative or args.temperature not in (0.0, 1.0) \
+                or args.top_k or args.top_p != 1.0:
+            raise SystemExit(
+                "--beams is deterministic; drop --speculative/"
+                "--temperature/--top-k/--top-p"
+            )
+        if not 1 <= args.beams <= cfg.vocab:
+            raise SystemExit(
+                f"--beams must be in [1, {cfg.vocab}] (vocab size), "
+                f"got {args.beams}"
+            )
+        from tpulab.models.beam import beam_search
+
+        seq, score = beam_search(params, prompt[0], cfg, steps=args.steps,
+                                 beams=args.beams)
+        print(f"[beam] width {args.beams}, total log-prob {score:.3f}",
+              file=sys.stderr)
+        out = seq[None, :]
+    elif args.speculative:
         # greedy-only: refuse explicitly-requested sampling rather than
         # silently dropping it (temperature 0 IS greedy — honor it)
         if args.temperature not in (0.0, 1.0) or args.top_k or args.top_p != 1.0:

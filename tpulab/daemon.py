@@ -46,12 +46,17 @@ def _recv_exact(conn: socket.socket, n: int) -> bytes:
 _ENGINES: "dict" = {}  # realpath|None -> (loaded_step, engine); LRU, max 2
 
 
-def _latest_ckpt_step(ckpt_dir: str):
-    """Cheap staleness probe: orbax lays out ``<dir>/<step>/``, so the
-    newest step is the largest integer-named subdirectory (no manager
-    construction per request)."""
+def _ckpt_stamp(ckpt_dir: str):
+    """Cheap CHANGE DETECTOR, not a step parser: the largest
+    integer-named subdirectory.  Compared against the stamp taken when
+    the engine loaded — never against orbax's own committed-step notion
+    (a stray digit-named file or crashed save would then disagree
+    forever and turn every request into a cold reload)."""
     try:
-        steps = [int(e) for e in os.listdir(ckpt_dir) if e.isdigit()]
+        steps = [
+            int(e) for e in os.listdir(ckpt_dir)
+            if e.isdigit() and os.path.isdir(os.path.join(ckpt_dir, e))
+        ]
     except OSError:
         return None
     return max(steps) if steps else None
@@ -66,18 +71,18 @@ def _engine_for(ckpt):
     from tpulab.models.paged import PagedEngine
 
     key = os.path.realpath(ckpt) if ckpt else None
-    want_step = _latest_ckpt_step(key) if key else None
+    stamp = _ckpt_stamp(key) if key else None
     hit = _ENGINES.get(key)
-    if hit is not None and hit[0] == want_step:
+    if hit is not None and hit[0] == stamp:
         _ENGINES[key] = _ENGINES.pop(key)  # LRU freshen
         return hit[1]
     cfg = demo_config()
-    params, step = load_params(cfg, key)
+    params, _ = load_params(cfg, key)
     engine = PagedEngine(
         params, cfg, slots=4, n_blocks=128, block_size=16, max_seq=512
     )
     _ENGINES.pop(key, None)
-    _ENGINES[key] = (step, engine)
+    _ENGINES[key] = (stamp, engine)
     while len(_ENGINES) > 2:
         _ENGINES.pop(next(iter(_ENGINES)))
     return engine

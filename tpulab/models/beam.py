@@ -78,8 +78,10 @@ def beam_search(
     prompt = np.asarray(prompt, np.int32).reshape(1, -1)
     if steps < 1:
         raise ValueError(f"steps must be >= 1, got {steps}")
-    if beams < 1:
-        raise ValueError(f"beams must be >= 1, got {beams}")
+    if not 1 <= beams <= cfg.vocab:
+        raise ValueError(
+            f"beams must be in [1, {cfg.vocab}] (vocab size), got {beams}"
+        )
     first, toks, parents, scores = jax.device_get(
         _beam_search_jit(params, jnp.asarray(prompt), cfg, steps, beams)
     )

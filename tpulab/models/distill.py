@@ -1,0 +1,129 @@
+"""Knowledge distillation: train a small draft model on the flagship's
+logits.
+
+Speculative decoding (tpulab.models.speculative) wants a draft that is
+CHEAP and AGREES with the target; int8 quantization gives agreement
+with ~half the bytes, but a distilled student with fewer layers/heads
+gives a much lower per-token cost.  This module trains one: the student
+minimizes ``alpha * KL(teacher_T || student_T) * T^2 +
+(1 - alpha) * CE(data)`` (Hinton et al. 2015 — softened teacher
+distribution at temperature T, straight cross-entropy on the stream as
+the anchor).
+
+The teacher forward runs under ``lax.stop_gradient`` inside the SAME
+jitted step, so one program does teacher inference + student update —
+XLA overlaps both on the MXU rather than paying two dispatches.
+
+Reference frame: no analog in the reference (its binaries are fixed
+kernels); this is the framework's model-compression tier alongside
+int8 quantization (models/quant.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from tpulab.models.labformer import LabformerConfig, forward, init_params
+
+
+def distill_loss_fn(student_params, tokens, teacher_logits,
+                    student_cfg: LabformerConfig, temperature: float,
+                    alpha: float):
+    """Soft-target KL at ``temperature`` blended with data CE.
+
+    ``teacher_logits`` are precomputed (stop-gradient'd) logits over the
+    same ``tokens``; both models read tokens[:, :-1] and predict
+    tokens[:, 1:]."""
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    s_logits = forward(student_params, inputs, student_cfg).astype(jnp.float32)
+    t_logits = teacher_logits.astype(jnp.float32)
+
+    T = jnp.float32(temperature)
+    t_soft = jax.nn.log_softmax(t_logits / T, axis=-1)
+    s_soft = jax.nn.log_softmax(s_logits / T, axis=-1)
+    # KL(teacher || student) summed over vocab, mean over positions;
+    # the T^2 factor keeps soft-gradient magnitudes comparable to CE
+    kl = jnp.mean(jnp.sum(jnp.exp(t_soft) * (t_soft - s_soft), axis=-1))
+    kl = kl * T * T
+
+    ll = jnp.take_along_axis(
+        jax.nn.log_softmax(s_logits, axis=-1), targets[..., None], axis=-1
+    )[..., 0]
+    ce = -jnp.mean(ll)
+    a = jnp.float32(alpha)
+    return a * kl + (jnp.float32(1.0) - a) * ce
+
+
+def make_distill_step(teacher_params, teacher_cfg: LabformerConfig,
+                      student_cfg: LabformerConfig, optimizer=None,
+                      temperature: float = 2.0, alpha: float = 0.5):
+    """Jitted (student_params, opt_state, tokens) ->
+    (student_params, opt_state, loss)."""
+    import optax
+
+    if teacher_cfg.vocab != student_cfg.vocab:
+        raise ValueError("teacher and student must share a vocabulary")
+    optimizer = optimizer or optax.adamw(1e-3)
+    # the teacher is CLOSED OVER by the jitted step: host numpy leaves
+    # (e.g. a freshly device_get checkpoint) can't be indexed by traced
+    # tokens — make them jax arrays once here
+    teacher_params = jax.tree_util.tree_map(jnp.asarray, teacher_params)
+
+    @jax.jit
+    def step(student_params, opt_state, tokens):
+        t_logits = jax.lax.stop_gradient(
+            forward(teacher_params, tokens[:, :-1], teacher_cfg)
+        )
+        loss, grads = jax.value_and_grad(distill_loss_fn)(
+            student_params, tokens, t_logits, student_cfg, temperature, alpha
+        )
+        updates, opt_state = optimizer.update(grads, opt_state, student_params)
+        student_params = optax.apply_updates(student_params, updates)
+        return student_params, opt_state, loss
+
+    return optimizer, step
+
+
+def distill(
+    teacher_params,
+    teacher_cfg: LabformerConfig,
+    student_cfg: LabformerConfig,
+    steps: int = 200,
+    batch: int = 8,
+    seq: int = 64,
+    seed: int = 0,
+    temperature: float = 2.0,
+    alpha: float = 0.5,
+    optimizer=None,
+    batch_at=None,
+    log=print,
+) -> Tuple[dict, float]:
+    """Train a fresh ``student_cfg`` model against the teacher; returns
+    ``(student_params, last_loss)``.
+
+    ``batch_at(step) -> (batch, seq+1) int32`` overrides the default
+    deterministic stream (tpulab.train.batches) — pass the native
+    loader's stream to distill on real files."""
+    from tpulab.train import batches
+
+    optimizer, step_fn = make_distill_step(
+        teacher_params, teacher_cfg, student_cfg, optimizer,
+        temperature=temperature, alpha=alpha,
+    )
+    student = init_params(student_cfg, seed=seed)
+    opt_state = optimizer.init(student)
+    batch_at = batch_at or batches(student_cfg.vocab, batch, seq, seed)
+    loss = float("nan")
+    for i in range(steps):
+        student, opt_state, loss = step_fn(student, opt_state, batch_at(i))
+        loss = float(loss)
+        if not np.isfinite(loss):
+            raise FloatingPointError(f"non-finite distill loss at step {i}")
+        if i % 50 == 0:
+            log(f"[distill] step {i} loss {loss:.4f}")
+    return jax.device_get(student), loss

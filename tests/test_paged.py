@@ -229,3 +229,27 @@ class TestPrefixSharing:
             eng._deref(b)
         assert all(b in eng.free for b in shared)           # now released
         assert int(eng.block_refs.sum()) == 0
+
+    def test_cache_hit_skips_dense_prefill(self, trained, monkeypatch):
+        """The compute-reuse claim: on a prefix-cache hit the dense
+        prefill must not run at all — only paged_extend over the tail."""
+        import tpulab.models.paged as paged_mod
+
+        eng = PagedEngine(trained, CFG, slots=1, n_blocks=16, block_size=8,
+                          max_seq=64)
+        first = eng.submit(self._sys_prompt([1]), max_new=4)
+        out1 = eng.run()
+        calls = {"n": 0}
+        real = paged_mod._prefill
+
+        def counting(*a, **kw):
+            calls["n"] += 1
+            return real(*a, **kw)
+
+        monkeypatch.setattr(paged_mod, "_prefill", counting)
+        rid = eng.submit(self._sys_prompt([5, 3]), max_new=5)
+        out = eng.run()
+        assert calls["n"] == 0, "dense prefill ran despite a cache hit"
+        want = generate(trained, self._sys_prompt([5, 3])[None, :], CFG,
+                        steps=5, temperature=0.0)[0]
+        assert np.array_equal(out[rid], want)

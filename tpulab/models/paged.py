@@ -31,9 +31,10 @@ requests repeating a system prompt share its KV blocks instead of
 duplicating them.  Causal KV depends only on the token prefix, so a
 cached block is valid for any prompt extending it, and decode writes
 land strictly past every full shared block (read-only by construction).
-Sharing currently dedups MEMORY; the prefill still recomputes the
-shared region's K/V (skipping that compute needs a paged windowed
-forward — future work).
+Sharing dedups both MEMORY and COMPUTE: on a cache hit,
+``paged_extend`` runs the model over only the tail beyond the shared
+region, attending the shared blocks straight from the pool — the dense
+prefill never executes (tested by counting its calls).
 
 Reference frame: the reference has no serving tier at all (SURVEY.md
 section 0); this is TPU-first serving infrastructure in the spirit of
@@ -51,8 +52,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tpulab.models.generate import _prefill
-from tpulab.models.labformer import LabformerConfig, _mlp, _rmsnorm
+from tpulab.models.generate import _attend_cached, _prefill
+from tpulab.models.labformer import LabformerConfig, _mlp, _rmsnorm, _rope
 from tpulab.models.quant import embed_lookup, qmat, unembed
 from tpulab.parallel.ring import NEG_INF
 
@@ -142,6 +143,54 @@ def paged_decode_step(params, tokens, kpool, vpool, tables, lengths,
     x = _rmsnorm(x, params["final_norm"])
     logits = unembed(x, params["embed"])[:, 0, :]
     return logits, kpool, vpool
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "block_size", "bucket"))
+def paged_extend(params, tokens, kpool, vpool, table_row, start, n_valid,
+                 cfg: LabformerConfig, block_size: int, bucket: int):
+    """Extend one slot's paged KV by running the model over ``tokens``
+    (1, bucket; valid through ``n_valid``) at logical positions
+    ``start``.. — attending the slot's EXISTING pool contents (the
+    shared prefix) plus the window's own causal prefix.
+
+    This is the prefix-cache COMPUTE reuse: on a cache hit the dense
+    prefill never runs; only the tail beyond the shared region is
+    computed.  ``start`` must be block-aligned (shared regions are whole
+    blocks by construction); writes route positions >= n_valid to TRASH.
+    """
+    h, dh, kvh = cfg.n_heads, cfg.head_dim, cfg.kv_heads
+    x = embed_lookup(params["embed"], tokens, cfg.dtype)  # (1, bucket, d)
+    j = jnp.arange(bucket)
+    blk = jnp.where(j < n_valid, table_row[(start + j) // block_size], TRASH)
+    off = ((start + j) % block_size).astype(jnp.int32)
+    pos = start + j
+    M = table_row.shape[0]
+
+    def layer_step(carry, inputs):
+        x = carry
+        layer, kpool_l, vpool_l = inputs
+        xn = _rmsnorm(x, layer["ln1"])
+        q = qmat(xn, layer["wq"]).reshape(1, bucket, h, dh)
+        k = qmat(xn, layer["wk"]).reshape(1, bucket, kvh, dh)
+        v = qmat(xn, layer["wv"]).reshape(1, bucket, kvh, dh)
+        q = _rope(q, pos, cfg.rope_theta)
+        k = _rope(k, pos, cfg.rope_theta)
+        kpool_l = kpool_l.at[blk, off].set(k[0])
+        vpool_l = vpool_l.at[blk, off].set(v[0])
+        kg = kpool_l[table_row].reshape(1, M * block_size, kvh, dh)
+        vg = vpool_l[table_row].reshape(1, M * block_size, kvh, dh)
+        # generate._attend_cached IS the windowed causal attend over a
+        # gathered key space (row r reads keys [0, start+r]) — one copy
+        # of the numerics-sensitive recipe, shared with dense decode
+        o = _attend_cached(q, kg, vg, start)
+        x = x + qmat(o.reshape(1, bucket, cfg.d_model), layer["wo"])
+        y, _ = _mlp(_rmsnorm(x, layer["ln2"]), layer, cfg)
+        return x + y, (kpool_l, vpool_l)
+
+    _, (kpool, vpool) = jax.lax.scan(
+        layer_step, x, (params["blocks"], kpool, vpool)
+    )
+    return kpool, vpool
 
 
 @functools.partial(jax.jit, static_argnames=("bucket", "block_size"))
@@ -327,22 +376,40 @@ class PagedEngine:
 
     def _prefill_slot(self, s: int, req: _Request, row: np.ndarray,
                       shared_pos: int = 0):
-        """Scatter KV for prompt[:-1] (positions below ``shared_pos``
-        already live in shared prefix blocks and are skipped); hold the
-        last prompt token back so the first engine step produces the
-        first generated token through the one shared decode program."""
+        """Fill the slot's KV for prompt[:-1]; hold the last prompt
+        token back so the first engine step produces the first generated
+        token through the one shared decode program.
+
+        Cache miss (``shared_pos == 0``): dense prefill + scatter (the
+        O(p^2) causal pass is cheapest as one dense program).  Cache hit:
+        ``paged_extend`` computes ONLY the tail beyond the shared
+        region, attending the shared blocks straight from the pool — the
+        prefix's prefill compute is genuinely skipped, not just its
+        memory deduplicated."""
         p = len(req.prompt) - 1
         if p > shared_pos:
-            bucket = _bucket(p)
-            padded = np.zeros((1, bucket), np.int32)
-            padded[0, :p] = req.prompt[:-1]
-            _, kc, vc = _prefill(
-                self.params, jnp.asarray(padded), self.cfg, bucket
-            )
-            self.kpool, self.vpool = _scatter_prefill(
-                self.kpool, self.vpool, kc[:, 0], vc[:, 0],
-                jnp.asarray(row), shared_pos, p, bucket, self.block_size,
-            )
+            if shared_pos > 0:
+                tail = req.prompt[shared_pos:p]
+                bucket = _bucket(len(tail))
+                padded = np.zeros((1, bucket), np.int32)
+                padded[0, :len(tail)] = tail
+                self.kpool, self.vpool = paged_extend(
+                    self.params, jnp.asarray(padded), self.kpool,
+                    self.vpool, jnp.asarray(row), shared_pos, len(tail),
+                    self.cfg, self.block_size, bucket,
+                )
+            else:
+                bucket = _bucket(p)
+                padded = np.zeros((1, bucket), np.int32)
+                padded[0, :p] = req.prompt[:-1]
+                _, kc, vc = _prefill(
+                    self.params, jnp.asarray(padded), self.cfg, bucket
+                )
+                self.kpool, self.vpool = _scatter_prefill(
+                    self.kpool, self.vpool, kc[:, 0], vc[:, 0],
+                    jnp.asarray(row), shared_pos, p, bucket,
+                    self.block_size,
+                )
         self.lengths[s] = p
         self.last_tok[s] = req.prompt[-1]
 

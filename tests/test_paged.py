@@ -253,3 +253,35 @@ class TestPrefixSharing:
         want = generate(trained, self._sys_prompt([5, 3])[None, :], CFG,
                         steps=5, temperature=0.0)[0]
         assert np.array_equal(out[rid], want)
+
+
+class TestChunkedPrefillAndStats:
+    def test_chunked_prefill_matches_whole_tail(self, trained):
+        """prefill_chunk splits admission into fixed windows through
+        paged_extend; tokens must stay bit-equal to solo greedy."""
+        prompt = (np.arange(30) % 7).astype(np.int32)
+        eng = PagedEngine(trained, CFG, slots=1, n_blocks=16, block_size=8,
+                          max_seq=64, prefill_chunk=8)
+        rid = eng.submit(prompt, max_new=6)
+        out = eng.run()
+        want = generate(trained, prompt[None, :], CFG, steps=6,
+                        temperature=0.0)[0]
+        assert np.array_equal(out[rid], want)
+
+    def test_stats_counters(self, trained):
+        eng = PagedEngine(trained, CFG, slots=2, n_blocks=32, block_size=8,
+                          max_seq=64)
+        sys_prompt = (np.arange(17) % 7).astype(np.int32)
+        eng.submit(sys_prompt, max_new=3)
+        eng.run()
+        eng.submit(np.concatenate([sys_prompt, [2]]).astype(np.int32),
+                   max_new=4)
+        eng.run()
+        st = eng.stats()
+        assert st["prefix_misses"] == 1 and st["prefix_hits"] == 1
+        assert st["requests_done"] == 2 and st["tokens_out"] == 7
+        assert st["ticks"] >= 4
+        # after run() drains, only cache-pinned blocks remain in use
+        cached = sum(len(b) for b in eng.prefix_cache.values())
+        assert st["blocks_free"] == st["blocks_total"] - cached
+        assert st["cache_entries"] >= 1

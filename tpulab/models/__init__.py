@@ -1,4 +1,9 @@
-"""Model tier: mesh-first flagship models (see labformer)."""
+"""Model tier: mesh-first flagship models (see labformer), the serving
+stack (generate / speculative / paged / beam), and model compression
+(quant / distill).
+
+Heavier members load lazily via ``__getattr__`` so ``import
+tpulab.models`` stays cheap for lab-only use."""
 
 from tpulab.models.labformer import (
     LabformerConfig,
@@ -12,6 +17,30 @@ from tpulab.models.labformer import (
     shard_params,
 )
 
+# NOTE: no entry may share a name with a submodule ("generate",
+# "distill", ...): the import system binds the submodule onto the
+# package on first import, which would shadow the lazy attribute and
+# hand callers a module where they expect a function
+_LAZY = {
+    "beam_search": ("tpulab.models.beam", "beam_search"),
+    "speculative_generate": ("tpulab.models.speculative",
+                             "speculative_generate"),
+    "PagedEngine": ("tpulab.models.paged", "PagedEngine"),
+    "distill_model": ("tpulab.models.distill", "distill"),
+    "quantize_decode_params": ("tpulab.models.quant",
+                               "quantize_decode_params"),
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        mod, attr = _LAZY[name]
+        return getattr(importlib.import_module(mod), attr)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
+
+
 __all__ = [
     "LabformerConfig",
     "expert_load",
@@ -22,4 +51,5 @@ __all__ = [
     "loss_fn",
     "make_train_step",
     "shard_params",
+    *sorted(_LAZY),
 ]

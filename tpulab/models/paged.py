@@ -153,11 +153,12 @@ def paged_extend(params, tokens, kpool, vpool, table_row, start, n_valid,
     ``start``.. — attending the slot's EXISTING pool contents (the
     shared prefix) plus the window's own causal prefix.
 
-    This is the prefix-cache COMPUTE reuse: on a cache hit the dense
-    prefill never runs; only the tail beyond the shared region is
-    computed.  ``start`` must be block-aligned (shared regions are whole
-    blocks by construction); writes route positions >= n_valid to TRASH.
-    """
+    This is the prefix-cache COMPUTE reuse (on a hit only the tail
+    beyond the shared region is computed) and the chunked-prefill
+    engine: ``start`` may be ANY position with all earlier positions'
+    KV already in the pool — the block/offset arithmetic and the causal
+    mask are position-exact.  Writes route positions >= n_valid to
+    TRASH."""
     h, dh, kvh = cfg.n_heads, cfg.head_dim, cfg.kv_heads
     x = embed_lookup(params["embed"], tokens, cfg.dtype)  # (1, bucket, d)
     j = jnp.arange(bucket)
@@ -249,9 +250,11 @@ class PagedEngine:
 
     def __init__(self, params, cfg: LabformerConfig, *, slots: int = 4,
                  n_blocks: int = 64, block_size: int = 16,
-                 max_seq: int = 256):
+                 max_seq: int = 256, prefill_chunk: int = 0):
         if max_seq % block_size:
             raise ValueError("max_seq must be a multiple of block_size")
+        if prefill_chunk < 0:
+            raise ValueError("prefill_chunk must be >= 0 (0 = whole tail)")
         self.params = params
         self.cfg = cfg
         self.slots = slots
@@ -277,6 +280,14 @@ class PagedEngine:
         # shared block, so shared blocks are read-only by construction.
         self.block_refs = np.zeros(n_blocks, np.int64)
         self.prefix_cache: "OrderedDict[bytes, List[int]]" = OrderedDict()
+        # chunked prefill: admit long prompts in fixed windows through
+        # paged_extend instead of one whole-tail program — peak prefill
+        # activation memory and compile-bucket count stay bounded
+        self.prefill_chunk = prefill_chunk
+        self.counters = {
+            "prefix_hits": 0, "prefix_misses": 0, "evictions": 0,
+            "ticks": 0, "tokens_out": 0, "requests_done": 0,
+        }
 
     # ------------------------------------------------------------- admission
     def submit(self, prompt, max_new: int) -> int:
@@ -320,6 +331,7 @@ class PagedEngine:
         only lose the cache's own ref; blocks free when refs hit 0)."""
         while len(self.free) < want_free and self.prefix_cache:
             _, blocks = self.prefix_cache.popitem(last=False)
+            self.counters["evictions"] += 1
             for b in blocks:
                 self._deref(b)
 
@@ -349,6 +361,9 @@ class PagedEngine:
                     self._deref(b)
                 break  # FIFO: wait rather than starve the head request
             self.pending.pop(0)
+            # count only REAL admissions: a stalled retry re-looks-up
+            # the prefix every tick and would inflate the hit rate
+            self.counters["prefix_hits" if shared else "prefix_misses"] += 1
             fresh = [self.free.pop() for _ in range(need_new)]
             for b in fresh:
                 self.block_refs[b] += 1
@@ -388,16 +403,22 @@ class PagedEngine:
         memory deduplicated."""
         p = len(req.prompt) - 1
         if p > shared_pos:
-            if shared_pos > 0:
-                tail = req.prompt[shared_pos:p]
-                bucket = _bucket(len(tail))
-                padded = np.zeros((1, bucket), np.int32)
-                padded[0, :len(tail)] = tail
-                self.kpool, self.vpool = paged_extend(
-                    self.params, jnp.asarray(padded), self.kpool,
-                    self.vpool, jnp.asarray(row), shared_pos, len(tail),
-                    self.cfg, self.block_size, bucket,
-                )
+            if shared_pos > 0 or self.prefill_chunk:
+                # paged path: works from ANY start (shared boundary or a
+                # chunk boundary), attending earlier pool contents
+                start = shared_pos
+                chunk = self.prefill_chunk or (p - shared_pos)
+                while start < p:
+                    tail = req.prompt[start:min(start + chunk, p)]
+                    bucket = _bucket(len(tail))
+                    padded = np.zeros((1, bucket), np.int32)
+                    padded[0, :len(tail)] = tail
+                    self.kpool, self.vpool = paged_extend(
+                        self.params, jnp.asarray(padded), self.kpool,
+                        self.vpool, jnp.asarray(row), start, len(tail),
+                        self.cfg, self.block_size, bucket,
+                    )
+                    start += len(tail)
             else:
                 bucket = _bucket(p)
                 padded = np.zeros((1, bucket), np.int32)
@@ -425,10 +446,12 @@ class PagedEngine:
             self.cfg, self.block_size,
         )
         nxt = np.asarray(jnp.argmax(logits, -1).astype(jnp.int32))
+        self.counters["ticks"] += 1
         finished = []
         for s, req in enumerate(self.active):
             if req is None:
                 continue
+            self.counters["tokens_out"] += 1
             req.out.append(int(nxt[s]))
             self.lengths[s] += 1
             self.last_tok[s] = nxt[s]
@@ -440,8 +463,18 @@ class PagedEngine:
                 self.lengths[s] = 0
                 self.active[s] = None
                 self._done[req.req_id] = np.asarray(req.out, np.int32)
+                self.counters["requests_done"] += 1
                 finished.append(req.req_id)
         return finished
+
+    def stats(self) -> Dict[str, int]:
+        """Serving observability: counters plus live pool occupancy."""
+        return {
+            **self.counters,
+            "blocks_free": len(self.free),
+            "blocks_total": self.n_usable_blocks,
+            "cache_entries": len(self.prefix_cache),
+        }
 
     def run(self) -> Dict[int, np.ndarray]:
         """Drain queue + active slots; {req_id: generated tokens} for

@@ -45,3 +45,29 @@ def reference_root():
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+@pytest.fixture(scope="session")
+def trained_small_cfg():
+    from tpulab.models.labformer import LabformerConfig
+
+    return LabformerConfig(d_model=32, n_heads=4, n_layers=2, d_ff=64,
+                           max_seq=128)
+
+
+@pytest.fixture(scope="session")
+def trained_small(trained_small_cfg):
+    """ONE sharp-logit small labformer shared by the serving-tier
+    suites (beam/paged/speculative/distill): untrained argmax ties flip
+    under benign numeric reorderings, so cross-implementation token
+    equality needs real margins — and training the same model four
+    times per run is pure waste.  Config must match each module's CFG:
+    d32 / h4 / L2 / ff64 / max_seq 128 (consumers assert equality via
+    trained_small_cfg so drift fails loudly)."""
+    from tpulab.models.labformer import init_train_state
+
+    params, opt, step = init_train_state(trained_small_cfg, None, seed=0)
+    tok = np.tile(np.arange(33, dtype=np.int32) % 7, (8, 1))
+    for _ in range(80):
+        params, opt, _ = step(params, opt, tok)
+    return jax.device_get(params)

@@ -19,14 +19,9 @@ CFG = LabformerConfig(d_model=32, n_heads=4, n_layers=2, d_ff=64, max_seq=128)
 
 
 @pytest.fixture(scope="module")
-def trained():
-    from tpulab.models.labformer import init_train_state
-
-    params, opt, step = init_train_state(CFG, None, seed=0)
-    tok = np.tile(np.arange(33, dtype=np.int32) % 7, (8, 1))
-    for _ in range(80):
-        params, opt, _ = step(params, opt, tok)
-    return jax.device_get(params)
+def trained(trained_small, trained_small_cfg):
+    assert CFG == trained_small_cfg  # shared-model drift fails loudly
+    return trained_small
 
 
 def _seq_logprob(params, prompt, cont):

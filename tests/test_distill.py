@@ -20,14 +20,9 @@ STUDENT_CFG = LabformerConfig(d_model=16, n_heads=2, n_layers=1, d_ff=32,
 
 
 @pytest.fixture(scope="module")
-def teacher():
-    from tpulab.models.labformer import init_train_state
-
-    params, opt, step = init_train_state(TEACHER_CFG, None, seed=0)
-    tok = np.tile(np.arange(33, dtype=np.int32) % 7, (8, 1))
-    for _ in range(80):
-        params, opt, _ = step(params, opt, tok)
-    return jax.device_get(params)
+def teacher(trained_small, trained_small_cfg):
+    assert TEACHER_CFG == trained_small_cfg  # drift fails loudly
+    return trained_small
 
 
 def _cycle_batch(step):

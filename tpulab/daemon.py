@@ -29,6 +29,7 @@ import signal
 import socket
 import struct
 import sys
+import threading
 import traceback
 from typing import Optional
 
@@ -44,6 +45,76 @@ def _recv_exact(conn: socket.socket, n: int) -> bytes:
 
 
 _ENGINES: "dict" = {}  # realpath|None -> (loaded_step, engine); LRU, max 2
+
+
+class _GenerateService:
+    """Cross-connection continuous batching.
+
+    Each connection thread calls :meth:`generate`; submissions land in
+    the shared PagedEngine under one lock, and a single stepper thread
+    advances ALL active slots together — concurrent clients ride the
+    same batched decode step instead of queueing whole requests behind
+    each other.  Results fan back out through a condition variable.
+
+    Failure policy: if a step raises, the stepper fails EVERY request
+    on that engine (each waiter re-raises a clear error instead of
+    hanging in cond.wait forever) and the engine is dropped from the
+    cache so the next request rebuilds it."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.cond = threading.Condition(self.lock)
+        self.results: dict = {}
+        self._stepper_alive: set = set()  # id(engine) while running
+
+    def generate(self, engine, prompt, steps: int):
+        with self.lock:
+            rid = engine.submit(prompt, max_new=steps)
+            key = id(engine)
+            if key not in self._stepper_alive:
+                self._stepper_alive.add(key)
+                threading.Thread(
+                    target=self._step_loop, args=(engine, key), daemon=True
+                ).start()
+            while rid not in self.results:
+                self.cond.wait()
+            out = self.results.pop(rid)
+            if isinstance(out, Exception):
+                raise RuntimeError(f"engine step failed: {out!r}") from out
+            return out
+
+    def _step_loop(self, engine, key):
+        try:
+            while True:
+                with self.lock:
+                    if not engine.pending and not any(
+                        r is not None for r in engine.active
+                    ):
+                        # discard INSIDE this locked region: after the
+                        # lock drops, a submitter must either see the
+                        # stepper alive (and it still is) or dead (and
+                        # spawn a fresh one) — never a dead flag-alive
+                        self._stepper_alive.discard(key)
+                        return
+                    for rid in engine.step():
+                        self.results[rid] = engine._done.pop(rid)
+                    self.cond.notify_all()
+        except Exception as e:  # fail every request; never hang waiters
+            with self.lock:
+                for req in list(engine.pending) + [
+                    r for r in engine.active if r is not None
+                ]:
+                    self.results[req.req_id] = e
+                engine.pending.clear()
+                engine.active = [None] * engine.slots
+                for k, v in list(_ENGINES.items()):
+                    if v[1] is engine:
+                        _ENGINES.pop(k)
+                self._stepper_alive.discard(key)
+                self.cond.notify_all()
+
+
+_GEN_SERVICE = _GenerateService()
 
 
 def _ckpt_stamp(ckpt_dir: str):
@@ -66,25 +137,35 @@ def _engine_for(ckpt):
     """Warm engine for the demo model (or a trainer snapshot), with the
     cache problems a naive dict would have handled: keys are realpaths
     (``ckpts`` and ``./ckpts`` alias), a newer checkpoint step evicts
-    the stale engine, and at most 2 engines stay resident (LRU)."""
+    the stale engine, and at most 2 engines stay resident (LRU).
+
+    Only the dict lookups hold the service lock — the multi-second cold
+    build (checkpoint restore + pool allocation) runs OUTSIDE it so
+    in-flight decode ticks never stall behind a load; a lost build race
+    reuses the winner's engine."""
     from tpulab.models.generate import demo_config, load_params
     from tpulab.models.paged import PagedEngine
 
     key = os.path.realpath(ckpt) if ckpt else None
     stamp = _ckpt_stamp(key) if key else None
-    hit = _ENGINES.get(key)
-    if hit is not None and hit[0] == stamp:
-        _ENGINES[key] = _ENGINES.pop(key)  # LRU freshen
-        return hit[1]
+    with _GEN_SERVICE.lock:
+        hit = _ENGINES.get(key)
+        if hit is not None and hit[0] == stamp:
+            _ENGINES[key] = _ENGINES.pop(key)  # LRU freshen
+            return hit[1]
     cfg = demo_config()
     params, _ = load_params(cfg, key)
     engine = PagedEngine(
         params, cfg, slots=4, n_blocks=128, block_size=16, max_seq=512
     )
-    _ENGINES.pop(key, None)
-    _ENGINES[key] = (stamp, engine)
-    while len(_ENGINES) > 2:
-        _ENGINES.pop(next(iter(_ENGINES)))
+    with _GEN_SERVICE.lock:
+        hit = _ENGINES.get(key)
+        if hit is not None and hit[0] == stamp:
+            return hit[1]  # concurrent build won; use theirs
+        _ENGINES.pop(key, None)
+        _ENGINES[key] = (stamp, engine)
+        while len(_ENGINES) > 2:
+            _ENGINES.pop(next(iter(_ENGINES)))
     return engine
 
 
@@ -109,24 +190,46 @@ def _handle_generate(header: dict, payload: bytes) -> bytes:
         raise ValueError("empty prompt")
     engine = _engine_for(config.get("ckpt_dir"))
     prompt = np.frombuffer(payload, np.uint8).astype(np.int32)
-    rid = engine.submit(prompt, max_new=steps)
-    out = engine.run()[rid]
+    out = _GEN_SERVICE.generate(engine, prompt, steps)
     return bytes(int(t) & 0xFF for t in out)
+
+
+def _handle_generate_stats(header: dict) -> bytes:
+    """Engine observability over the wire: PagedEngine.stats() JSON for
+    the requested ckpt_dir's engine (empty object if none is warm)."""
+    config = header.get("config") or {}
+    key = config.get("ckpt_dir")
+    key = os.path.realpath(key) if key else None
+    with _GEN_SERVICE.lock:
+        hit = _ENGINES.get(key)
+        stats = hit[1].stats() if hit else {}
+    return json.dumps(stats).encode("utf-8")
+
+
+# Lab runs are SERIALIZED even though connections are threaded: their
+# "execution time:" lines feed the harness's stats CSVs, and two timed
+# kernels sharing the device would inflate each other's numbers.  (A
+# lab overlapping generate decode can still contend — point timing
+# workloads at a daemon without generate traffic.)
+_LAB_LOCK = threading.Lock()
 
 
 def handle_request(header: dict, payload: bytes) -> bytes:
     if header.get("lab") == "generate":
         return _handle_generate(header, payload)
+    if header.get("lab") == "generate_stats":
+        return _handle_generate_stats(header)
 
     from tpulab.labs import get_workload
 
     mod = get_workload(header["lab"])
-    out = mod.run(
-        payload.decode("utf-8"),
-        sweep=bool(header.get("sweep", False)),
-        backend=header.get("backend"),
-        **(header.get("config") or {}),
-    )
+    with _LAB_LOCK:
+        out = mod.run(
+            payload.decode("utf-8"),
+            sweep=bool(header.get("sweep", False)),
+            backend=header.get("backend"),
+            **(header.get("config") or {}),
+        )
     return out.encode("utf-8")
 
 
@@ -154,28 +257,52 @@ def serve(socket_path: str, *, max_requests: Optional[int] = None) -> None:
     jax.devices()
     print(f"[tpulab.daemon] serving on {socket_path}", flush=True)
 
-    served = 0
+    import threading
+
+    served = {"n": 0}
+    served_lock = threading.Lock()
+
+    def _handle_conn(conn):
+        # per-connection thread: long generate requests batch through
+        # the shared engine instead of blocking lab traffic (and each
+        # other) behind a serial accept loop
+        try:
+            raw = _recv_exact(conn, 4)
+            (hlen,) = struct.unpack("<I", raw)
+            header = json.loads(_recv_exact(conn, hlen))
+            (plen,) = struct.unpack("<Q", _recv_exact(conn, 8))
+            payload = _recv_exact(conn, plen)
+            try:
+                out = handle_request(header, payload)
+                conn.sendall(struct.pack("<BQ", 0, len(out)) + out)
+            except Exception:
+                err = traceback.format_exc().encode("utf-8")
+                conn.sendall(struct.pack("<BQ", 1, len(err)) + err)
+        except ConnectionError:
+            pass
+        finally:
+            conn.close()
+            with served_lock:
+                served["n"] += 1
+
     try:
+        accepted = 0
         while not stop["flag"]:
             conn, _ = srv.accept()
-            try:
-                raw = _recv_exact(conn, 4)
-                (hlen,) = struct.unpack("<I", raw)
-                header = json.loads(_recv_exact(conn, hlen))
-                (plen,) = struct.unpack("<Q", _recv_exact(conn, 8))
-                payload = _recv_exact(conn, plen)
-                try:
-                    out = handle_request(header, payload)
-                    conn.sendall(struct.pack("<BQ", 0, len(out)) + out)
-                except Exception:
-                    err = traceback.format_exc().encode("utf-8")
-                    conn.sendall(struct.pack("<BQ", 1, len(err)) + err)
-            except ConnectionError:
-                pass
-            finally:
-                conn.close()
-            served += 1
-            if max_requests is not None and served >= max_requests:
+            threading.Thread(
+                target=_handle_conn, args=(conn,), daemon=True
+            ).start()
+            accepted += 1
+            if max_requests is not None and accepted >= max_requests:
+                # drain: in-flight handlers must finish (and send their
+                # responses) before process exit kills their threads
+                import time as _time
+
+                for _ in range(600):
+                    with served_lock:
+                        if served["n"] >= accepted:
+                            break
+                    _time.sleep(0.1)
                 break
     except KeyboardInterrupt:
         pass

@@ -277,3 +277,57 @@ class TestChunkedPrefillAndStats:
         cached = sum(len(b) for b in eng.prefix_cache.values())
         assert st["blocks_free"] == st["blocks_total"] - cached
         assert st["cache_entries"] >= 1
+
+
+def test_tp_mesh_engine_matches_single_device(trained):
+    """Tensor-parallel serving: the engine over a {'tp': 2} mesh (params
+    tp-sharded, pools sharded on the kv-head axis, GSPMD partitioning
+    the same decode program) must emit the same tokens as the
+    single-device engine."""
+    from tpulab.parallel.mesh import make_mesh
+
+    mesh = make_mesh({"tp": 2})
+    reqs = [(3, 6), (5, 8), (9, 4)]
+    outs = []
+    for m in (None, mesh):
+        eng = PagedEngine(trained, CFG, slots=2, n_blocks=24, block_size=8,
+                          max_seq=64, mesh=m)
+        rids = [eng.submit(_cycle_prompt(p), max_new=n) for p, n in reqs]
+        got = eng.run()
+        outs.append([got[r] for r in rids])
+    for a, b, (p, n) in zip(outs[0], outs[1], reqs):
+        assert np.array_equal(a, b), (p, n)
+
+
+def test_tp_mesh_gqa_engine():
+    """tp=2 over kv_heads=2: one kv head per shard."""
+    from tpulab.parallel.mesh import make_mesh
+
+    cfg = LabformerConfig(
+        d_model=32, n_heads=4, n_kv_heads=2, n_layers=2, d_ff=64, max_seq=128
+    )
+    from tpulab.models.labformer import init_train_state
+
+    params, opt, step = init_train_state(cfg, None, seed=0)
+    tok = np.tile(np.arange(33, dtype=np.int32) % 7, (8, 1))
+    for _ in range(60):
+        params, opt, _ = step(params, opt, tok)
+    params = jax.device_get(params)
+    single = PagedEngine(params, cfg, slots=1, n_blocks=16, block_size=8,
+                         max_seq=64)
+    a = single.submit(_cycle_prompt(5), max_new=6)
+    want = single.run()[a]
+    sharded = PagedEngine(params, cfg, slots=1, n_blocks=16, block_size=8,
+                          max_seq=64, mesh=make_mesh({"tp": 2}))
+    b = sharded.submit(_cycle_prompt(5), max_new=6)
+    assert np.array_equal(sharded.run()[b], want)
+
+
+def test_tp_mesh_rejects_indivisible_heads(trained):
+    from tpulab.parallel.mesh import make_mesh
+
+    cfg = LabformerConfig(
+        d_model=32, n_heads=4, n_kv_heads=1, n_layers=2, d_ff=64, max_seq=128
+    )
+    with pytest.raises(ValueError, match="tp=2 must divide kv_heads=1"):
+        PagedEngine(trained, cfg, mesh=make_mesh({"tp": 2}))

@@ -250,7 +250,7 @@ class PagedEngine:
 
     def __init__(self, params, cfg: LabformerConfig, *, slots: int = 4,
                  n_blocks: int = 64, block_size: int = 16,
-                 max_seq: int = 256, prefill_chunk: int = 0):
+                 max_seq: int = 256, prefill_chunk: int = 0, mesh=None):
         if max_seq % block_size:
             raise ValueError("max_seq must be a multiple of block_size")
         if prefill_chunk < 0:
@@ -260,7 +260,38 @@ class PagedEngine:
         self.slots = slots
         self.block_size = block_size
         self.max_blocks = max_seq // block_size
-        self.kpool, self.vpool = init_pools(cfg, n_blocks, block_size)
+        if mesh is None:
+            self.kpool, self.vpool = init_pools(cfg, n_blocks, block_size)
+        else:
+            # tensor-parallel serving: params take their tp shardings
+            # and the pools shard on the kv-head axis — GSPMD partitions
+            # the SAME jitted decode/extend programs across the mesh
+            # (attention is head-independent; the MLP's hidden split
+            # psums exactly like the training step)
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from tpulab.models.labformer import _restrict, shard_params
+            from tpulab.runtime.device import commit
+
+            tp = mesh.shape.get("tp", 1)
+            if cfg.kv_heads % tp or cfg.n_heads % tp:
+                raise ValueError(
+                    f"tp={tp} must divide kv_heads={cfg.kv_heads} "
+                    f"and n_heads={cfg.n_heads}"
+                )
+            self.params = shard_params(params, cfg, mesh)
+            pool_sharding = NamedSharding(
+                mesh, _restrict(P(None, None, None, "tp", None), mesh)
+            )
+            # allocate pools INTO the sharding from host zeros — a
+            # full-size device array staged on one chip first would OOM
+            # exactly the configurations tp-sharded pools exist to fit
+            shape = (cfg.n_layers, n_blocks, block_size, cfg.kv_heads,
+                     cfg.head_dim)
+            host = np.zeros(shape, jnp.zeros((), cfg.dtype).dtype)
+            self.kpool = commit(host, pool_sharding)
+            self.vpool = commit(host, pool_sharding)
+        self.mesh = mesh
         self.n_usable_blocks = n_blocks - 1
         self.free = list(range(1, n_blocks))  # block 0 is TRASH
         self.tables = np.zeros((slots, self.max_blocks), np.int32)

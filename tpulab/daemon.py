@@ -67,18 +67,22 @@ class _GenerateService:
         self.results: dict = {}
         self._stepper_alive: set = set()  # id(engine) while running
 
-    def generate(self, engine, prompt, steps: int):
+    def generate(self, engine, prompt, steps: int, *,
+                 temperature: float = 0.0, seed: int = 0):
         with self.lock:
-            rid = engine.submit(prompt, max_new=steps)
+            rid = engine.submit(prompt, max_new=steps,
+                                temperature=temperature, seed=seed)
             key = id(engine)
+            token = (key, rid)  # engine-scoped: two warm engines' rid
+            # counters both start at 0 and would collide on a bare rid
             if key not in self._stepper_alive:
                 self._stepper_alive.add(key)
                 threading.Thread(
                     target=self._step_loop, args=(engine, key), daemon=True
                 ).start()
-            while rid not in self.results:
+            while token not in self.results:
                 self.cond.wait()
-            out = self.results.pop(rid)
+            out = self.results.pop(token)
             if isinstance(out, Exception):
                 raise RuntimeError(f"engine step failed: {out!r}") from out
             return out
@@ -97,14 +101,14 @@ class _GenerateService:
                         self._stepper_alive.discard(key)
                         return
                     for rid in engine.step():
-                        self.results[rid] = engine._done.pop(rid)
+                        self.results[(key, rid)] = engine._done.pop(rid)
                     self.cond.notify_all()
         except Exception as e:  # fail every request; never hang waiters
             with self.lock:
                 for req in list(engine.pending) + [
                     r for r in engine.active if r is not None
                 ]:
-                    self.results[req.req_id] = e
+                    self.results[(key, req.req_id)] = e
                 engine.pending.clear()
                 engine.active = [None] * engine.slots
                 for k, v in list(_ENGINES.items()):
@@ -178,7 +182,7 @@ def _handle_generate(header: dict, payload: bytes) -> bytes:
     hit the engine's refcounted prefix cache and every request after
     the first skips compilation entirely.  Config keys: ``steps``
     (default 64), ``ckpt_dir`` (trainer snapshot; default random demo
-    weights).  Greedy decode (byte-stream reproducible)."""
+    weights), ``temperature`` + ``seed`` (default greedy)."""
     import numpy as np
 
     config = header.get("config") or {}
@@ -190,7 +194,11 @@ def _handle_generate(header: dict, payload: bytes) -> bytes:
         raise ValueError("empty prompt")
     engine = _engine_for(config.get("ckpt_dir"))
     prompt = np.frombuffer(payload, np.uint8).astype(np.int32)
-    out = _GEN_SERVICE.generate(engine, prompt, steps)
+    out = _GEN_SERVICE.generate(
+        engine, prompt, steps,
+        temperature=float(config.get("temperature", 0.0)),
+        seed=int(config.get("seed", 0)),
+    )
     return bytes(int(t) & 0xFF for t in out)
 
 

@@ -222,6 +222,28 @@ def _scatter_prefill(kpool, vpool, k_seq, v_seq, table_row, start, p,
     return kpool, vpool
 
 
+@jax.jit
+def _sample_tokens(logits, temps, keys):
+    """Per-slot next token: greedy where temperature == 0, else a
+    categorical draw from the slot's own PRNG stream.  Returns
+    ``(tokens (S,), next_keys (S, 2))`` — keys advance every tick so a
+    slot's samples form one deterministic stream per seed."""
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    # split FIRST, then consume one half and carry the other: feeding
+    # the same key to categorical and to the next tick would correlate
+    # consecutive draws (JAX forbids reusing a consumed key)
+    pairs = jax.vmap(lambda k: jax.random.split(k, 2))(keys)  # (S, 2, 2)
+    use, nxt_keys = pairs[:, 0], pairs[:, 1]
+
+    def one(lg, t, k):
+        return jax.random.categorical(
+            k, lg.astype(jnp.float32) / jnp.maximum(t, jnp.float32(1e-6))
+        ).astype(jnp.int32)
+
+    sampled = jax.vmap(one)(logits, temps, use)
+    return jnp.where(temps > 0, sampled, greedy), nxt_keys
+
+
 def _bucket(n: int) -> int:
     b = 16
     while b < n:
@@ -234,6 +256,8 @@ class _Request:
     req_id: int
     prompt: np.ndarray          # (p,) int32
     max_new: int
+    temperature: float = 0.0    # 0 = greedy
+    seed: int = 0
     out: List[int] = field(default_factory=list)
 
 
@@ -245,8 +269,9 @@ class PagedEngine:
     ``step()`` admits queued requests into free slots (when enough
     blocks are free) and advances every active slot one token;
     ``run()`` drains everything and returns {req_id: generated
-    tokens}.  Greedy decode; outputs match ``generate`` greedy
-    per-request."""
+    tokens}.  Greedy by default (outputs match ``generate`` greedy
+    per-request); per-request temperature/seed opt into sampled
+    slots that coexist with greedy ones in the same batch."""
 
     def __init__(self, params, cfg: LabformerConfig, *, slots: int = 4,
                  n_blocks: int = 64, block_size: int = 16,
@@ -297,6 +322,10 @@ class PagedEngine:
         self.tables = np.zeros((slots, self.max_blocks), np.int32)
         self.lengths = np.zeros(slots, np.int32)
         self.last_tok = np.zeros(slots, np.int32)
+        # per-slot sampling state: temperature 0 = greedy; each sampled
+        # request walks its own PRNG stream (seeded at admission)
+        self.temps = np.zeros(slots, np.float32)
+        self.keys = np.zeros((slots, 2), np.uint32)
         self.active: List[Optional[_Request]] = [None] * slots
         self.pending: List[_Request] = []
         self._done: Dict[int, np.ndarray] = {}
@@ -321,7 +350,11 @@ class PagedEngine:
         }
 
     # ------------------------------------------------------------- admission
-    def submit(self, prompt, max_new: int) -> int:
+    def submit(self, prompt, max_new: int, *, temperature: float = 0.0,
+               seed: int = 0) -> int:
+        """Queue a request.  ``temperature == 0`` decodes greedily;
+        otherwise the slot samples from its own seeded PRNG stream —
+        per-request sampling coexists with greedy slots in one batch."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if len(prompt) == 0:
             raise ValueError("empty prompt")
@@ -329,6 +362,8 @@ class PagedEngine:
             # step() appends before checking the budget, so 0 would
             # still emit one token — refuse instead of off-by-one-ing
             raise ValueError(f"max_new must be >= 1, got {max_new}")
+        if not temperature >= 0:  # rejects negatives AND NaN
+            raise ValueError(f"temperature must be >= 0, got {temperature}")
         need = self._blocks_needed(len(prompt) + max_new)
         if need > min(self.max_blocks, self.n_usable_blocks):
             raise ValueError(
@@ -338,7 +373,9 @@ class PagedEngine:
             )
         rid = self._next_id
         self._next_id += 1
-        self.pending.append(_Request(rid, prompt, max_new))
+        self.pending.append(
+            _Request(rid, prompt, max_new, float(temperature), int(seed))
+        )
         return rid
 
     def _blocks_needed(self, n_positions: int) -> int:
@@ -403,6 +440,10 @@ class PagedEngine:
             self.tables[s] = row
             self._prefill_slot(s, req, row, shared_pos)
             self._register_prefix(req.prompt, row)
+            self.temps[s] = req.temperature
+            self.keys[s] = np.asarray(
+                jax.random.PRNGKey(req.seed), np.uint32
+            )
             self.active[s] = req
 
     def _register_prefix(self, prompt: np.ndarray, row: np.ndarray):
@@ -476,7 +517,14 @@ class PagedEngine:
             jnp.asarray(self.tables), jnp.asarray(self.lengths),
             self.cfg, self.block_size,
         )
-        nxt = np.asarray(jnp.argmax(logits, -1).astype(jnp.int32))
+        toks, new_keys = _sample_tokens(
+            logits, jnp.asarray(self.temps),
+            jnp.asarray(self.keys, jnp.uint32),
+        )
+        nxt = np.asarray(toks)
+        # np.array (copy), not np.asarray: a zero-copy view of a jax
+        # buffer is read-only, and admission writes keys[s] in place
+        self.keys = np.array(new_keys, np.uint32)
         self.counters["ticks"] += 1
         finished = []
         for s, req in enumerate(self.active):
@@ -492,6 +540,7 @@ class PagedEngine:
                     self._deref(int(b))
                 self.tables[s] = TRASH
                 self.lengths[s] = 0
+                self.temps[s] = 0.0
                 self.active[s] = None
                 self._done[req.req_id] = np.asarray(req.out, np.int32)
                 self.counters["requests_done"] += 1

@@ -311,3 +311,17 @@ class TestDaemonConcurrency:
         delta = stats["ticks"] - ticks0
         assert delta < 4 * steps, delta
         assert stats["requests_done"] >= 4
+
+
+class TestDaemonSampling:
+    def test_sampled_generation_seeded_over_socket(self, daemon):
+        h = (b'{"lab": "generate", '
+             b'"config": {"steps": 8, "temperature": 1.5, "seed": 11}}')
+        s1, a = _raw_request_bytes(daemon, h, b"xyz")
+        s2, b = _raw_request_bytes(daemon, h, b"xyz")
+        assert s1 == 0 and s2 == 0 and a == b  # one stream per seed
+        g = b'{"lab": "generate", "config": {"steps": 8}}'
+        s3, greedy = _raw_request_bytes(daemon, g, b"xyz")
+        assert s3 == 0 and len(greedy) == 8
+        # hot sampling almost surely diverges from greedy within 8 bytes
+        assert a != greedy

@@ -187,3 +187,38 @@ class TestSamplingFilters:
                        top_p=0.0, seed=1)
         greedy = generate(params, prompt, CFG, steps=5, temperature=0.0)
         assert np.array_equal(out, greedy)
+
+
+def test_load_params_ignores_optimizer_stack(tmp_path):
+    """Checkpoints trained with ANY optax stack (clipping + schedules
+    change the chain's pytree length) must load for inference — the
+    restore is params-only/partial."""
+    from tpulab.models.generate import load_params
+    from tpulab.train import train
+
+    cfg = LabformerConfig(d_model=16, n_heads=2, n_layers=1, d_ff=32,
+                          max_seq=64)
+    train(steps=2, batch=2, seq=16, cfg=cfg, ckpt_dir=str(tmp_path),
+          save_every=1, lr=1e-3, clip_norm=1.0, schedule="cosine",
+          warmup_steps=1, log=lambda *a: None)
+    params, step = load_params(cfg, str(tmp_path))
+    assert step == 2
+    out = forward(params, jnp.zeros((1, 4), jnp.int32), cfg)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_load_params_from_mesh_trained_checkpoint(tmp_path):
+    """A snapshot saved by MESH training (NamedSharding leaves in the
+    checkpoint) must load for single-process inference — restore targets
+    come from the live template, not the checkpoint's sharding file."""
+    from tpulab.models.generate import load_params
+    from tpulab.train import train
+
+    cfg = LabformerConfig(d_model=16, n_heads=2, n_layers=2, d_ff=32,
+                          max_seq=64)
+    train(steps=2, batch=4, seq=16, cfg=cfg, ckpt_dir=str(tmp_path),
+          save_every=1, mesh_devices=2, log=lambda *a: None)
+    params, step = load_params(cfg, str(tmp_path))
+    assert step == 2
+    out = forward(params, jnp.zeros((1, 4), jnp.int32), cfg)
+    assert np.isfinite(np.asarray(out)).all()

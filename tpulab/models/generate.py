@@ -269,7 +269,13 @@ def demo_config() -> LabformerConfig:
 def load_params(cfg: LabformerConfig, ckpt_dir: Optional[str] = None,
                 seed: int = 0):
     """Demo params: random init, or the latest trainer snapshot from
-    ``ckpt_dir``.  Returns (params, step|None)."""
+    ``ckpt_dir``.  Returns (params, step|None).
+
+    Partial restore, params only: inference does not need the optimizer
+    state, and guessing its pytree shape would break on any checkpoint
+    trained with a different optax stack (clipping and schedules change
+    the chain length — the exact mismatch a template-based restore hits).
+    """
     from tpulab.models.labformer import init_params
 
     params = init_params(cfg, seed=seed)
@@ -279,18 +285,23 @@ def load_params(cfg: LabformerConfig, ckpt_dir: Optional[str] = None,
 
     import orbax.checkpoint as ocp
 
-    from tpulab.models.labformer import make_train_step
-
     mgr = ocp.CheckpointManager(os.path.abspath(ckpt_dir))
     step = mgr.latest_step()
     if step is None:
         raise FileNotFoundError(f"no checkpoint found in {ckpt_dir}")
-    optimizer, _ = make_train_step(cfg, None)
     restored = mgr.restore(
         step,
         args=ocp.args.Composite(
-            state=ocp.args.StandardRestore(
-                {"params": params, "opt_state": optimizer.init(params)}
+            state=ocp.args.PyTreeRestore(
+                item={"params": params},
+                # template-derived restore targets, NOT the checkpoint's
+                # sharding file: a mesh-trained snapshot must load on a
+                # single-device server (the file's NamedShardings name
+                # devices that don't exist there)
+                restore_args=ocp.checkpoint_utils.construct_restore_args(
+                    {"params": params}
+                ),
+                partial_restore=True,
             )
         ),
     )

@@ -123,3 +123,51 @@ class TestSortTotalOrder:
         got = np.asarray(sort_ascending(jnp.asarray(x)))
         want = np.sort(x)
         assert np.array_equal(got, want, equal_nan=True)
+
+
+class TestPagedEngineInvariants:
+    """Randomized serving workloads: whatever the mix of prompt lengths,
+    budgets, shared prefixes, slot counts, and chunked prefill, every
+    request's greedy tokens must equal its solo decode, and the pool
+    must account for every block afterward."""
+
+    @settings(max_examples=8, deadline=None)
+    @given(
+        data=st.data(),
+        slots=st.integers(1, 3),
+        n_reqs=st.integers(1, 6),
+        chunk=st.sampled_from([0, 8]),
+        seed=st.integers(0, 2**31),
+    )
+    def test_random_workload_matches_solo_decode(
+        self, trained_small, trained_small_cfg, data, slots, n_reqs,
+        chunk, seed,
+    ):
+        from tpulab.models.generate import generate
+        from tpulab.models.paged import PagedEngine
+
+        cfg = trained_small_cfg
+        rng = np.random.default_rng(seed)
+        shared = (np.arange(17) % 7).astype(np.int32)
+        jobs = []
+        for _ in range(n_reqs):
+            if data.draw(st.booleans(), label="share"):
+                tail = rng.integers(0, 7, rng.integers(1, 5)).astype(np.int32)
+                prompt = np.concatenate([shared, tail])
+            else:
+                prompt = rng.integers(
+                    0, 7, rng.integers(1, 21)).astype(np.int32)
+            jobs.append((prompt, int(rng.integers(1, 8))))
+
+        eng = PagedEngine(trained_small, cfg, slots=slots, n_blocks=32,
+                          block_size=8, max_seq=64, prefill_chunk=chunk)
+        rids = [eng.submit(p, max_new=n) for p, n in jobs]
+        out = eng.run()
+        for rid, (prompt, n) in zip(rids, jobs):
+            want = generate(trained_small, prompt[None, :], cfg, steps=n,
+                            temperature=0.0)[0]
+            assert np.array_equal(out[rid], want), (prompt.tolist(), n)
+        # block accounting: everything not held by the prefix cache is free
+        cached = sum(len(b) for b in eng.prefix_cache.values())
+        assert len(eng.free) == eng.n_usable_blocks - cached
+        assert int(eng.block_refs.sum()) == cached

@@ -14,11 +14,24 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import pathlib
 import sys
 
 ROOT = pathlib.Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(ROOT))
+
+# counters are backend-independent; pin CPU BEFORE jax initializes so
+# the tool runs anywhere (incl. containers whose default platform is a
+# tunneled accelerator that may be unavailable) — same override as
+# tools/gen_fixtures.py and tests/conftest.py.  The env var alone is
+# not enough: the container's sitecustomize updates jax_platforms at
+# interpreter startup, which takes precedence — override the config too.
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 
 def main(argv=None) -> int:

@@ -17,6 +17,7 @@
 //   tl_open(paths, n, batch, row_tokens, prefetch, threads, seed,
 //           start_step, err, errlen) -> handle | NULL
 //   tl_next(handle, out) -> step number delivered, or -1 after close
+//   tl_short_reads(handle) -> rows zero-padded by IO failure so far
 //   tl_close(handle)
 
 #include <atomic>
@@ -59,6 +60,10 @@ struct Loader {
   std::vector<std::thread> workers;
   std::atomic<uint64_t> claim{0};   // next step a worker takes
   std::atomic<bool> stop{false};
+  // rows zero-padded because pread failed or the file shrank; exposed
+  // via tl_short_reads so the consumer can detect corrupted training
+  // rows instead of silently learning token 0 (round-2 advisor)
+  mutable std::atomic<uint64_t> short_reads{0};
 
   std::mutex mu;
   std::condition_variable cv_room;  // producers: buffer has room
@@ -94,6 +99,7 @@ struct Loader {
         ssize_t n = pread(f.fd, buf.data() + got, row_bytes - got, off + got);
         if (n <= 0) {  // unexpected shrink: zero-fill rather than hang
           std::memset(buf.data() + got, 0, row_bytes - got);
+          short_reads.fetch_add(1);
           break;
         }
         got += n;
@@ -179,6 +185,11 @@ long long tl_next(void* handle, int32_t* out) {
   ld->next_out = step + 1;
   ld->cv_room.notify_all();
   return (long long)step;
+}
+
+unsigned long long tl_short_reads(void* handle) {
+  return (unsigned long long)
+      static_cast<Loader*>(handle)->short_reads.load();
 }
 
 void tl_close(void* handle) { delete static_cast<Loader*>(handle); }

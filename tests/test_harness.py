@@ -56,6 +56,29 @@ class TestLab1Sweep:
         stats = pd.read_csv(tmp_path / "stats_lab1_tpu.csv")
         assert set(stats["device"]) == {"TPU", "CPU"}
 
+    def test_return_inp_and_task_res_columns(self, tmp_path):
+        """--return_inp/--return_task_res debug columns (reference
+        run_test.py:44-49): raw stdin payload + parsed task result land
+        in the runs CSV only when requested."""
+        target = InProcessTarget(
+            name="lab1_dbg", workload="lab1", config={"warmup": 0, "reps": 1}
+        )
+        proc = Lab1Processor(seed=5, size_min=8, size_max=16)
+        df = run_tester(
+            make_tester(target, tmp_path, k_times=1,
+                        return_inp=True, return_task_res=True),
+            proc,
+        )
+        assert "input_str" in df.columns and "task_result" in df.columns
+        # the recorded stdin payload starts with the vector length line
+        n = int(str(df["input_str"].iloc[0]).split()[0])
+        assert 8 <= n <= 16
+        df2 = run_tester(
+            make_tester(target, tmp_path / "plain",
+                        k_times=1), Lab1Processor(seed=5, size_min=8, size_max=16)
+        )
+        assert "input_str" not in df2.columns and "task_result" not in df2.columns
+
     def test_verification_gate_withholds_stats(self, tmp_path):
         # add-op processor against a subtract-computing target -> all fail
         target = InProcessTarget(

@@ -11,7 +11,7 @@ from typing import Any, Dict
 
 import numpy as np
 
-from tpulab.bench import CUDA_BASELINES_MS
+from tpulab.bench import CUDA_BASELINES_MS, variance_fields
 
 
 def _test_image(h: int = 1024, w: int = 1024) -> np.ndarray:
@@ -38,7 +38,11 @@ def bench_lab2(size: int = 1024, reps: int = 30, use_pallas=None) -> Dict[str, A
         fn = lambda img: roberts_pallas(img, interpret=device.platform != "tpu")
     else:
         fn = roberts_edges
-    ms, _ = measure_kernel_ms(fn, (x,), iters=max(reps, 500), outer=5)
+    samples: list = []
+    # headline is a ~24us kernel: 11 outer trials + IQR tame the ±30%
+    # run-to-run tails (round-2 verdict, weak #4)
+    ms, _ = measure_kernel_ms(fn, (x,), iters=max(reps, 500), outer=11,
+                              collect=samples)
     base = CUDA_BASELINES_MS["lab2_roberts_1024"]
     return {
         "metric": f"lab2_roberts_{size}x{size}_median_ms",
@@ -46,6 +50,7 @@ def bench_lab2(size: int = 1024, reps: int = 30, use_pallas=None) -> Dict[str, A
         "unit": "ms",
         "vs_baseline": round(base / ms, 3),
         "device": device.platform,
+        **variance_fields(samples),
     }
 
 
@@ -66,11 +71,14 @@ def bench_lab3(size: int = 1024, nc: int = 8, reps: int = 30, use_pallas=None) -
     stats = class_statistics(img, classes)
     device = default_device()
     fn, args = classify_staged(img, stats, use_pallas=use_pallas)
-    ms, _ = measure_kernel_ms(fn, args, iters=max(reps, 500), outer=5)
+    samples: list = []
+    ms, _ = measure_kernel_ms(fn, args, iters=max(reps, 500), outer=11,
+                              collect=samples)
     return {
         "metric": f"lab3_classify_{size}x{size}_nc{nc}_median_ms",
         "value": round(ms, 6),
         "unit": "ms",
         "vs_baseline": None,  # no published lab3 baseline (BASELINE.md)
         "device": device.platform,
+        **variance_fields(samples),
     }

@@ -34,27 +34,87 @@ import traceback
 from typing import Optional
 
 
+# Wire-size ceilings: the length prefixes are attacker-controlled (any
+# local process can connect), so cap them before allocating.  The
+# payload ceiling fits the largest documented workload input (lab2 at
+# its 1e8-px bound serializes to ~0.9 GB of hex text) with headroom;
+# override via TPULAB_DAEMON_MAX_PAYLOAD for bigger custom runs.
+MAX_HEADER_BYTES = 1 << 20
+MAX_PAYLOAD_BYTES = int(
+    os.environ.get("TPULAB_DAEMON_MAX_PAYLOAD", 2 << 30)
+)
+#: concurrent connection-handler threads (each may hold a payload
+#: buffer); excess connections queue in accept order
+MAX_CONN_THREADS = 32
+#: AGGREGATE staged-payload ceiling across all connections — the
+#: per-connection cap alone would still let MAX_CONN_THREADS clients
+#: stage MAX_CONN_THREADS x MAX_PAYLOAD_BYTES concurrently
+MAX_TOTAL_PAYLOAD_BYTES = int(
+    os.environ.get("TPULAB_DAEMON_MAX_TOTAL_PAYLOAD", 4 << 30)
+)
+
+
 def _recv_exact(conn: socket.socket, n: int) -> bytes:
-    buf = b""
-    while len(buf) < n:
-        chunk = conn.recv(n - len(buf))
-        if not chunk:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = conn.recv_into(view[got:], n - got)
+        if r == 0:
             raise ConnectionError("peer closed mid-message")
-        buf += chunk
-    return buf
+        got += r
+    return bytes(buf)
+
+
+class _ByteBudget:
+    """Aggregate allocation budget: payload reads block until they fit.
+
+    A single request within the per-connection cap always proceeds when
+    it is alone (``used > 0`` guard), so the budget throttles floods
+    without deadlocking a legitimate large payload."""
+
+    def __init__(self, total: int):
+        self.total = total
+        self.used = 0
+        self.cond = threading.Condition()
+
+    def acquire(self, n: int) -> None:
+        with self.cond:
+            while self.used > 0 and self.used + n > self.total:
+                self.cond.wait()
+            self.used += n
+
+    def release(self, n: int) -> None:
+        with self.cond:
+            self.used -= n
+            self.cond.notify_all()
 
 
 _ENGINES: "dict" = {}  # realpath|None -> (loaded_step, engine); LRU, max 2
+
+
+class _EngineState:
+    """Per-engine stepping state: its own condition + results map, so
+    two warm engines' steppers (and their waiters) never serialize
+    behind each other's device dispatch (round-2 advisor: one global
+    lock held across engine.step() stalled everything per tick)."""
+
+    def __init__(self):
+        self.cond = threading.Condition()
+        self.results: dict = {}
+        self.stepper_alive = False
 
 
 class _GenerateService:
     """Cross-connection continuous batching.
 
     Each connection thread calls :meth:`generate`; submissions land in
-    the shared PagedEngine under one lock, and a single stepper thread
-    advances ALL active slots together — concurrent clients ride the
-    same batched decode step instead of queueing whole requests behind
-    each other.  Results fan back out through a condition variable.
+    the shared PagedEngine under that ENGINE's condition, and a single
+    per-engine stepper thread advances all its active slots together —
+    concurrent clients ride the same batched decode step instead of
+    queueing whole requests behind each other.  ``self.lock`` is only
+    the short-held registry lock (_ENGINES cache + state lookup); it is
+    never held across device compute.
 
     Failure policy: if a step raises, the stepper fails EVERY request
     on that engine (each waiter re-raises a clear error instead of
@@ -63,59 +123,70 @@ class _GenerateService:
 
     def __init__(self):
         self.lock = threading.Lock()
-        self.cond = threading.Condition(self.lock)
-        self.results: dict = {}
-        self._stepper_alive: set = set()  # id(engine) while running
+        # weak keys: an engine evicted from _ENGINES (LRU overflow /
+        # checkpoint-stamp change) drops its state with it once the
+        # stepper exits — no leak, and no id()-recycling collision
+        # handing a fresh engine a dead engine's Condition
+        import weakref
+
+        self._states: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+    def _state_for(self, engine) -> _EngineState:
+        with self.lock:
+            st = self._states.get(engine)
+            if st is None:
+                st = self._states[engine] = _EngineState()
+            return st
 
     def generate(self, engine, prompt, steps: int, *,
                  temperature: float = 0.0, seed: int = 0):
-        with self.lock:
+        st = self._state_for(engine)
+        with st.cond:
             rid = engine.submit(prompt, max_new=steps,
                                 temperature=temperature, seed=seed)
-            key = id(engine)
-            token = (key, rid)  # engine-scoped: two warm engines' rid
-            # counters both start at 0 and would collide on a bare rid
-            if key not in self._stepper_alive:
-                self._stepper_alive.add(key)
+            if not st.stepper_alive:
+                st.stepper_alive = True
                 threading.Thread(
-                    target=self._step_loop, args=(engine, key), daemon=True
+                    target=self._step_loop, args=(engine, st), daemon=True
                 ).start()
-            while token not in self.results:
-                self.cond.wait()
-            out = self.results.pop(token)
+            while rid not in st.results:
+                st.cond.wait()
+            out = st.results.pop(rid)
             if isinstance(out, Exception):
                 raise RuntimeError(f"engine step failed: {out!r}") from out
             return out
 
-    def _step_loop(self, engine, key):
+    def _step_loop(self, engine, st: _EngineState):
         try:
             while True:
-                with self.lock:
+                with st.cond:
                     if not engine.pending and not any(
                         r is not None for r in engine.active
                     ):
-                        # discard INSIDE this locked region: after the
+                        # clear INSIDE this locked region: after the
                         # lock drops, a submitter must either see the
                         # stepper alive (and it still is) or dead (and
                         # spawn a fresh one) — never a dead flag-alive
-                        self._stepper_alive.discard(key)
+                        st.stepper_alive = False
                         return
                     for rid in engine.step():
-                        self.results[(key, rid)] = engine._done.pop(rid)
-                    self.cond.notify_all()
+                        st.results[rid] = engine._done.pop(rid)
+                    st.cond.notify_all()
         except Exception as e:  # fail every request; never hang waiters
-            with self.lock:
+            with st.cond:
                 for req in list(engine.pending) + [
                     r for r in engine.active if r is not None
                 ]:
-                    self.results[(key, req.req_id)] = e
+                    st.results[req.req_id] = e
                 engine.pending.clear()
                 engine.active = [None] * engine.slots
+                st.stepper_alive = False
+                st.cond.notify_all()
+            with self.lock:
                 for k, v in list(_ENGINES.items()):
                     if v[1] is engine:
                         _ENGINES.pop(k)
-                self._stepper_alive.discard(key)
-                self.cond.notify_all()
+                self._states.pop(engine, None)
 
 
 _GEN_SERVICE = _GenerateService()
@@ -208,9 +279,11 @@ def _handle_generate_stats(header: dict) -> bytes:
     config = header.get("config") or {}
     key = config.get("ckpt_dir")
     key = os.path.realpath(key) if key else None
-    with _GEN_SERVICE.lock:
+    with _GEN_SERVICE.lock:  # registry lookup only — short-held
         hit = _ENGINES.get(key)
-        stats = hit[1].stats() if hit else {}
+    # stats() reads flat counters/lengths; calling it OUTSIDE any lock
+    # keeps observability from queueing behind a decode tick
+    stats = hit[1].stats() if hit else {}
     return json.dumps(stats).encode("utf-8")
 
 
@@ -270,15 +343,37 @@ def serve(socket_path: str, *, max_requests: Optional[int] = None) -> None:
     served = {"n": 0}
     served_lock = threading.Lock()
 
+    conn_sem = threading.Semaphore(MAX_CONN_THREADS)
+    budget = _ByteBudget(MAX_TOTAL_PAYLOAD_BYTES)
+
     def _handle_conn(conn):
         # per-connection thread: long generate requests batch through
         # the shared engine instead of blocking lab traffic (and each
         # other) behind a serial accept loop
+        held = 0
         try:
             raw = _recv_exact(conn, 4)
             (hlen,) = struct.unpack("<I", raw)
+            if hlen > MAX_HEADER_BYTES:
+                raise ConnectionError(f"header length {hlen} exceeds cap")
             header = json.loads(_recv_exact(conn, hlen))
             (plen,) = struct.unpack("<Q", _recv_exact(conn, 8))
+            if plen > MAX_PAYLOAD_BYTES:
+                # tell the client why, then DRAIN (bounded by a socket
+                # timeout) so its pipelined body send completes and it
+                # can actually read the error frame before our close
+                err = (f"payload length {plen} exceeds cap "
+                       f"{MAX_PAYLOAD_BYTES}").encode()
+                conn.sendall(struct.pack("<BQ", 1, len(err)) + err)
+                conn.settimeout(5.0)
+                try:
+                    while conn.recv(1 << 16):
+                        pass
+                except OSError:
+                    pass
+                raise ConnectionError("oversized payload")
+            budget.acquire(plen)
+            held = plen
             payload = _recv_exact(conn, plen)
             try:
                 out = handle_request(header, payload)
@@ -289,7 +384,10 @@ def serve(socket_path: str, *, max_requests: Optional[int] = None) -> None:
         except ConnectionError:
             pass
         finally:
+            if held:
+                budget.release(held)
             conn.close()
+            conn_sem.release()
             with served_lock:
                 served["n"] += 1
 
@@ -297,6 +395,9 @@ def serve(socket_path: str, *, max_requests: Optional[int] = None) -> None:
         accepted = 0
         while not stop["flag"]:
             conn, _ = srv.accept()
+            # bound handler threads: accept stalls at the cap instead of
+            # letting a flood of connections each stage a payload buffer
+            conn_sem.acquire()
             threading.Thread(
                 target=_handle_conn, args=(conn,), daemon=True
             ).start()

@@ -52,6 +52,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--k-times", "--k_times", type=int, default=20)
     p.add_argument("--kernel-sizes", "--kernel_sizes", default=None,
                    help="JSON list of per-lab launch configs")
+    p.add_argument("--return-inp", "--return_inp", dest="return_inp",
+                   action="store_true",
+                   help="record each run's raw stdin payload as a CSV column "
+                        "(reference run_test.py:44-45)")
+    p.add_argument("--return-task-res", "--return_task_res", dest="return_task_res",
+                   action="store_true",
+                   help="record each run's parsed task result as a CSV column "
+                        "(reference run_test.py:47-49)")
     p.add_argument("--metadata-columns2plot", "--metadata_columns2plot", default="[]")
     p.add_argument("--artifact-dir", "--artifact_dir", dest="artifact_dir", default=None)
     p.add_argument("--backend", default=None)
@@ -120,6 +128,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         kernel_sizes=kernel_sizes,
         artifact_dir=artifact_dir,
         metadata_columns2plot=json.loads(args.metadata_columns2plot),
+        return_inp=args.return_inp,
+        return_task_res=args.return_task_res,
     )
     df = asyncio.run(tester.run_experiments(processor))
     return 0 if bool((df["verified"] == True).all()) else 1  # noqa: E712

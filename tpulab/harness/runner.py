@@ -81,11 +81,17 @@ async def run_once(
     processor: WorkloadProcessor,
     kernel_size=None,
     device_info: str = "",
+    return_inp: bool = False,
+    return_task_res: bool = False,
 ) -> RunRecord:
     """Execute one run end-to-end: pre_process -> target -> parse -> verify.
 
     Failures of any stage are captured into the record (the reference's
     blanket except -> failed-row behavior, tester.py:144-166), never raised.
+    ``return_inp`` stashes the full stdin payload in the row (reference
+    tester.py:123-124: ``debug_data["input_str"]``); ``return_task_res``
+    keeps the parsed task result as a row column (reference
+    tester.py:254-258 drops it from the CSV unless the flag is set).
     """
     record = RunRecord(
         bin_name=target.name,
@@ -98,6 +104,8 @@ async def run_once(
         prepared = await processor.pre_process(device_info=device_info)
         record.metadata.update(prepared.metadata)
         prefix = processor.serialize_kernel_size(kernel_size)
+        if return_inp:
+            record.metadata["input_str"] = prefix + prepared.stdin_text
         stdout = await target.execute(prefix + prepared.stdin_text, sweep=bool(prefix))
         first, _, payload = stdout.partition("\n")
         record.time_kernel_ms = parse_timing_line(first)
@@ -110,6 +118,8 @@ async def run_once(
             # target) so charts/stats can expose misattribution.
             record.device_reported = parse_timing_device(first)
         result = await processor.load_result(payload, prepared)
+        if return_task_res:
+            record.metadata["task_result"] = result
         record.verified = await processor.verify(result, prepared)
     except Exception:
         record.error = traceback.format_exc(limit=8)

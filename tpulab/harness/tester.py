@@ -51,6 +51,8 @@ class Tester:
         artifact_dir: str = ".",
         metadata_columns2plot: Optional[List[str]] = None,
         max_concurrency: int = 1,
+        return_inp: bool = False,
+        return_task_res: bool = False,
         log=print,
     ):
         self.target = target
@@ -60,6 +62,11 @@ class Tester:
         self.artifact_dir = artifact_dir
         self.metadata_columns2plot = metadata_columns2plot or []
         self.max_concurrency = max(1, max_concurrency)
+        #: debug columns (reference run_test.py:44-49): ``return_inp``
+        #: adds the raw stdin payload per row, ``return_task_res`` the
+        #: parsed task result — both land in the runs/stats CSVs.
+        self.return_inp = return_inp
+        self.return_task_res = return_task_res
         self.log = log
 
     async def run_target_sweep(
@@ -71,7 +78,11 @@ class Tester:
         async def one(ks):
             device_info = f"{target.name}__{ks}"
             async with sem:
-                return await run_once(target, processor, ks, device_info=device_info)
+                return await run_once(
+                    target, processor, ks, device_info=device_info,
+                    return_inp=self.return_inp,
+                    return_task_res=self.return_task_res,
+                )
 
         tasks = [
             asyncio.create_task(one(ks))

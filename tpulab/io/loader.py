@@ -48,6 +48,10 @@ def _load():
                                 ctypes.POINTER(ctypes.c_int32)]
         lib.tl_close.restype = None
         lib.tl_close.argtypes = [ctypes.c_void_p]
+        # older prebuilt .so may lack the counter; degrade to None
+        if hasattr(lib, "tl_short_reads"):
+            lib.tl_short_reads.restype = ctypes.c_ulonglong
+            lib.tl_short_reads.argtypes = [ctypes.c_void_p]
         _lib = lib
     return _lib
 
@@ -104,6 +108,14 @@ class TokenLoader:
             raise RuntimeError("loader stopped")
         self.last_step = int(step)
         return self._buf.copy()
+
+    def short_reads(self) -> Optional[int]:
+        """Rows zero-padded by IO failure (pread error / file shrank)
+        since open — nonzero means some training rows were corrupted to
+        token 0; None if the built .so predates the counter."""
+        if self._h is None or not hasattr(self._lib, "tl_short_reads"):
+            return None
+        return int(self._lib.tl_short_reads(self._h))
 
     def close(self):
         if getattr(self, "_h", None):

@@ -403,6 +403,16 @@ class PagedEngine:
             for b in blocks:
                 self._deref(b)
 
+    def _evictable_blocks(self) -> int:
+        """Blocks the cache alone holds — the number eviction could
+        actually return to the free list (blocks a live request or an
+        admission pin also references stay allocated regardless)."""
+        cache_refs: Dict[int, int] = {}
+        for blocks in self.prefix_cache.values():
+            for b in blocks:
+                cache_refs[b] = cache_refs.get(b, 0) + 1
+        return sum(1 for b, n in cache_refs.items() if self.block_refs[b] == n)
+
     def _deref(self, block: int):
         self.block_refs[block] -= 1
         assert self.block_refs[block] >= 0, "block refcount underflow"
@@ -423,7 +433,13 @@ class PagedEngine:
             need_total = self._blocks_needed(len(req.prompt) + req.max_new)
             need_new = need_total - len(shared)
             if need_new > len(self.free):
-                self._evict_prefixes(need_new)
+                # evict ONLY when eviction can actually admit the head
+                # request this tick; otherwise a stalled head would strip
+                # the cache (and its own matched prefix) a little more
+                # every tick while still not getting in — losing the
+                # compute-dedup it just matched (round-2 advisor)
+                if need_new <= len(self.free) + self._evictable_blocks():
+                    self._evict_prefixes(need_new)
             if need_new > len(self.free):
                 for b in shared:  # unpin; retry after a release
                     self._deref(b)

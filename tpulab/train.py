@@ -188,7 +188,7 @@ def train(
 
         _eval_fn = jax.jit(_vision_loss, static_argnums=(3,))
 
-        def eval_loss(params):
+        def eval_loss(params, step: int = 0):
             import jax.numpy as jnp
 
             tot = 0.0
@@ -264,24 +264,29 @@ def train(
             # validation from the SAME corpus, different sampling seed:
             # fresh random windows the training stream almost surely
             # never visited — without this, eval would score synthetic
-            # tokens unrelated to what the model trains on
-            def eval_loss(params):
-                if "val" not in _box:
-                    from tpulab.io.loader import TokenLoader
+            # tokens unrelated to what the model trains on.  The val
+            # stream position is a pure function of the TRAIN step
+            # (eval #n reads val-stream steps [n*eval_batches, ...)), so
+            # a resumed run replays the same validation windows at the
+            # same steps as the original (round-2 advisor: a monotonic
+            # shared loader made val curves non-resume-reproducible)
+            def eval_loss(params, step: int = 0):
+                from tpulab.io.loader import TokenLoader
 
-                    _box["val"] = TokenLoader.from_dir(
-                        data_dir, batch=batch, row_tokens=seq + 1,
-                        seed=seed + 104729,
-                    )
-                return sum(
-                    float(_eval_fn(params, _box["val"].next(), cfg, mesh))
-                    for _ in range(eval_batches)
-                ) / eval_batches
+                n_eval = step // eval_every if eval_every else 0
+                with TokenLoader.from_dir(
+                    data_dir, batch=batch, row_tokens=seq + 1,
+                    seed=seed + 104729, start_step=n_eval * eval_batches,
+                ) as val:
+                    return sum(
+                        float(_eval_fn(params, val.next(), cfg, mesh))
+                        for _ in range(eval_batches)
+                    ) / eval_batches
         else:
             # disjoint seed space: the training stream hashes (seed<<20)^step
             val_at = batches(cfg.vocab, batch, seq, seed + 104729)
 
-            def eval_loss(params):
+            def eval_loss(params, step: int = 0):
                 return sum(
                     float(_eval_fn(params, val_at(j), cfg, mesh))
                     for j in range(eval_batches)
@@ -353,7 +358,7 @@ def train(
                     raise FloatingPointError(f"non-finite loss {loss} at step {step}")
                 log(f"[train] step {step} loss {loss:.4f} ({dt:.1f} ms)")
                 if eval_every and (step + 1) % eval_every == 0:
-                    val = eval_loss(params)
+                    val = eval_loss(params, step)
                     log(f"[eval] step {step} val_loss {val:.4f}")
                 if manager and (step + 1) % save_every == 0:
                     import orbax.checkpoint as ocp
@@ -368,6 +373,16 @@ def train(
                     )
     finally:
         for _ld in _box.values():
+            # IO failures during streaming degrade rows to token 0; the
+            # loader counts them (native tl_short_reads) — surface loudly
+            n_short = None
+            try:
+                n_short = _ld.short_reads()
+            except Exception:
+                pass
+            if n_short:
+                log(f"[train] WARNING: {n_short} rows zero-padded by "
+                    f"short reads (IO errors) during streaming")
             _ld.close()
     if manager:
         manager.wait_until_finished()

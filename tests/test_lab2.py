@@ -52,6 +52,26 @@ class TestGolden:
         out = np.asarray(roberts_edges(img))
         np.testing.assert_array_equal(out, roberts_oracle_c(img))
 
+    def test_committed_showcase_pair_bit_exact(self):
+        """The committed 512x512 before/after pair (data/lab2/showcase,
+        the reference lab2/test_data analog) stays bit-exact to the op:
+        edges(committed input) == committed output, and the .png mirrors
+        hold the same pixels as the .data files."""
+        import os
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        show = os.path.join(repo, "data/lab2/showcase")
+        inp = load_image(os.path.join(show, "cityline_512.data"))
+        expect = load_image(os.path.join(show, "cityline_512_roberts.data"))
+        assert inp.shape == (512, 512, 4)
+        np.testing.assert_array_equal(np.asarray(roberts_edges(inp)), expect)
+        np.testing.assert_array_equal(
+            load_image(os.path.join(show, "cityline_512.png")), inp
+        )
+        np.testing.assert_array_equal(
+            load_image(os.path.join(show, "cityline_512_roberts.png")), expect
+        )
+
     def test_random_images_vs_oracle(self, rng):
         for h, w in [(1, 1), (1, 5), (3, 3), (17, 31), (64, 129)]:
             img = rng.integers(0, 256, size=(h, w, 4), dtype=np.uint8)

@@ -1,0 +1,119 @@
+"""Generate the committed lab2 before/after showcase pair.
+
+The reference ships a human-scale demonstration image with its processed
+output (``/root/reference/lab2/test_data/``: lenna.data at 512x512 plus
+the Roberts-filtered result) so a reader can SEE what the kernel does.
+This tool produces tpulab's equivalent: a deterministic photo-class
+512x512 RGBA scene (synthetic — no third-party image rights involved),
+run through the same ``roberts_edges`` op the lab2 workload uses, both
+sides committed as ``.data`` (the suite's raw format) and ``.png``.
+
+Usage: python tools/make_showcase.py [--out data/lab2/showcase]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import numpy as np
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+
+def photo_scene(size: int = 512, seed: int = 1973) -> np.ndarray:
+    """Deterministic photo-class RGBA test scene.
+
+    Built from the feature families edge detectors are demonstrated on:
+    smooth gradients (sky), a disc with soft shading (sun), overlapping
+    rectangles (buildings) with window grids, a sinusoidal ridge line
+    (hills), and film-grain noise so flat regions aren't digitally flat.
+    """
+    rng = np.random.default_rng(seed)
+    y, x = np.mgrid[0:size, 0:size].astype(np.float32) / size
+
+    # sky: vertical gradient, slightly warm at the horizon
+    r = 40 + 120 * y
+    g = 60 + 110 * y
+    b = 120 + 90 * y
+
+    # sun disc with soft limb
+    d_sun = np.hypot(x - 0.72, y - 0.22)
+    sun = np.clip(1.0 - d_sun / 0.11, 0.0, 1.0) ** 0.5
+    r = r + 180 * sun
+    g = g + 150 * sun
+    b = b + 60 * sun
+
+    # hills: everything below a sinusoidal ridge darkens
+    ridge = 0.55 + 0.08 * np.sin(x * 9.2) + 0.05 * np.sin(x * 23.1 + 1.7)
+    hill = (y > ridge).astype(np.float32)
+    r = r * (1 - hill) + hill * (30 + 40 * y)
+    g = g * (1 - hill) + hill * (70 + 50 * y)
+    b = b * (1 - hill) + hill * (35 + 30 * y)
+
+    # buildings: overlapping rectangles with window grids
+    for i in range(7):
+        brng = np.random.default_rng(seed + 100 + i)
+        w = brng.uniform(0.06, 0.16)
+        h = brng.uniform(0.15, 0.38)
+        cx = brng.uniform(0.05, 0.95)
+        top = 1.0 - h
+        mask = ((x > cx - w / 2) & (x < cx + w / 2) & (y > top)).astype(
+            np.float32
+        )
+        shade = brng.uniform(0.15, 0.45)
+        r = r * (1 - mask) + mask * 255 * shade * 0.9
+        g = g * (1 - mask) + mask * 255 * shade * 0.95
+        b = b * (1 - mask) + mask * 255 * shade
+        # windows: lit cells on an 8px grid inside the building
+        win = (
+            mask
+            * (np.sin(x * size * np.pi / 8) > 0.6)
+            * (np.sin(y * size * np.pi / 8) > 0.6)
+        ).astype(np.float32)
+        lit = (brng.random() < 0.8) * win
+        r = r * (1 - lit) + lit * 250
+        g = g * (1 - lit) + lit * 220
+        b = b * (1 - lit) + lit * 120
+
+    # film grain
+    grain = rng.normal(0.0, 3.0, (size, size)).astype(np.float32)
+    rgba = np.stack(
+        [
+            np.clip(r + grain, 0, 255),
+            np.clip(g + grain, 0, 255),
+            np.clip(b + grain, 0, 255),
+            np.full((size, size), 255.0, np.float32),
+        ],
+        axis=-1,
+    )
+    return rgba.astype(np.uint8)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--out", default=os.path.join(ROOT, "data/lab2/showcase"))
+    ap.add_argument("--size", type=int, default=512)
+    args = ap.parse_args(argv)
+
+    import jax
+
+    from tpulab.io.imagefile import save_image
+    from tpulab.ops.roberts import roberts_edges
+
+    os.makedirs(args.out, exist_ok=True)
+    scene = photo_scene(args.size)
+    edges = np.asarray(jax.jit(roberts_edges)(scene))
+
+    for name, img in (("cityline_512", scene), ("cityline_512_roberts", edges)):
+        for ext in (".data", ".png"):
+            path = os.path.join(args.out, name + ext)
+            save_image(path, img)
+            print(f"wrote {path} ({os.path.getsize(path)} bytes)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

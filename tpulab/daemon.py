@@ -54,7 +54,10 @@ MAX_TOTAL_PAYLOAD_BYTES = int(
 )
 
 
-def _recv_exact(conn: socket.socket, n: int) -> bytes:
+def _recv_exact(conn: socket.socket, n: int) -> bytearray:
+    # returned as the bytearray itself: a bytes() copy would double the
+    # peak payload footprint outside the _ByteBudget accounting (every
+    # consumer — json.loads, .decode, np.frombuffer — takes bytearray)
     buf = bytearray(n)
     view = memoryview(buf)
     got = 0
@@ -63,7 +66,7 @@ def _recv_exact(conn: socket.socket, n: int) -> bytes:
         if r == 0:
             raise ConnectionError("peer closed mid-message")
         got += r
-    return bytes(buf)
+    return buf
 
 
 class _ByteBudget:
@@ -182,11 +185,16 @@ class _GenerateService:
                 engine.active = [None] * engine.slots
                 st.stepper_alive = False
                 st.cond.notify_all()
+            # the engine leaves the cache (new requests get a fresh
+            # engine) but its state is NOT popped: a thread already
+            # holding this engine keeps the one state/Condition it
+            # submitted under, so at most one stepper can ever run per
+            # engine; the WeakKeyDictionary reclaims the state when the
+            # engine itself is garbage-collected
             with self.lock:
                 for k, v in list(_ENGINES.items()):
                     if v[1] is engine:
                         _ENGINES.pop(k)
-                self._states.pop(engine, None)
 
 
 _GEN_SERVICE = _GenerateService()

@@ -278,10 +278,14 @@ def train(
                     data_dir, batch=batch, row_tokens=seq + 1,
                     seed=seed + 104729, start_step=n_eval * eval_batches,
                 ) as val:
-                    return sum(
+                    out = sum(
                         float(_eval_fn(params, val.next(), cfg, mesh))
                         for _ in range(eval_batches)
                     ) / eval_batches
+                    if val.short_reads():
+                        log(f"[eval] WARNING: {val.short_reads()} val rows "
+                            f"zero-padded by short reads (IO errors)")
+                    return out
         else:
             # disjoint seed space: the training stream hashes (seed<<20)^step
             val_at = batches(cfg.vocab, batch, seq, seed + 104729)

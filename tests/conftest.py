@@ -7,6 +7,7 @@ whatever device is present — see SURVEY.md section 4).
 """
 
 import os
+import pathlib as _pathlib
 
 # Hard override: the container environment pins JAX_PLATFORMS=axon (real
 # TPU tunnel); tests always run on the virtual 8-device CPU mesh.
@@ -19,6 +20,21 @@ flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
 
+# Persistent XLA compilation cache (.jax_cache/, gitignored): on this
+# one-core box the suite's wall time is dominated by recompiling the
+# same small programs every run — warm-cache runs cut minutes off every
+# verification loop.  Env vars (not config calls) so the subprocess
+# targets (`python -m tpulab ...`) share the cache too.
+_cache_dir = _pathlib.Path(__file__).resolve().parent.parent / ".jax_cache"
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", str(_cache_dir))
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
+# The AOT loader logs a full machine-feature dump at E level for every
+# cache hit (XLA records pseudo-features like +prefer-no-scatter that
+# host detection never reports — same machine, cosmetic mismatch);
+# silence the C++ log stream or cached runs drown the pytest output.
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
 # The container's sitecustomize registers the axon PJRT plugin at
 # interpreter startup and calls jax.config.update("jax_platforms",
 # "axon,cpu"), which takes precedence over the env var — override the
@@ -26,6 +42,17 @@ if "xla_force_host_platform_device_count" not in flags:
 import jax
 
 jax.config.update("jax_platforms", "cpu")
+# sitecustomize imported jax BEFORE this conftest set the cache env
+# vars, so the in-process config never saw them — set it explicitly,
+# from the POST-setdefault env values so a caller's own
+# JAX_COMPILATION_CACHE_DIR keeps parent and subprocess targets on one
+# cache (children get the env vars at startup, before their jax import)
+jax.config.update("jax_compilation_cache_dir",
+                  os.environ["JAX_COMPILATION_CACHE_DIR"])
+jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                  float(os.environ["JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS"]))
+jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                  int(os.environ["JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES"]))
 
 import pathlib
 

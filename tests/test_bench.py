@@ -57,6 +57,35 @@ def test_mfu_fields_empty_without_peak_or_flops():
     assert _mfu_fields(0, 1.0, _Dev()) == {}
 
 
+def test_variance_fields_summary():
+    from tpulab.bench import variance_fields
+
+    f = variance_fields([3.0, 1.0, 2.0, 4.0, 5.0])
+    assert f["median_ms"] == 3.0
+    assert f["min_ms"] == 1.0
+    assert f["p25_ms"] == 2.0 and f["p75_ms"] == 4.0
+    assert f["iqr_ms"] == 2.0
+    assert f["n_trials"] == 5
+    assert variance_fields([]) == {}
+
+
+def test_measure_collects_samples():
+    """The collect hook feeds variance_fields: samples arrive in ms and
+    match the reported outer-trial count."""
+    import jax.numpy as jnp
+
+    from tpulab.runtime.timing import measure_ms
+
+    samples = []
+    ms, _ = measure_ms(lambda x: x + 1, (jnp.float32(1.0),), warmup=1,
+                       reps=2, outer=4, collect=samples)
+    import statistics
+
+    assert len(samples) == 4
+    assert min(samples) > 0
+    assert ms == statistics.median(samples)
+
+
 def test_run_benchmarks_isolates_failures(monkeypatch):
     """One broken bench becomes an error row; the rest still run."""
     import tpulab.bench as tb

@@ -130,7 +130,10 @@ class TestMoeAuxLoss:
         params, opt_state, step = init_train_state(cfg, mesh, seed=0)
         tok_sharding = NamedSharding(mesh, _restrict(P("dp", None), mesh))
         data = rng.integers(0, 256, (16, 4, 33)).astype(np.int32)
-        for i in range(100):
+        # 60 mesh steps: collapse (if the aux loss failed) develops well
+        # within this horizon at lr defaults; 100 added 40% runtime for
+        # no extra discrimination on the one-core box
+        for i in range(60):
             tokens = jax.device_put(jnp.asarray(data[i % 16]), tok_sharding)
             params, opt_state, loss = step(params, opt_state, tokens)
         assert np.isfinite(float(loss))

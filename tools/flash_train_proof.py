@@ -1,0 +1,147 @@
+"""On-silicon proof that flash-attention training works end to end.
+
+Round-2 verdict task #2: the claim "flash attention is trainable"
+rested on interpret-mode tests; the Mosaic lowering of the custom_vjp
+backward (``ops/pallas/attention.py``) had never produced a gradient on
+the real chip.  This tool makes the measured claim:
+
+  1. **Grad parity on chip**: at small seq, d(loss)/d(params) through
+     ``attn_impl="flash"`` vs ``attn_impl="dense"`` on identical
+     params/batch — max relative leaf error within tolerance proves the
+     compiled backward computes the same mathematics.
+  2. **Training run through flash**: a short labformer run at seq past
+     the flash threshold (the step differentiates THROUGH the Pallas
+     kernels); a strictly-decreasing-trend, finite loss curve is the
+     working-training evidence.  Loss curve + timings land in the
+     artifact.
+
+Writes ``results/flash_train_tpu.json``.
+
+Usage: python tools/flash_train_proof.py [--steps 30]
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import pathlib
+import sys
+import time
+
+import numpy as np
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+
+def grad_parity(seq: int = 512, b: int = 2):
+    """Max relative grad-leaf error, flash vs dense, same params/batch."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpulab.models.labformer import LabformerConfig, init_params, loss_fn
+
+    errs = {}
+    base = dict(d_model=256, n_heads=4, n_layers=2, d_ff=512, max_seq=seq,
+                dtype=jnp.bfloat16)
+    cfg_f = LabformerConfig(**base, attn_impl="flash")
+    cfg_d = LabformerConfig(**base, attn_impl="dense")
+    params = init_params(cfg_d, seed=0)
+    tokens = np.random.default_rng(0).integers(
+        0, cfg_d.vocab, (b, seq + 1)).astype(np.int32)
+
+    g_f = jax.jit(jax.grad(lambda p: loss_fn(p, tokens, cfg_f, None)))(params)
+    g_d = jax.jit(jax.grad(lambda p: loss_fn(p, tokens, cfg_d, None)))(params)
+    flat_f = jax.tree_util.tree_leaves_with_path(g_f)
+    flat_d = jax.tree_util.tree_leaves(g_d)
+    for (path, lf), ld in zip(flat_f, flat_d):
+        a = np.asarray(lf, np.float32)
+        bb = np.asarray(ld, np.float32)
+        denom = max(float(np.abs(bb).max()), 1e-6)
+        errs[jax.tree_util.keystr(path)] = float(
+            np.abs(a - bb).max() / denom
+        )
+    return errs
+
+
+def train_through_flash(steps: int, seq: int, b: int):
+    """Short real-chip training run whose step differentiates through
+    the Pallas flash kernels (seq past the auto threshold)."""
+    from tpulab.train import train
+
+    losses = []
+    t0 = time.perf_counter()
+    train(
+        model="labformer", steps=steps, batch=b, seq=seq,
+        log=lambda msg: losses.append(msg) if "[train]" in str(msg) else None,
+    )
+    wall = time.perf_counter() - t0
+    curve = []
+    for line in losses:
+        # "[train] step N loss X (Y ms)"
+        parts = line.split()
+        try:
+            curve.append({"step": int(parts[2]), "loss": float(parts[4]),
+                          "ms": float(parts[5].lstrip("("))})
+        except (IndexError, ValueError):
+            pass
+    return curve, wall
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--seq", type=int, default=2048)
+    ap.add_argument("--batch", type=int, default=2)
+    ap.add_argument("--tol", type=float, default=0.06,
+                    help="max relative grad-leaf error (bf16 model: flash "
+                         "and dense round differently through exp/matmuls)")
+    ap.add_argument("--out", default=str(ROOT / "results" / "flash_train_tpu.json"))
+    args = ap.parse_args(argv)
+
+    import jax
+
+    dev = jax.devices()[0]
+    if dev.platform != "tpu":
+        print("refusing: this artifact certifies the compiled Mosaic "
+              "backward on real hardware", file=sys.stderr)
+        return 2
+
+    errs = grad_parity()
+    worst = max(errs.values())
+    curve, wall = train_through_flash(args.steps, args.seq, args.batch)
+    finite = all(np.isfinite(r["loss"]) for r in curve)
+    # trend: mean of last 5 below mean of first 5
+    head = np.mean([r["loss"] for r in curve[:5]]) if len(curve) >= 10 else None
+    tail = np.mean([r["loss"] for r in curve[-5:]]) if len(curve) >= 10 else None
+    report = {
+        "device_kind": dev.device_kind,
+        "grad_parity": {
+            "seq": 512, "worst_rel_err": worst, "tol": args.tol,
+            "ok": bool(worst < args.tol),
+            "n_leaves": len(errs),
+        },
+        "train": {
+            "steps": args.steps, "seq": args.seq, "batch": args.batch,
+            "wall_s": round(wall, 2),
+            "finite": finite,
+            "loss_first5_mean": head, "loss_last5_mean": tail,
+            "decreasing": bool(head is not None and tail < head),
+            "curve": curve,
+        },
+        "ok": bool(worst < args.tol and finite),
+    }
+    out = pathlib.Path(args.out)
+    out.parent.mkdir(parents=True, exist_ok=True)
+    out.write_text(json.dumps(report, indent=2) + "\n")
+    print(json.dumps({k: v for k, v in report.items() if k != "train"},
+                     indent=2))
+    print(f"train: {len(curve)} steps, finite={finite}, "
+          f"first5={head} last5={tail}")
+    print(f"wrote {out}")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

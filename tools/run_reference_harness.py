@@ -112,6 +112,32 @@ def start_daemon(workdir: pathlib.Path, env: dict) -> tuple:
     raise SystemExit("daemon socket never appeared")
 
 
+def daemon_platform(sock_path: str) -> str:
+    """Ask the daemon which backend it computes on (wire protocol of
+    tpulab/daemon.py; 'platform' pseudo-lab)."""
+    import json as _json
+    import struct
+
+    header = _json.dumps({"lab": "platform"}).encode()
+    s = socket.socket(socket.AF_UNIX)
+    s.connect(sock_path)
+    s.sendall(struct.pack("<I", len(header)) + header + struct.pack("<Q", 0))
+    def recv_exact(n):
+        buf = b""
+        while len(buf) < n:
+            chunk = s.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("daemon closed during platform probe")
+            buf += chunk
+        return buf
+    status, ln = struct.unpack("<BQ", recv_exact(9))
+    out = recv_exact(ln).decode()
+    s.close()
+    if status != 0:
+        raise SystemExit(f"platform probe failed: {out[-500:]}")
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--lab", default="lab2", choices=sorted(TINY_FIXTURES))
@@ -123,20 +149,47 @@ def main(argv=None) -> int:
     )
     ap.add_argument("--out", default=None)
     ap.add_argument("--workdir", default=None)
+    ap.add_argument("--backend", default="cpu", choices=("cpu", "tpu"),
+                    help="daemon compute backend: cpu (hermetic) or tpu — "
+                         "the daemon claims the real chip and the "
+                         "reference harness verifies CHIP output bit-"
+                         "exactly (round-2 verdict missing #3)")
     args = ap.parse_args(argv)
     kernel_sizes = args.kernel_sizes or DEFAULT_KERNEL_SIZES[args.lab]
-    out_default = ROOT / "results" / (
-        "reference_harness" if args.lab == "lab2"
-        else f"reference_harness_{args.lab}"
+    suffix = ("" if args.lab == "lab2" else f"_{args.lab}") + (
+        "_tpu" if args.backend == "tpu" else ""
     )
+    out_default = ROOT / "results" / f"reference_harness{suffix}"
 
     workdir = pathlib.Path(args.workdir or tempfile.mkdtemp(prefix="refharness_"))
     srcdir = stage_workdir(workdir, args.lab)
 
-    env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    if args.backend == "tpu":
+        # leave the container's JAX_PLATFORMS=axon for the daemon (it
+        # claims the one chip; "cpu" stays registered for the f64/oracle
+        # paths).  Everything else in this tool must NOT claim: the
+        # reference harness subprocess gets CPU pins below.
+        env = dict(os.environ)
+    else:
+        env = dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
     daemon, sock = start_daemon(workdir, env)
+    if args.backend == "tpu":
+        # refuse to produce a *_tpu artifact computed anywhere else —
+        # outside the container (or with the relay down) the daemon
+        # could silently fall back to CPU and the harness would "pass"
+        plat = daemon_platform(sock)
+        if plat != "tpu":
+            daemon.terminate()
+            daemon.wait(timeout=10)
+            raise SystemExit(
+                f"--backend tpu requested but the daemon computes on "
+                f"{plat!r}; aborting before writing a _tpu artifact"
+            )
     try:
-        run_env = dict(env, TPULAB_DAEMON_SOCKET=sock)
+        # the harness itself is numpy/pandas only — pin it to CPU so it
+        # can never contend for the daemon's chip claim
+        run_env = dict(env, TPULAB_DAEMON_SOCKET=sock,
+                       JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
         cmd = [
             sys.executable,
             str(REFERENCE / "run_test.py"),

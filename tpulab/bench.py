@@ -521,6 +521,7 @@ def run_benchmarks(only: Optional[str] = None, **kw) -> List[Dict[str, Any]]:
         "hw2_sort": bench_sort,
         "lab5_reduce": bench_reduce,
         "flash_attention": bench_flash_attention,
+        "flash_attention_8k": functools.partial(bench_flash_attention, s=8192),
     }
     try:
         from tpulab.bench_image import bench_lab2, bench_lab3  # lands with lab2/lab3

@@ -308,6 +308,13 @@ def handle_request(header: dict, payload: bytes) -> bytes:
         return _handle_generate(header, payload)
     if header.get("lab") == "generate_stats":
         return _handle_generate_stats(header)
+    if header.get("lab") == "platform":
+        # observability: which backend this daemon actually computes on
+        # (tools/run_reference_harness.py --backend tpu refuses to write
+        # its artifact unless this says "tpu")
+        import jax
+
+        return jax.devices()[0].platform.encode("utf-8")
 
     from tpulab.labs import get_workload
 

@@ -57,13 +57,23 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
     # final o_ref write below stays OUTSIDE the skip: for short-q rows
     # the last K steps are all masked, and kb == n_k-1 must still flush.
     active = (kb * block_k <= qb * block_q + block_q - 1) if causal else None
+    # ...and of the active blocks, only those CROSSING the diagonal need
+    # the positional mask; interior (fully-visible) blocks skip the two
+    # iotas + compare + select — three VPU passes over (bq, bk) that,
+    # with d=64 halving the MXU, otherwise rival the matmul time
+    diag = (
+        (kb * block_k + block_k - 1 > qb * block_q) if causal else None
+    )
 
-    def _compute():
+    def _compute(masked: bool):
         # dots take NATIVE-dtype operands with f32 accumulation
         # (preferred_element_type): bf16xbf16->f32 is one MXU pass where
-        # upcast-then-f32xf32 costs several.  The scale folds into the
-        # f32 scores, not the operands (np.float32, not np.float64: under
-        # the global x64 a float64 scalar would poison the f32 scratch).
+        # upcast-then-f32xf32 costs several.  The softmax scale is
+        # pre-folded into q by the host wrapper (_flash_bshd) — shared
+        # by forward AND backward so the saved lse matches the
+        # recomputed scores exactly; scale != 1 here only for direct
+        # _flash_fwd_call callers (np.float32, not np.float64: under the
+        # global x64 a float64 scalar would poison the f32 scratch).
         q = q_ref[0]                                      # (bq, d)
         k = k_ref[0]                                      # (bk, d)
         v = v_ref[0]                                      # (bk, d)
@@ -71,13 +81,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
             precision=jax.lax.Precision.HIGHEST,
-        ) * np.float32(scale)                             # (bq, bk) f32
+        )                                                 # (bq, bk) f32
+        if scale != 1.0:
+            s = s * np.float32(scale)
 
-        if causal:
+        if masked:
             q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            # np.float32 constant: a Python float lowers as f64 under the
-            # global x64 config, which Mosaic cannot truncate
             s = jnp.where(k_pos <= q_pos, s, np.float32(NEG_INF))
 
         m_prev = m_ref[:]                                  # (bq, 1)
@@ -102,9 +112,16 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
         l_ref[:] = l_new
 
     if causal:
-        pl.when(active)(_compute)
+        # exactly one branch runs per step: diagonal-crossing blocks pay
+        # the mask, interior blocks take the unmasked body
+        pl.when(jnp.logical_and(active, diag))(
+            functools.partial(_compute, masked=True)
+        )
+        pl.when(jnp.logical_and(active, jnp.logical_not(diag)))(
+            functools.partial(_compute, masked=False)
+        )
     else:
-        _compute()
+        _compute(masked=False)
 
     @pl.when(kb == n_k - 1)
     def _finish():
@@ -135,7 +152,10 @@ def _flash_fwd_call(q, k, v, block_q: int, block_k: int, causal: bool,
     _check_blocks(s, block_q, block_k)
     n_q = s // block_q
     n_k = s // block_k
-    scale = 1.0 / np.sqrt(d)
+    # scale == 1: the host wrapper pre-folds 1/sqrt(d) into q (one pass
+    # over (b,s,h,d) instead of a per-K-step pass over every (bq, bk)
+    # score tile), identically for forward and backward
+    scale = 1.0
     grid = (bh, n_q, n_k)
     q_spec = pl.BlockSpec(
         (1, block_q, d), lambda b, i, j: (b, i, jnp.int32(0)), memory_space=pltpu.VMEM
@@ -190,7 +210,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     def _init():
         dq_acc[:] = jnp.zeros_like(dq_acc)
 
-    def _compute():
+    def _compute(masked: bool):
         # native-dtype operands + f32 accumulation throughout (see
         # _flash_kernel._compute): one MXU pass per dot for bf16 models
         q = q_ref[0]                                           # (bq, d)
@@ -203,9 +223,11 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
             precision=jax.lax.Precision.HIGHEST,
-        ) * np.float32(scale)                                  # (bq, bk)
+        )                                                      # (bq, bk)
+        if scale != 1.0:
+            s = s * np.float32(scale)
         p = jnp.exp(s - lse)
-        if causal:
+        if masked:
             p = _causal_p_mask(p, qb, kb, block_q, block_k)
         dp = jax.lax.dot_general(
             do, v, (((1,), (1,)), ((), ())),
@@ -213,16 +235,30 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             precision=jax.lax.Precision.HIGHEST,
         )                                                      # (bq, bk)
         ds = p * (dp - delta)
-        dq_acc[:] += jax.lax.dot_general(
+        # with the wrapper's prescaled q, d(q')/dq folds the 1/sqrt(d)
+        # outside the custom_vjp — no in-kernel rescale of dq
+        dq = jax.lax.dot_general(
             ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
             precision=jax.lax.Precision.HIGHEST,
-        ) * np.float32(scale)
+        )
+        if scale != 1.0:
+            dq = dq * np.float32(scale)
+        dq_acc[:] += dq
 
     if causal:
-        pl.when(kb * block_k <= qb * block_q + block_q - 1)(_compute)
+        # diagonal split as in the forward: only blocks crossing the
+        # diagonal pay the positional mask's VPU passes
+        active = kb * block_k <= qb * block_q + block_q - 1
+        diag = kb * block_k + block_k - 1 > qb * block_q
+        pl.when(jnp.logical_and(active, diag))(
+            functools.partial(_compute, masked=True)
+        )
+        pl.when(jnp.logical_and(active, jnp.logical_not(diag)))(
+            functools.partial(_compute, masked=False)
+        )
     else:
-        _compute()
+        _compute(masked=False)
 
     @pl.when(kb == n_k - 1)
     def _finish():
@@ -240,7 +276,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc[:] = jnp.zeros_like(dk_acc)
         dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    def _compute():
+    def _compute(masked: bool):
         # native-dtype operands + f32 accumulation (see _flash_kernel)
         q = q_ref[0]                                           # (bq, d)
         k = k_ref[0]                                           # (bk, d)
@@ -252,9 +288,11 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
             precision=jax.lax.Precision.HIGHEST,
-        ) * np.float32(scale)                                  # (bq, bk)
+        )                                                      # (bq, bk)
+        if scale != 1.0:
+            s = s * np.float32(scale)
         p = jnp.exp(s - lse)
-        if causal:
+        if masked:
             p = _causal_p_mask(p, qb, kb, block_q, block_k)
         dv_acc[:] += jax.lax.dot_general(
             p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
@@ -267,18 +305,30 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             precision=jax.lax.Precision.HIGHEST,
         )                                                      # (bq, bk)
         ds = p * (dp - delta)
-        # ds^T @ (q*scale) == (ds^T @ q) * scale: the fold is linear
-        dk_acc[:] += jax.lax.dot_general(
+        # dk = ds^T @ q' directly: q' already carries 1/sqrt(d) (the
+        # wrapper prescale), so no post-dot rescale pass is needed
+        dk = jax.lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
             precision=jax.lax.Precision.HIGHEST,
-        ) * np.float32(scale)
+        )
+        if scale != 1.0:
+            dk = dk * np.float32(scale)
+        dk_acc[:] += dk
 
     if causal:
-        # a K block only sees gradient from Q blocks reaching it
-        pl.when(qb * block_q + block_q - 1 >= kb * block_k)(_compute)
+        # a K block only sees gradient from Q blocks reaching it, and
+        # only diagonal-crossing blocks pay the positional mask
+        active = qb * block_q + block_q - 1 >= kb * block_k
+        diag = kb * block_k + block_k - 1 > qb * block_q
+        pl.when(jnp.logical_and(active, diag))(
+            functools.partial(_compute, masked=True)
+        )
+        pl.when(jnp.logical_and(active, jnp.logical_not(diag)))(
+            functools.partial(_compute, masked=False)
+        )
     else:
-        _compute()
+        _compute(masked=False)
 
     @pl.when(qb == n_q - 1)
     def _finish():
@@ -304,7 +354,10 @@ def _flash_bwd_call(q, k, v, o, lse, do, block_q: int, block_k: int,
     _check_blocks(s, bq, bk)
     n_q = s // bq
     n_k = s // bk
-    scale = 1.0 / np.sqrt(d)
+    # scale == 1: q arrives prescaled from _flash_bshd — the SAME q' the
+    # forward used, so p = exp(s - lse) reconstructs the forward's exact
+    # probabilities (a fwd/bwd scale-rounding mismatch would bias grads)
+    scale = 1.0
     # delta = rowsum(do * o): one cheap fused XLA pass, f32.  When the
     # caller also consumes lse (ring merge), its cotangent folds in here:
     # d lse / d s_ij = p_ij, so ds = p*(dp - delta + dlse) — i.e. the
@@ -407,6 +460,15 @@ def _flash_bshd(q, k, v, causal: bool, block_q: int, block_k: int,
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
     b, s, h, d = q.shape
+    # fold the softmax scale into q ONCE here (f32 math, back to q's
+    # dtype) instead of a per-K-step pass over every (bq, bk) score
+    # tile in the kernels.  This sits OUTSIDE the custom_vjp, so
+    # autodiff routes the 1/sqrt(d) factor into dq automatically, and
+    # forward/backward kernels see the identical prescaled q — the
+    # saved lse and the backward's recomputed scores stay consistent.
+    # For d a power of 4 (the model tier's d=64), the bf16 prescale is
+    # exact (scale is a power of two).
+    q = (q.astype(jnp.float32) * np.float32(1.0 / np.sqrt(d))).astype(q.dtype)
     block_q = min(block_q, max(8, s))
     block_k = min(block_k, max(8, s))
     # lcm, not max: with unequal blocks a max-multiple padded length need

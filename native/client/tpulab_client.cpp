@@ -23,8 +23,11 @@
 #include <string>
 #include <vector>
 
+#include <csignal>
+
 #include <sys/socket.h>
 #include <sys/un.h>
+#include <sys/wait.h>
 #include <unistd.h>
 
 namespace {
@@ -120,7 +123,7 @@ bool recv_exact(int fd, void* data, size_t n) {
   return true;
 }
 
-[[noreturn]] void exec_fallback(int argc, char** argv) {
+[[noreturn]] void exec_python_cli(int argc, char** argv) {
   // cold path: python -m tpulab run <lab> [--to-plot] [--backend B] [extras]
   std::vector<char*> args;
   static char py[] = "python3";
@@ -137,6 +140,47 @@ bool recv_exact(int fd, void* data, size_t n) {
   execvp("python", args.data());
   perror("tpulab_client: exec python fallback failed");
   exit(127);
+}
+
+[[noreturn]] void fallback_with_payload(int argc, char** argv,
+                                        const std::string& payload) {
+  // stdin is already consumed into `payload` (read before connecting so
+  // the daemon's handler slot isn't held during stdin ingestion), so a
+  // plain re-exec would hand the CLI an empty stdin — feed the captured
+  // payload through a pipe instead.
+  int fds[2];
+  if (pipe(fds) != 0) {
+    perror("tpulab_client: pipe for fallback failed");
+    exit(127);
+  }
+  pid_t pid = fork();
+  if (pid < 0) {
+    perror("tpulab_client: fork for fallback failed");
+    exit(127);
+  }
+  if (pid == 0) {
+    close(fds[1]);
+    if (dup2(fds[0], 0) < 0) _exit(127);
+    close(fds[0]);
+    exec_python_cli(argc, argv);
+  }
+  close(fds[0]);
+  // child may exit before draining (e.g. bad args): a SIGPIPE here must
+  // not kill us before we can report its exit status
+  signal(SIGPIPE, SIG_IGN);
+  size_t off = 0;
+  while (off < payload.size()) {
+    ssize_t w = write(fds[1], payload.data() + off, payload.size() - off);
+    if (w < 0) {
+      if (errno == EINTR) continue;
+      break;  // child gone; its status is the answer
+    }
+    off += static_cast<size_t>(w);
+  }
+  close(fds[1]);
+  int st = 0;
+  waitpid(pid, &st, 0);
+  exit(WIFEXITED(st) ? WEXITSTATUS(st) : 128 + WTERMSIG(st));
 }
 
 }  // namespace
@@ -166,49 +210,67 @@ int main(int argc, char** argv) {
 
   const char* sock_env = getenv("TPULAB_DAEMON_SOCKET");
   std::string sock_path = sock_env && *sock_env ? sock_env : "/tmp/tpulab.sock";
+  if (sock_path.size() >= sizeof(sockaddr_un{}.sun_path)) {
+    exec_python_cli(argc, argv);  // unusable socket path: cold path
+  }
+  sockaddr_un addr{};
+  addr.sun_family = AF_UNIX;
+  strncpy(addr.sun_path, sock_path.c_str(), sizeof(addr.sun_path) - 1);
+
+  // Cheap daemon-presence check WITHOUT connecting: the common
+  // no-daemon cold path must keep handing python an untouched streaming
+  // stdin (no double-buffering of multi-hundred-MB payloads), and a
+  // throwaway probe connection would both churn a daemon handler slot
+  // and double-count against --max-requests.  A stale socket file
+  // (daemon crashed) is rare and still correct: we buffer stdin, the
+  // real connect below fails, and fallback_with_payload pipes the
+  // captured bytes to the python CLI.
+  if (access(sock_path.c_str(), F_OK) != 0) {
+    exec_python_cli(argc, argv);
+  }
+
+  // Socket file exists: slurp stdin BEFORE the real connect — from
+  // connect() on, the daemon holds a bounded handler slot with an
+  // eviction deadline (tpulab/daemon.py RECV_TIMEOUT_S), and time spent
+  // by a slow upstream producer must not count against it.
+  std::string payload = read_all_stdin();
 
   int fd = socket(AF_UNIX, SOCK_STREAM, 0);
-  if (fd >= 0) {
-    sockaddr_un addr{};
-    addr.sun_family = AF_UNIX;
-    if (sock_path.size() < sizeof(addr.sun_path)) {
-      strncpy(addr.sun_path, sock_path.c_str(), sizeof(addr.sun_path) - 1);
-      if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
-        std::string payload = read_all_stdin();
-        std::string header = "{\"lab\":\"" + json_escape(lab) + "\"";
-        header += ",\"sweep\":" + std::string(sweep ? "true" : "false");
-        header += ",\"backend\":" +
-                  (backend.empty() ? std::string("null")
-                                   : "\"" + json_escape(backend) + "\"");
-        header += ",\"config\":" + config_json(cfg) + "}";
+  if (fd < 0) {
+    fallback_with_payload(argc, argv, payload);
+  }
+  if (connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) == 0) {
+    std::string header = "{\"lab\":\"" + json_escape(lab) + "\"";
+    header += ",\"sweep\":" + std::string(sweep ? "true" : "false");
+    header += ",\"backend\":" +
+              (backend.empty() ? std::string("null")
+                               : "\"" + json_escape(backend) + "\"");
+    header += ",\"config\":" + config_json(cfg) + "}";
 
-        uint32_t hlen = static_cast<uint32_t>(header.size());
-        uint64_t plen = payload.size();
-        bool ok = send_exact(fd, &hlen, 4) && send_exact(fd, header.data(), hlen) &&
-                  send_exact(fd, &plen, 8) && send_exact(fd, payload.data(), plen);
-        uint8_t status = 2;
-        uint64_t rlen = 0;
-        if (ok && recv_exact(fd, &status, 1) && recv_exact(fd, &rlen, 8)) {
-          std::string out(rlen, '\0');
-          if (recv_exact(fd, out.data(), rlen)) {
-            close(fd);
-            if (status == 0) {
-              fwrite(out.data(), 1, out.size(), stdout);
-              return 0;
-            }
-            fwrite(out.data(), 1, out.size(), stderr);
-            return 1;
-          }
-        }
-        fprintf(stderr, "tpulab_client: daemon protocol error, falling back\n");
+    uint32_t hlen = static_cast<uint32_t>(header.size());
+    uint64_t plen = payload.size();
+    bool ok = send_exact(fd, &hlen, 4) && send_exact(fd, header.data(), hlen) &&
+              send_exact(fd, &plen, 8) && send_exact(fd, payload.data(), plen);
+    uint8_t status = 2;
+    uint64_t rlen = 0;
+    if (ok && recv_exact(fd, &status, 1) && recv_exact(fd, &rlen, 8)) {
+      std::string out(rlen, '\0');
+      if (recv_exact(fd, out.data(), rlen)) {
         close(fd);
-        // stdin already consumed — re-exec would lose it; fail loudly
-        // instead of silently recomputing with empty input
-        return 3;
+        if (status == 0) {
+          fwrite(out.data(), 1, out.size(), stdout);
+          return 0;
+        }
+        fwrite(out.data(), 1, out.size(), stderr);
+        return 1;
       }
     }
+    fprintf(stderr, "tpulab_client: daemon protocol error, falling back\n");
     close(fd);
+    fallback_with_payload(argc, argv, payload);
   }
-  // no daemon: keep the reference contract via the Python CLI
-  exec_fallback(argc, argv);
+  close(fd);
+  // stale socket file or refused connect: the daemon is gone — pipe the
+  // already-captured payload through the python CLI
+  fallback_with_payload(argc, argv, payload);
 }

@@ -33,7 +33,22 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
 # cache hit (XLA records pseudo-features like +prefer-no-scatter that
 # host detection never reports — same machine, cosmetic mismatch);
 # silence the C++ log stream or cached runs drown the pytest output.
-os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+# Level 3 is the MINIMUM that works: the spam is emitted at ERROR level
+# (cpu_aot_loader.cc:210, two ~2KB lines per loaded executable —
+# verified 2026-07-30: TF_CPP_MIN_LOG_LEVEL=2 still prints it), and no
+# env knob filters a single C++ module's ERROR stream.  Cost: genuine
+# XLA ERROR logs are also hidden — FATALs still abort loudly, and
+# Python-side exceptions are unaffected.
+#
+# This must be a FORCED assignment: the axon sitecustomize pins
+# TF_CPP_MIN_LOG_LEVEL=1 into os.environ at interpreter start, so a
+# setdefault here silently loses (verified 2026-07-30 — the "silenced"
+# spam was in fact flowing the whole time, and once the AOT cache grew
+# past ~32 loaded executables per daemon it deadlocked the module-
+# scoped daemon fixture by filling its undrained 64 KB stdout pipe).
+# Debug escape hatch: TPULAB_TEST_TF_LOG=0 pytest ... restores the full
+# C++ stream (parent AND `python -m tpulab` subprocess targets).
+os.environ["TF_CPP_MIN_LOG_LEVEL"] = os.environ.get("TPULAB_TEST_TF_LOG", "3")
 
 # The container's sitecustomize registers the axon PJRT plugin at
 # interpreter startup and calls jax.config.update("jax_platforms",

@@ -72,10 +72,22 @@ def daemon(tmp_path_factory, built_native):
     env["JAX_PLATFORMS"] = "cpu"
     env["PALLAS_AXON_POOL_IPS"] = ""
     env["PYTHONPATH"] = str(ROOT)
+    # short socket-op timeout: bounds only socket reads/writes (compute
+    # inside handle_request is unaffected), keeps the stalled-client
+    # regression test below fast
+    env["TPULAB_DAEMON_RECV_TIMEOUT_S"] = "2"
+    # daemon output goes to a FILE, not a PIPE: nothing drains the pipe
+    # during the tests, so 64 KB of daemon/XLA chatter would block the
+    # next print() inside a handler forever — the handler then never
+    # sends its response and the requesting test hangs in recv
+    # (observed 2026-07-30: thread stuck in anon_pipe_write, suite
+    # deadlocked at ~50 min)
+    log_path = pathlib.Path(sock).parent / "daemon.log"
+    log_f = open(log_path, "w")
     proc = subprocess.Popen(
         [sys.executable, "-m", "tpulab.daemon", "--socket", sock],
         env=env,
-        stdout=subprocess.PIPE,
+        stdout=log_f,
         stderr=subprocess.STDOUT,
         text=True,
         cwd=str(ROOT),
@@ -84,7 +96,8 @@ def daemon(tmp_path_factory, built_native):
         if os.path.exists(sock):
             break
         if proc.poll() is not None:
-            raise RuntimeError(f"daemon died: {proc.stdout.read()}")
+            raise RuntimeError(
+                f"daemon died: {log_path.read_text()[-4000:]}")
         time.sleep(0.1)
     else:
         proc.kill()
@@ -92,6 +105,7 @@ def daemon(tmp_path_factory, built_native):
     yield sock
     proc.terminate()
     proc.wait(timeout=10)
+    log_f.close()
 
 
 def _raw_request_bytes(sock_path, header: bytes, payload: bytes):
@@ -130,6 +144,56 @@ class TestDaemon:
         status, out = _raw_request(daemon, b'{"lab": "nope"}', b"")
         assert status == 1
         assert "nope" in out
+
+    def test_stalled_client_is_evicted(self, daemon):
+        """A client that connects but never completes a frame must be
+        disconnected once RECV_TIMEOUT_S elapses, releasing its handler
+        slot — otherwise 32 such stalls would wedge accept() for every
+        later client (round-3 advisor finding: the conn_sem bound plus
+        unbounded header reads turned one idle socket into a daemon-wide
+        stall)."""
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.connect(daemon)
+        s.sendall(b"\x01\x02")  # half a header-length prefix, then stall
+        s.settimeout(8)  # daemon side times out at 2s
+        t0 = time.perf_counter()
+        try:
+            got = s.recv(1)
+        except OSError:
+            got = b""  # reset instead of EOF is an equally valid eviction
+        dt = time.perf_counter() - t0
+        s.close()
+        assert got == b"", "daemon sent data to a half-dead client?"
+        assert dt < 7, f"stalled client not evicted after {dt:.1f}s"
+        # and the daemon still serves followers normally
+        status, out = _raw_request(daemon, b'{"lab": "hw1"}', b"1 -3 2")
+        assert status == 0 and "1.000000" in out
+
+    def test_trickling_client_is_evicted(self, daemon):
+        """The eviction deadline is absolute per frame, not per socket
+        op: a client feeding one byte per interval keeps every recv
+        alive yet must still be cut off at RECV_TIMEOUT_S (review
+        finding: per-op settimeout resets on each recv, so a trickle
+        held the slot forever)."""
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.connect(daemon)
+        s.settimeout(10)
+        t0 = time.perf_counter()
+        evicted = False
+        try:
+            # header-length prefix says 16-byte header; trickle it slowly
+            s.sendall(struct.pack("<I", 16))
+            for _ in range(12):  # 6s of trickle >> the 2s deadline
+                time.sleep(0.5)
+                s.sendall(b"x")  # raises once the daemon closes on us
+        except OSError:
+            evicted = True
+        dt = time.perf_counter() - t0
+        s.close()
+        assert evicted, "trickling client was never disconnected"
+        assert dt < 7, f"trickling client held its slot for {dt:.1f}s"
+        status, out = _raw_request(daemon, b'{"lab": "hw1"}', b"1 -3 2")
+        assert status == 0 and "1.000000" in out
 
     def test_warm_requests_are_fast(self, daemon):
         _raw_request(daemon, b'{"lab": "hw1"}', b"1 -3 2")  # warm

@@ -7,6 +7,8 @@ allclose); hypothesis turns that pattern into searched invariants over
 the input space, shrinking any counterexample it finds.
 """
 
+import os
+
 import numpy as np
 from hypothesis import given, settings, strategies as st
 
@@ -131,10 +133,13 @@ class TestPagedEngineInvariants:
     request's greedy tokens must equal its solo decode, and the pool
     must account for every block afterward."""
 
-    # 4 examples: each draws a full engine workload + per-request solo
-    # decode oracle (~7s on the one-core box); 4 keeps the randomized
-    # slot/share/chunk space covered per run at half the round-2 cost
-    @settings(max_examples=4, deadline=None)
+    # Each example draws a full engine workload + per-request solo decode
+    # oracle (~7s on the one-core box).  Default 4 halves the round-2
+    # cost for the every-commit loop; TPULAB_PAGED_EXAMPLES=8 (or more)
+    # restores the wider draw for thorough runs — the strategy space is
+    # identical either way, only the per-run sample count changes.
+    @settings(max_examples=int(os.environ.get("TPULAB_PAGED_EXAMPLES", "4")),
+              deadline=None)
     @given(
         data=st.data(),
         slots=st.integers(1, 3),

@@ -130,7 +130,10 @@ def main(argv=None) -> int:
             "decreasing": bool(head is not None and tail < head),
             "curve": curve,
         },
-        "ok": bool(worst < args.tol and finite),
+        # the loss trend IS the working-training evidence: with >=10 points
+        # a finite but flat/diverging curve must not certify ok
+        "ok": bool(worst < args.tol and finite
+                   and (head is None or tail < head)),
     }
     out = pathlib.Path(args.out)
     out.parent.mkdir(parents=True, exist_ok=True)

@@ -30,6 +30,7 @@ import socket
 import struct
 import sys
 import threading
+import time
 import traceback
 from typing import Optional
 
@@ -46,6 +47,12 @@ MAX_PAYLOAD_BYTES = int(
 #: concurrent connection-handler threads (each may hold a payload
 #: buffer); excess connections queue in accept order
 MAX_CONN_THREADS = 32
+#: bound on every per-connection socket operation (recv AND sendall) so a
+#: stalled/half-dead client releases its handler slot instead of holding
+#: it forever; generous because legit clients stream multi-MB payloads
+#: over the loopback in well under a second (env-tunable so tests can
+#: exercise the stall path without waiting a minute)
+RECV_TIMEOUT_S = float(os.environ.get("TPULAB_DAEMON_RECV_TIMEOUT_S", "60"))
 #: AGGREGATE staged-payload ceiling across all connections — the
 #: per-connection cap alone would still let MAX_CONN_THREADS clients
 #: stage MAX_CONN_THREADS x MAX_PAYLOAD_BYTES concurrently
@@ -54,14 +61,26 @@ MAX_TOTAL_PAYLOAD_BYTES = int(
 )
 
 
-def _recv_exact(conn: socket.socket, n: int) -> bytearray:
+def _recv_exact(conn: socket.socket, n: int,
+                deadline: float | None = None) -> bytearray:
     # returned as the bytearray itself: a bytes() copy would double the
     # peak payload footprint outside the _ByteBudget accounting (every
     # consumer — json.loads, .decode, np.frombuffer — takes bytearray)
+    #
+    # deadline is an ABSOLUTE time.monotonic() bound on the whole frame:
+    # a per-op settimeout alone resets on every recv, so a client
+    # trickling one byte per interval would hold its handler slot
+    # forever — the remaining-time settimeout below closes that.
     buf = bytearray(n)
     view = memoryview(buf)
     got = 0
     while got < n:
+        if deadline is not None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(f"frame receive deadline exceeded "
+                                   f"({got}/{n} bytes)")
+            conn.settimeout(remaining)
         r = conn.recv_into(view[got:], n - got)
         if r == 0:
             raise ConnectionError("peer closed mid-message")
@@ -367,36 +386,64 @@ def serve(socket_path: str, *, max_requests: Optional[int] = None) -> None:
         # other) behind a serial accept loop
         held = 0
         try:
-            raw = _recv_exact(conn, 4)
+            # a client that connects but never completes the request must
+            # not hold its conn_sem slot forever (32 such stalls would
+            # wedge accept() for every later client).  The deadline is
+            # absolute across the whole request frame — per-op timeouts
+            # alone would let a one-byte-per-interval trickle hold the
+            # slot indefinitely.  Compute inside handle_request is
+            # unaffected; sendall below is bounded per-op by the
+            # settimeout state _recv_exact leaves behind.
+            deadline = time.monotonic() + RECV_TIMEOUT_S
+            raw = _recv_exact(conn, 4, deadline)
             (hlen,) = struct.unpack("<I", raw)
             if hlen > MAX_HEADER_BYTES:
                 raise ConnectionError(f"header length {hlen} exceeds cap")
-            header = json.loads(_recv_exact(conn, hlen))
-            (plen,) = struct.unpack("<Q", _recv_exact(conn, 8))
+            header = json.loads(_recv_exact(conn, hlen, deadline))
+            (plen,) = struct.unpack("<Q", _recv_exact(conn, 8, deadline))
             if plen > MAX_PAYLOAD_BYTES:
                 # tell the client why, then DRAIN (bounded by a socket
                 # timeout) so its pipelined body send completes and it
                 # can actually read the error frame before our close
                 err = (f"payload length {plen} exceeds cap "
                        f"{MAX_PAYLOAD_BYTES}").encode()
+                conn.settimeout(RECV_TIMEOUT_S)
                 conn.sendall(struct.pack("<BQ", 1, len(err)) + err)
-                conn.settimeout(5.0)
+                # drain is bounded by wall clock, not just per-op: a
+                # trickling sender must not pin the handler here either
+                drain_end = time.monotonic() + 5.0
+                conn.settimeout(1.0)
                 try:
-                    while conn.recv(1 << 16):
+                    while time.monotonic() < drain_end and conn.recv(1 << 16):
                         pass
                 except OSError:
                     pass
                 raise ConnectionError("oversized payload")
             budget.acquire(plen)
             held = plen
-            payload = _recv_exact(conn, plen)
+            # the budget wait above can be long (legitimate queueing
+            # behind other staged payloads) — the payload frame gets its
+            # own fresh deadline so a responsive client isn't evicted
+            # for time it spent waiting on US
+            payload = _recv_exact(conn, plen,
+                                  time.monotonic() + RECV_TIMEOUT_S)
+            # compute first, send ONCE: if the sendall itself fails
+            # (send timeout against a non-draining client is possible
+            # now that every socket op is bounded), no second frame may
+            # follow a partially-written one — the outer except closes
+            # the connection instead
             try:
                 out = handle_request(header, payload)
-                conn.sendall(struct.pack("<BQ", 0, len(out)) + out)
+                frame = struct.pack("<BQ", 0, len(out)) + out
             except Exception:
                 err = traceback.format_exc().encode("utf-8")
-                conn.sendall(struct.pack("<BQ", 1, len(err)) + err)
-        except ConnectionError:
+                frame = struct.pack("<BQ", 1, len(err)) + err
+            # explicit send bound: _recv_exact leaves whatever
+            # remaining-time settimeout its last iteration computed
+            # (possibly near zero) on the socket
+            conn.settimeout(RECV_TIMEOUT_S)
+            conn.sendall(frame)
+        except (ConnectionError, TimeoutError):
             pass
         finally:
             if held:
@@ -420,13 +467,11 @@ def serve(socket_path: str, *, max_requests: Optional[int] = None) -> None:
             if max_requests is not None and accepted >= max_requests:
                 # drain: in-flight handlers must finish (and send their
                 # responses) before process exit kills their threads
-                import time as _time
-
                 for _ in range(600):
                     with served_lock:
                         if served["n"] >= accepted:
                             break
-                    _time.sleep(0.1)
+                    time.sleep(0.1)
                 break
     except KeyboardInterrupt:
         pass

@@ -89,7 +89,7 @@ class LabformerConfig:
         # (numerically identical) path and mislabel measurements
         checks = {
             "attn_impl": ("auto", "flash", "dense"),
-            "sp_impl": ("ring", "ulysses"),
+            "sp_impl": ("ring", "ulysses", "zigzag"),
             "moe_impl": ("dense", "dispatch"),
         }
         for field, allowed in checks.items():
@@ -325,7 +325,16 @@ def _attention(x, layer, cfg: LabformerConfig, mesh: Optional[Mesh], positions):
     k, v = repeat_kv(k, v, h)
     if mesh is not None and "sp" in mesh.axis_names and mesh.shape["sp"] > 1:
         spec = _restrict(P("dp", "sp", "tp", None), mesh)
-        if cfg.sp_impl == "ulysses":
+        if cfg.sp_impl == "zigzag":
+            # load-balanced causal ring.  The activations are ALREADY in
+            # zigzag sequence order — _forward_scan permutes tokens and
+            # rope positions once at the model boundary, so every layer
+            # runs shuffle-free (per-layer global gathers would cost
+            # more ICI than the halved attention FLOPs save)
+            from tpulab.parallel.ring import _zigzag_body
+
+            body = functools.partial(_zigzag_body, axis="sp")
+        elif cfg.sp_impl == "ulysses":
             from tpulab.parallel.ring import _ulysses_body
 
             tp = mesh.shape.get("tp", 1)
@@ -441,12 +450,32 @@ def _forward_scan(params, tokens, cfg: LabformerConfig, mesh: Optional[Mesh]):
     the layer axis sharded over ``pp``, each scan step's weights live on
     one stage and GSPMD moves the carried activations across stages.
     """
+    zig = (cfg.sp_impl == "zigzag" and mesh is not None
+           and "sp" in mesh.axis_names and mesh.shape["sp"] > 1)
+    if zig:
+        # zigzag layout once at the boundary: device i's sequence shard
+        # becomes half-blocks (i, 2p-1-i).  Tokens are permuted here,
+        # rope positions carry the ORIGINAL indices, and the logits are
+        # un-permuted below — all layers in between run shuffle-free
+        # (see parallel/ring.py::_zigzag_body for the balance argument)
+        from tpulab.parallel.ring import _zigzag_perm
+
+        sp = mesh.shape["sp"]
+        s = tokens.shape[1]
+        if s % (2 * sp):
+            raise ValueError(
+                f"sp_impl=zigzag needs seq divisible by 2*sp "
+                f"({2 * sp}); got {s}")
+        zperm = _zigzag_perm(s, sp)
+        tokens = tokens[:, zperm]
+        positions = jnp.asarray(zperm)
+    else:
+        positions = jnp.arange(tokens.shape[1])
     x = params["embed"][tokens]
     if mesh is not None:
         x = jax.lax.with_sharding_constraint(
             x, NamedSharding(mesh, _restrict(ACT_SPEC, mesh))
         )
-    positions = jnp.arange(tokens.shape[1])
 
     def block(x, layer):
         x = x + _attention(_rmsnorm(x, layer["ln1"]), layer, cfg, mesh, positions)
@@ -462,7 +491,12 @@ def _forward_scan(params, tokens, cfg: LabformerConfig, mesh: Optional[Mesh]):
         block = jax.checkpoint(block)
     x, (aux_per_layer, load_per_layer) = jax.lax.scan(block, x, params["blocks"])
     x = _rmsnorm(x, params["final_norm"])
-    return x @ params["embed"].T, aux_per_layer, load_per_layer  # tied head
+    logits = x @ params["embed"].T  # tied head
+    if zig:
+        # one inverse gather restores normal sequence order for every
+        # consumer (loss targets, generation, tests)
+        logits = logits[:, np.argsort(zperm)]
+    return logits, aux_per_layer, load_per_layer
 
 
 def forward_with_aux(

@@ -232,6 +232,162 @@ def ring_attention(
     )
 
 
+def _zigzag_perm(seq: int, p: int) -> np.ndarray:
+    """Global seq permutation for the zigzag layout: device ``i`` owns
+    half-blocks ``(i, 2p-1-i)`` so causal work is identical per device."""
+    hl = seq // (2 * p)
+    order = []
+    for i in range(p):
+        order.extend(range(i * hl, (i + 1) * hl))
+        j = 2 * p - 1 - i
+        order.extend(range(j * hl, (j + 1) * hl))
+    return np.asarray(order, dtype=np.int32)
+
+
+def _zigzag_body(q, k, v, *, axis: str):
+    """Per-device zigzag ring attention, causal only (runs in shard_map).
+
+    Plain causal ring attention is load-imbalanced by construction:
+    under a contiguous layout, rank 0's queries attend almost nothing
+    and the last rank's attend everything, yet SPMD executes (and then
+    masks away) the same p block-attends everywhere — about half the
+    ring's FLOPs are discarded.  The zigzag layout (each device owns
+    sequence half-blocks ``i`` and ``2p-1-i``) makes every step's useful
+    work identical across devices, and the per-step ``lax.cond`` does
+    ONLY that work:
+
+    * visiting block from an earlier rank: both local q halves attend
+      the visitor's LOW half in full — its high half is later than
+      every local query, so it is skipped entirely, not masked;
+    * visiting block from a later rank: only the local HIGH q half
+      attends, but it attends BOTH visitor halves in full.
+
+    Both branches are one (2·hl × hl)-score-equivalent — balanced and
+    100% useful.  Step 0 folds the self-block causally.  The K/V
+    rotation (ppermute) stays outside the cond so collectives remain
+    uniform across devices.
+    """
+    p = jax.lax.axis_size(axis)
+    idx = jax.lax.axis_index(axis)
+    hl = q.shape[-3] // 2  # local seq = two half-blocks [low; high]
+    d = q.shape[-1]
+    qs = q / np.sqrt(d).astype(q.dtype)
+
+    pos = jnp.arange(hl)
+    # global positions of the local q rows: [low half a=idx; high half b]
+    a_pos = idx * hl + pos
+    b_pos = (2 * p - 1 - idx) * hl + pos
+    q_pos = jnp.concatenate([a_pos, b_pos])
+
+    o0 = (q * 0).astype(jnp.float32)                    # (..., 2hl, h, d)
+    zeros_hq = jnp.swapaxes(o0[..., 0], -1, -2)         # (..., h, 2hl)
+    m0 = zeros_hq + NEG_INF
+    l0 = zeros_hq
+
+    # --- step 0: self block ---------------------------------------
+    # one (2hl x hl) attend vs the low half covers q_a causal AND q_b
+    # full (every b row is later than every a key); plus q_b causal vs
+    # the high half
+    k_a, v_a = k[..., :hl, :, :], v[..., :hl, :, :]
+    k_b, v_b = k[..., hl:, :, :], v[..., hl:, :, :]
+    s_low = _block_attend(qs, k_a, v_a, _causal_bias(q_pos, a_pos))
+    carry = _online_softmax_step((m0, l0, o0), s_low, v_a)
+    qs_b = qs[..., hl:, :, :]
+    s_high = _block_attend(qs_b, k_b, v_b, _causal_bias(b_pos, b_pos))
+    # fold into the b slice of the accumulators only
+    m, l, o = carry
+    mb, lb, ob = (m[..., hl:], l[..., hl:], o[..., hl:, :, :])
+    mb, lb, ob = _online_softmax_step((mb, lb, ob), s_high, v_b)
+    m = m.at[..., hl:].set(mb)
+    l = l.at[..., hl:].set(lb)
+    o = o.at[..., hl:, :, :].set(ob)
+
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    def step(t, carry):
+        m, l, o, kt, vt = carry
+        kt = jax.lax.ppermute(kt, axis, perm)
+        vt = jax.lax.ppermute(vt, axis, perm)
+        src = (idx - t) % p
+
+        def from_earlier(mlo):
+            # both q halves fully attend the visitor's low half; its
+            # high half ((2p-1-src)·hl onward) is later than all local
+            # queries and is not computed at all
+            m, l, o = mlo
+            s = _block_attend(qs, kt[..., :hl, :, :], None, 0.0)
+            return _online_softmax_step((m, l, o), s, vt[..., :hl, :, :])
+
+        def from_later(mlo):
+            # only the local high q half attends — but it attends the
+            # whole visiting block (both its halves precede b_pos)
+            m, l, o = mlo
+            mb, lb, ob = (m[..., hl:], l[..., hl:], o[..., hl:, :, :])
+            s = _block_attend(qs_b, kt, None, 0.0)
+            mb, lb, ob = _online_softmax_step((mb, lb, ob), s, vt)
+            return (m.at[..., hl:].set(mb),
+                    l.at[..., hl:].set(lb),
+                    o.at[..., hl:, :, :].set(ob))
+
+        m, l, o = jax.lax.cond(src < idx, from_earlier, from_later, (m, l, o))
+        return m, l, o, kt, vt
+
+    m, l, o, _, _ = jax.lax.fori_loop(1, p, step, (m, l, o, k, v))
+    out = o / l[..., None].swapaxes(-2, -3)
+    return out.astype(q.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "axis"))
+def _zigzag_sharded(q, k, v, *, mesh: Mesh, axis: str):
+    """Standalone zigzag entry: layout gathers at the jit level around a
+    shard_map of the body.  (labformer does NOT route through here — it
+    permutes once at the model boundary and wraps _zigzag_body in its
+    own dp/sp/tp shard_map, so no per-layer gathers are paid.)"""
+    p = mesh.shape[axis]
+    seq = q.shape[1]
+    if seq % (2 * p):
+        # _zigzag_perm floor-divides, so an unchecked indivisible seq
+        # would silently truncate the tail tokens
+        raise ValueError(
+            f"zigzag needs seq divisible by 2*axis ({2 * p}); got {seq}")
+    perm = _zigzag_perm(seq, p)
+    inv = np.argsort(perm)
+    spec = P(None, axis, None, None)
+    body = functools.partial(_zigzag_body, axis=axis)
+    qz, kz, vz = (x[:, perm] for x in (q, k, v))
+    oz = jax.shard_map(
+        body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False,
+    )(qz, kz, vz)
+    return oz[:, inv]
+
+
+def zigzag_ring_attention(
+    q,
+    k,
+    v,
+    *,
+    mesh: Optional[Mesh] = None,
+    axis: str = "sp",
+) -> jax.Array:
+    """Load-balanced CAUSAL ring attention over (batch, seq, heads, d).
+
+    Same contract as :func:`ring_attention` with ``causal=True``, but
+    ~2x the useful-FLOP ratio: the zigzag sequence layout (device ``i``
+    owns half-blocks ``i`` and ``2p-1-i``) equalizes causal work across
+    devices, and each ring step computes only live (q, k) pairs instead
+    of masking dead ones after the fact.  Inputs and outputs use the
+    NORMAL sequence order — the layout shuffle is internal (one gather
+    each way at the jit boundary).  Non-causal attention is already
+    balanced; use :func:`ring_attention` for it.
+    """
+    mesh = mesh or make_mesh(axes=(axis,))
+    spec = NamedSharding(mesh, P(None, axis, None, None))
+    q, k, v = (jax.device_put(commit(x, mesh_anchor(mesh)), spec)
+               for x in (q, k, v))
+    return _zigzag_sharded(q, k, v, mesh=mesh, axis=axis)
+
+
 def _ulysses_local_attention(q, k, v, causal: bool, local_impl: str):
     """The per-head-group full-sequence attention inside Ulysses.
 

@@ -100,6 +100,19 @@ class LabformerConfig:
                 f"n_heads={self.n_heads} must be a multiple of "
                 f"n_kv_heads={self.n_kv_heads}"
             )
+        if self.sp_impl == "zigzag" and self.attn_impl == "flash":
+            # the zigzag body computes dense (2hl x hl) f32 score blocks
+            # per ring step; running that while the user explicitly asked
+            # for flash would mislabel measurements AND lose flash's
+            # O(seq) memory at exactly the lengths it matters.  (A flash
+            # local attend needs a rectangular-causal kernel variant —
+            # not built yet.)  attn_impl="auto" stays valid: it promises
+            # a heuristic, not a specific path.
+            raise ValueError(
+                "sp_impl='zigzag' has no flash local attention yet; use "
+                "attn_impl='auto'/'dense' with zigzag, or sp_impl='ring' "
+                "for the flash ring"
+            )
 
     @property
     def head_dim(self) -> int:
@@ -536,7 +549,7 @@ def loss_fn(params, tokens, cfg: LabformerConfig, mesh: Optional[Mesh] = None):
 
 def make_train_step(
     cfg: LabformerConfig, mesh: Optional[Mesh], optimizer=None, accum: int = 1,
-    zero1: bool = False,
+    zero1: bool = False, zero2: bool = False,
 ):
     """Jitted (params, opt_state, tokens) -> (params, opt_state, loss).
 
@@ -549,26 +562,49 @@ def make_train_step(
     the grads before the moment update and all-gathering the parameter
     updates after — the optimizer-memory term stops scaling with model
     replication.
+
+    ``zero2`` (implies ``zero1``) additionally pins the GRADIENTS to the
+    same dp-sharded layout: under GSPMD the backward's dp gradient
+    reduction then lowers to a reduce-scatter instead of an all-reduce,
+    each rank holds and updates only its 1/dp gradient shard, and the
+    single all-gather moves the (smaller) parameter updates — the
+    full-size replicated gradient tree never materializes.  With
+    ``accum > 1`` the microbatch accumulator is sharded too, so
+    accumulation memory also drops 1/dp.
     """
     import optax
 
     optimizer = optimizer or optax.adamw(3e-4)
+    zero1 = bool(zero1 or zero2)
     use_zero1 = bool(zero1 and mesh is not None)
+    use_zero2 = bool(zero2 and mesh is not None)
+
+    def _constrain_grads(grads):
+        return jax.tree_util.tree_map(
+            jax.lax.with_sharding_constraint, grads,
+            zero1_shardings(grads, cfg, mesh),
+        )
 
     @jax.jit
     def train_step(params, opt_state, tokens):
         if accum <= 1:
             loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg, mesh)
+            if use_zero2:
+                grads = _constrain_grads(grads)
         else:
             micro = tokens.reshape(accum, tokens.shape[0] // accum, tokens.shape[1])
 
             def one(carry, mb):
                 loss_acc, grads_acc = carry
                 loss, grads = jax.value_and_grad(loss_fn)(params, mb, cfg, mesh)
+                if use_zero2:
+                    grads = _constrain_grads(grads)
                 grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc, grads)
                 return (loss_acc + loss, grads_acc), None
 
             zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+            if use_zero2:
+                zeros = _constrain_grads(zeros)
             (loss, grads), _ = jax.lax.scan(one, (jnp.float32(0.0), zeros), micro)
             inv = jnp.float32(1.0 / accum)
             loss = loss * inv
@@ -591,10 +627,12 @@ def init_train_state(
     optimizer=None,
     accum: int = 1,
     zero1: bool = False,
+    zero2: bool = False,
 ):
+    zero1 = bool(zero1 or zero2)
     params = init_params(cfg, seed)
     optimizer, train_step = make_train_step(
-        cfg, mesh, optimizer, accum=accum, zero1=zero1
+        cfg, mesh, optimizer, accum=accum, zero1=zero1, zero2=zero2
     )
     if mesh is not None:
         params = shard_params(params, cfg, mesh)
@@ -673,6 +711,12 @@ def dryrun_train_step(n_devices: int, backend: Optional[str] = None) -> None:
         ztok = rng.integers(0, zcfg.vocab, (n_devices, 17)).astype(np.int32)
         zp, zs, zloss = zstep(zp, zs, ztok)
         assert np.isfinite(float(zloss)), "zero1 loss not finite"
+        # ZeRO-2: gradient reduce-scatter layout must compile and step
+        zp2, zs2, zstep2 = init_train_state(zcfg, dp_mesh, seed=0, zero2=True)
+        zp2, zs2, zloss2 = zstep2(zp2, zs2, ztok)
+        assert np.isfinite(float(zloss2)), "zero2 loss not finite"
+        assert np.allclose(float(zloss), float(zloss2), atol=1e-5), (
+            "zero2 first-step loss diverged from zero1")
         shapes = {np.shape(p) for p in jax.tree_util.tree_leaves(zp)}
         split = 0
         for leaf in jax.tree_util.tree_leaves(zs):

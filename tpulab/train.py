@@ -108,6 +108,7 @@ def train(
     schedule: str = "const",
     clip_norm: float = 0.0,
     zero1: bool = False,
+    zero2: bool = False,
     data_dir: Optional[str] = None,
 ):
     """Run the loop; returns (final_step, last_loss).
@@ -129,8 +130,9 @@ def train(
     # refuse rather than silently no-op: a user asking for ZeRO-1 is
     # counting on the optimizer-memory shard — running replicated and
     # reporting success would be a lie
+    zero1 = bool(zero1 or zero2)  # stage 2 builds on stage 1's layouts
     if zero1 and model != "labformer":
-        raise ValueError("zero1 is implemented for the labformer trainer")
+        raise ValueError("zero1/zero2 are implemented for the labformer trainer")
     if data_dir and model != "labformer":
         raise ValueError(
             "data_dir streams byte tokens — only the labformer consumes it"
@@ -237,7 +239,8 @@ def train(
             else:
                 mesh = make_mesh(n_devices=mesh_devices, axes=axes)
         params, opt_state, train_step = init_train_state(
-            cfg, mesh, seed=seed, optimizer=optimizer, accum=accum, zero1=zero1
+            cfg, mesh, seed=seed, optimizer=optimizer, accum=accum,
+            zero1=zero1, zero2=zero2,
         )
         if data_dir:
             from tpulab.io.loader import TokenLoader
@@ -430,6 +433,10 @@ def main(argv=None) -> int:
                     help="global gradient-norm clip (0 = off)")
     ap.add_argument("--zero1", action="store_true",
                     help="shard optimizer state over the dp axis (ZeRO-1)")
+    ap.add_argument("--zero2", action="store_true",
+                    help="ZeRO-2: additionally shard gradients over dp "
+                         "(reduce-scatter instead of all-reduce; implies "
+                         "--zero1)")
     ap.add_argument("--data-dir", default=None,
                     help="stream byte tokens from files via the native "
                          "prefetching loader (default: synthetic stream)")
@@ -457,6 +464,7 @@ def main(argv=None) -> int:
         moe_impl=args.moe_impl,
         moe_aux_weight=args.moe_aux_weight,
         zero1=args.zero1,
+        zero2=args.zero2,
         data_dir=args.data_dir,
     )
     print(json.dumps({"final_step": step, "loss": loss}))

@@ -224,6 +224,21 @@ class TestSharded:
         assert all(np.isfinite(losses)), losses
         assert losses[-1] < losses[0], losses
 
+    def test_zigzag_flash_local_parity(self, mesh, rng):
+        """Zigzag sp with flash local attends (attn_impl=flash) ==
+        single-device dense."""
+        import dataclasses
+
+        zz = dataclasses.replace(CFG, sp_impl="zigzag", attn_impl="flash")
+        base = dataclasses.replace(CFG, attn_impl="dense")
+        params = init_params(CFG, seed=0)
+        tokens = _tokens(rng, b=4, s=32)
+        want = np.asarray(forward(params, tokens, base, mesh=None))
+        sharded = shard_params(params, CFG, mesh)
+        tok_sh = jax.device_put(tokens, NamedSharding(mesh, _restrict(P("dp", None), mesh)))
+        got = np.asarray(jax.jit(lambda p, t: forward(p, t, zz, mesh=mesh))(sharded, tok_sh))
+        np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
     def test_ulysses_flash_local_parity(self, mesh, rng):
         """Ulysses sp with the Pallas flash kernel as the gathered-sequence
         local attention (attn_impl=flash) == single-device dense."""

@@ -100,19 +100,6 @@ class LabformerConfig:
                 f"n_heads={self.n_heads} must be a multiple of "
                 f"n_kv_heads={self.n_kv_heads}"
             )
-        if self.sp_impl == "zigzag" and self.attn_impl == "flash":
-            # the zigzag body computes dense (2hl x hl) f32 score blocks
-            # per ring step; running that while the user explicitly asked
-            # for flash would mislabel measurements AND lose flash's
-            # O(seq) memory at exactly the lengths it matters.  (A flash
-            # local attend needs a rectangular-causal kernel variant —
-            # not built yet.)  attn_impl="auto" stays valid: it promises
-            # a heuristic, not a specific path.
-            raise ValueError(
-                "sp_impl='zigzag' has no flash local attention yet; use "
-                "attn_impl='auto'/'dense' with zigzag, or sp_impl='ring' "
-                "for the flash ring"
-            )
 
     @property
     def head_dim(self) -> int:
@@ -343,10 +330,14 @@ def _attention(x, layer, cfg: LabformerConfig, mesh: Optional[Mesh], positions):
             # zigzag sequence order — _forward_scan permutes tokens and
             # rope positions once at the model boundary, so every layer
             # runs shuffle-free (per-layer global gathers would cost
-            # more ICI than the halved attention FLOPs save)
-            from tpulab.parallel.ring import _zigzag_body
+            # more ICI than the halved attention FLOPs save).  attn_impl
+            # picks the local body: flash folds equal-length (hl x hl)
+            # Pallas calls via lse merges, O(seq/p * d) memory
+            from tpulab.parallel.ring import _zigzag_local_body
 
-            body = functools.partial(_zigzag_body, axis="sp")
+            body = _zigzag_local_body(
+                "sp", cfg.attn_impl, s // mesh.shape["sp"]
+            )
         elif cfg.sp_impl == "ulysses":
             from tpulab.parallel.ring import _ulysses_body
 

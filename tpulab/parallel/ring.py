@@ -37,7 +37,7 @@ from tpulab.runtime.device import commit
 NEG_INF = -1e30  # large-negative instead of -inf: keeps masked softmax NaN-free
 
 
-def _block_attend(q, k, v, bias):
+def _block_attend(q, k, bias):
     """Scores for one (q-block, k-block) pair: (..., hq, hk) f32."""
     s = jnp.einsum("...qhd,...khd->...hqk", q, k).astype(jnp.float32)
     return s + bias
@@ -112,7 +112,7 @@ def _ring_body(q, k, v, *, axis: str, causal: bool):
         # the K/V block visiting at step t originated at rank (idx - t) mod p
         src = (idx - t) % p
         bias = _causal_bias(idx * seq_local + local_pos, src * seq_local + local_pos) if causal else 0.0
-        s = _block_attend(qs, kt, vt, bias)
+        s = _block_attend(qs, kt, bias)
         m, l, o = _online_softmax_step((m, l, o), s, vt)
         # rotate for the next step (the final rotation is harmless and
         # keeps the loop body uniform for lax.fori_loop)
@@ -166,11 +166,7 @@ def _ring_body_flash(q, k, v, *, axis: str, causal: bool):
         vt = jax.lax.ppermute(vt, axis, perm)
         src = (idx - t) % p  # origin rank of the visiting block
         o2, lse2 = attend(q, kt, vt, causal=False)
-        lse_new = jnp.logaddexp(lse, lse2)
-        o_new = (
-            o * jnp.exp(lse - lse_new)[..., None]
-            + o2.astype(jnp.float32) * jnp.exp(lse2 - lse_new)[..., None]
-        )
+        o_new, lse_new = _lse_merge(o, lse, o2.astype(jnp.float32), lse2)
         if causal:
             # visiting blocks strictly earlier in the sequence merge;
             # later ones are fully masked (select keeps control flow
@@ -290,10 +286,10 @@ def _zigzag_body(q, k, v, *, axis: str):
     # the high half
     k_a, v_a = k[..., :hl, :, :], v[..., :hl, :, :]
     k_b, v_b = k[..., hl:, :, :], v[..., hl:, :, :]
-    s_low = _block_attend(qs, k_a, v_a, _causal_bias(q_pos, a_pos))
+    s_low = _block_attend(qs, k_a, _causal_bias(q_pos, a_pos))
     carry = _online_softmax_step((m0, l0, o0), s_low, v_a)
     qs_b = qs[..., hl:, :, :]
-    s_high = _block_attend(qs_b, k_b, v_b, _causal_bias(b_pos, b_pos))
+    s_high = _block_attend(qs_b, k_b, _causal_bias(b_pos, b_pos))
     # fold into the b slice of the accumulators only
     m, l, o = carry
     mb, lb, ob = (m[..., hl:], l[..., hl:], o[..., hl:, :, :])
@@ -315,7 +311,7 @@ def _zigzag_body(q, k, v, *, axis: str):
             # high half ((2p-1-src)·hl onward) is later than all local
             # queries and is not computed at all
             m, l, o = mlo
-            s = _block_attend(qs, kt[..., :hl, :, :], None, 0.0)
+            s = _block_attend(qs, kt[..., :hl, :, :], 0.0)
             return _online_softmax_step((m, l, o), s, vt[..., :hl, :, :])
 
         def from_later(mlo):
@@ -323,7 +319,7 @@ def _zigzag_body(q, k, v, *, axis: str):
             # whole visiting block (both its halves precede b_pos)
             m, l, o = mlo
             mb, lb, ob = (m[..., hl:], l[..., hl:], o[..., hl:, :, :])
-            s = _block_attend(qs_b, kt, None, 0.0)
+            s = _block_attend(qs_b, kt, 0.0)
             mb, lb, ob = _online_softmax_step((mb, lb, ob), s, vt)
             return (m.at[..., hl:].set(mb),
                     l.at[..., hl:].set(lb),
@@ -337,8 +333,103 @@ def _zigzag_body(q, k, v, *, axis: str):
     return out.astype(q.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("mesh", "axis"))
-def _zigzag_sharded(q, k, v, *, mesh: Mesh, axis: str):
+def _lse_merge(o1, lse1, o2, lse2):
+    """Combine two attention partials over disjoint key sets.
+
+    ``o`` (..., s, h, d) f32, ``lse`` (..., s, h) f32 — the flash
+    (output, logsumexp) contract; exact up to float rounding.
+    """
+    lse = jnp.logaddexp(lse1, lse2)
+    o = (o1 * jnp.exp(lse1 - lse)[..., None]
+         + o2 * jnp.exp(lse2 - lse)[..., None])
+    return o, lse
+
+
+def _zigzag_body_flash(q, k, v, *, axis: str):
+    """Zigzag ring attention with the Pallas flash kernel as the local
+    attention (runs in shard_map; requires (batch, seq/p, heads, d)).
+
+    Same balance argument as :func:`_zigzag_body`, but every block
+    attend is an EQUAL-LENGTH (hl x hl) flash call — the rectangular
+    pairs split into two square ones — so per-device memory is
+    O(hl * d) instead of (2hl x hl) f32 score blocks, and both cond
+    branches run exactly two flash calls.  Trainable end to end through
+    the kernel's custom_vjp (o and lse cotangents).
+    """
+    from tpulab.ops.pallas.attention import flash_attention_with_lse
+
+    p = jax.lax.axis_size(axis)
+    idx = jax.lax.axis_index(axis)
+    hl = q.shape[1] // 2
+    blk = _pick_flash_block(hl)
+    attend = functools.partial(
+        flash_attention_with_lse, block_q=blk, block_k=blk
+    )
+
+    q_a, q_b = q[:, :hl], q[:, hl:]
+    k_a, v_a = k[:, :hl], v[:, :hl]
+    k_b, v_b = k[:, hl:], v[:, hl:]
+
+    # step 0 (self): q_a causal vs kv_a; q_b = merge(causal vs kv_b,
+    # full vs kv_a) — q_b's global positions are later than all of kv_a
+    o_a, lse_a = attend(q_a, k_a, v_a, causal=True)
+    o_a = o_a.astype(jnp.float32)
+    ob1, lb1 = attend(q_b, k_b, v_b, causal=True)
+    ob2, lb2 = attend(q_b, k_a, v_a, causal=False)
+    o_b, lse_b = _lse_merge(ob1.astype(jnp.float32), lb1,
+                            ob2.astype(jnp.float32), lb2)
+
+    perm = [(i, (i + 1) % p) for i in range(p)]
+
+    def step(t, carry):
+        o_a, lse_a, o_b, lse_b, kt, vt = carry
+        kt = jax.lax.ppermute(kt, axis, perm)
+        vt = jax.lax.ppermute(vt, axis, perm)
+        src = (idx - t) % p
+        kt_a, vt_a = kt[:, :hl], vt[:, :hl]
+        kt_b, vt_b = kt[:, hl:], vt[:, hl:]
+
+        def from_earlier(c):
+            # both q halves fully attend the visitor's low half
+            o_a, lse_a, o_b, lse_b = c
+            oa2, la2 = attend(q_a, kt_a, vt_a, causal=False)
+            ob2, lb2 = attend(q_b, kt_a, vt_a, causal=False)
+            o_a2, lse_a2 = _lse_merge(o_a, lse_a, oa2.astype(jnp.float32), la2)
+            o_b2, lse_b2 = _lse_merge(o_b, lse_b, ob2.astype(jnp.float32), lb2)
+            return o_a2, lse_a2, o_b2, lse_b2
+
+        def from_later(c):
+            # only the high q half attends — both visitor halves in full
+            o_a, lse_a, o_b, lse_b = c
+            ob2, lb2 = attend(q_b, kt_a, vt_a, causal=False)
+            ob3, lb3 = attend(q_b, kt_b, vt_b, causal=False)
+            o_b2, lse_b2 = _lse_merge(o_b, lse_b, ob2.astype(jnp.float32), lb2)
+            o_b2, lse_b2 = _lse_merge(o_b2, lse_b2, ob3.astype(jnp.float32), lb3)
+            return o_a, lse_a, o_b2, lse_b2
+
+        o_a, lse_a, o_b, lse_b = jax.lax.cond(
+            src < idx, from_earlier, from_later, (o_a, lse_a, o_b, lse_b)
+        )
+        return o_a, lse_a, o_b, lse_b, kt, vt
+
+    o_a, lse_a, o_b, lse_b, _, _ = jax.lax.fori_loop(
+        1, p, step, (o_a, lse_a, o_b, lse_b, k, v)
+    )
+    return jnp.concatenate([o_a, o_b], axis=1).astype(q.dtype)
+
+
+def _zigzag_local_body(axis: str, local_impl: str, s_local: int):
+    """Pick the zigzag per-device body for ``local_impl`` (same contract
+    as ring's: "dense" | "flash" | "auto", auto -> flash from 1024
+    local tokens)."""
+    if local_impl == "flash" or (local_impl == "auto" and s_local >= 1024):
+        return functools.partial(_zigzag_body_flash, axis=axis)
+    return functools.partial(_zigzag_body, axis=axis)
+
+
+@functools.partial(jax.jit, static_argnames=("mesh", "axis", "local_impl"))
+def _zigzag_sharded(q, k, v, *, mesh: Mesh, axis: str,
+                    local_impl: str = "dense"):
     """Standalone zigzag entry: layout gathers at the jit level around a
     shard_map of the body.  (labformer does NOT route through here — it
     permutes once at the model boundary and wraps _zigzag_body in its
@@ -353,7 +444,7 @@ def _zigzag_sharded(q, k, v, *, mesh: Mesh, axis: str):
     perm = _zigzag_perm(seq, p)
     inv = np.argsort(perm)
     spec = P(None, axis, None, None)
-    body = functools.partial(_zigzag_body, axis=axis)
+    body = _zigzag_local_body(axis, local_impl, seq // p)
     qz, kz, vz = (x[:, perm] for x in (q, k, v))
     oz = jax.shard_map(
         body, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
@@ -369,6 +460,7 @@ def zigzag_ring_attention(
     *,
     mesh: Optional[Mesh] = None,
     axis: str = "sp",
+    local_impl: str = "dense",
 ) -> jax.Array:
     """Load-balanced CAUSAL ring attention over (batch, seq, heads, d).
 
@@ -379,13 +471,17 @@ def zigzag_ring_attention(
     of masking dead ones after the fact.  Inputs and outputs use the
     NORMAL sequence order — the layout shuffle is internal (one gather
     each way at the jit boundary).  Non-causal attention is already
-    balanced; use :func:`ring_attention` for it.
+    balanced; use :func:`ring_attention` for it.  ``local_impl``:
+    "dense" | "flash" | "auto" — flash runs every block attend as an
+    equal-length (hl x hl) Pallas call with lse-merged partials,
+    O(seq/p * d) memory per device.
     """
     mesh = mesh or make_mesh(axes=(axis,))
     spec = NamedSharding(mesh, P(None, axis, None, None))
     q, k, v = (jax.device_put(commit(x, mesh_anchor(mesh)), spec)
                for x in (q, k, v))
-    return _zigzag_sharded(q, k, v, mesh=mesh, axis=axis)
+    return _zigzag_sharded(q, k, v, mesh=mesh, axis=axis,
+                           local_impl=local_impl)
 
 
 def _ulysses_local_attention(q, k, v, causal: bool, local_impl: str):

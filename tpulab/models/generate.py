@@ -125,10 +125,12 @@ def _prefill(params, prompt, cfg: LabformerConfig, cache_len: int):
     h, dh, kvh = cfg.n_heads, cfg.head_dim, cfg.kv_heads
     x = embed_lookup(params["embed"], prompt, cfg.dtype)  # (b, p, d)
     positions = jnp.arange(p)
-    use_flash = cfg.attn_impl == "flash" or (cfg.attn_impl == "auto" and p >= 1024)
+    from tpulab.parallel.ring import use_flash
+
+    flash_prefill = use_flash(cfg.attn_impl, p)
 
     def attend(q, k, v):
-        if use_flash:
+        if flash_prefill:
             from tpulab.ops.pallas.attention import flash_attention
 
             return flash_attention(q, k, v, causal=True)
@@ -156,6 +158,16 @@ def _prefill(params, prompt, cfg: LabformerConfig, cache_len: int):
     x = _rmsnorm(x[:, -1:], params["final_norm"])
     logits = unembed(x, params["embed"])[:, 0, :]
     return logits, k_caches, v_caches
+
+
+def apply_repetition_penalty(logits, seen, penalty: float):
+    """HF-convention repetition discount: for every token marked in
+    ``seen`` (b, vocab) bool, positive logits divide by ``penalty`` and
+    negative multiply — both strictly lower the score for penalty > 1.
+    Module-level so the math is unit-testable in isolation."""
+    pen = jnp.float32(penalty)
+    discounted = jnp.where(logits > 0, logits / pen, logits * pen)
+    return jnp.where(seen, discounted, logits)
 
 
 def _filter_logits(logits, top_k: int, top_p: float):
@@ -193,7 +205,8 @@ def _filter_logits(logits, top_k: int, top_p: float):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("cfg", "steps", "temperature", "top_k", "top_p")
+    jax.jit, static_argnames=("cfg", "steps", "temperature", "top_k", "top_p",
+                              "repetition_penalty", "stop_token")
 )
 def generate_jit(
     params,
@@ -204,6 +217,8 @@ def generate_jit(
     temperature: float = 1.0,
     top_k: int = 0,
     top_p: float = 1.0,
+    repetition_penalty: float = 1.0,
+    stop_token: int = -1,
 ):
     """Batched prompt prefill, then sample ``steps`` tokens from the
     KV-cached decode loop.
@@ -211,11 +226,25 @@ def generate_jit(
     Greedy when ``temperature == 0``; categorical over the
     temperature-scaled, top-k/top-p-filtered distribution otherwise
     (``top_k=0`` / ``top_p=1.0`` disable the filters).
+
+    ``repetition_penalty > 1`` discounts every token already seen in the
+    prompt or generated so far (HF convention: positive logits divide by
+    the penalty, negative multiply — both strictly lower the score), via
+    a (b, vocab) presence mask carried through the scan.  It applies in
+    greedy mode too.
+
+    ``stop_token >= 0`` freezes a row once it emits that token: every
+    later position repeats the stop token (so output shapes stay static
+    — callers trim at the first occurrence).
+
     Returns (b, steps) int32.  One jitted program end to end.
     """
     b, p = prompt.shape
+    use_penalty = repetition_penalty != 1.0
 
-    def sample(logits, key):
+    def sample(logits, key, seen):
+        if use_penalty:
+            logits = apply_repetition_penalty(logits, seen, repetition_penalty)
         if temperature == 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
         # temperature BEFORE top-p (the HF-transformers convention): the
@@ -225,18 +254,31 @@ def generate_jit(
         scaled = _filter_logits(scaled, top_k, top_p)
         return jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
 
+    # presence of every prompt token, per row (vocab is the byte space)
+    seen0 = (jnp.zeros((b, cfg.vocab), bool)
+             .at[jnp.arange(b)[:, None], prompt].set(True)
+             if use_penalty else jnp.zeros((b, 1), bool))
+
     logits0, kc, vc = _prefill(params, prompt, cfg, p + steps)
     rng_key, sub = jax.random.split(rng_key)
-    tok0 = sample(logits0, sub)
+    tok0 = sample(logits0, sub, seen0)
+    done0 = (tok0 == stop_token) if stop_token >= 0 else jnp.zeros((b,), bool)
 
     def decode_step(carry, i):
-        kc, vc, tok, key = carry
+        kc, vc, tok, key, seen, done = carry
         key, sub = jax.random.split(key)
+        if use_penalty:
+            seen = seen.at[jnp.arange(b), tok].set(True)
         logits, kc, vc = _forward_step(params, tok, kc, vc, p + i, cfg)
-        return (kc, vc, sample(logits, sub), key), tok
+        nxt = sample(logits, sub, seen)
+        if stop_token >= 0:
+            nxt = jnp.where(done, jnp.int32(stop_token), nxt)
+            done = done | (nxt == stop_token)
+        return (kc, vc, nxt, key, seen, done), tok
 
-    (_, _, last, _), out = jax.lax.scan(
-        decode_step, (kc, vc, tok0, rng_key), jnp.arange(steps - 1)
+    (_, _, last, _, _, _), out = jax.lax.scan(
+        decode_step, (kc, vc, tok0, rng_key, seen0, done0),
+        jnp.arange(steps - 1),
     )
     out = jnp.concatenate([out, last[None]], axis=0)
     return out.T  # (b, steps)
@@ -251,10 +293,13 @@ def generate(
     seed: int = 0,
     top_k: int = 0,
     top_p: float = 1.0,
+    repetition_penalty: float = 1.0,
+    stop_token: int = -1,
 ) -> np.ndarray:
     key = jax.random.PRNGKey(seed)
     out = generate_jit(params, jnp.asarray(prompt, jnp.int32), key, cfg, steps,
-                       temperature, top_k, top_p)
+                       temperature, top_k, top_p, repetition_penalty,
+                       stop_token)
     return np.asarray(jax.device_get(out))
 
 
@@ -321,6 +366,13 @@ def main(argv=None) -> int:
                     help="keep only the k most likely tokens (0 = off)")
     ap.add_argument("--top-p", type=float, default=1.0,
                     help="nucleus sampling probability mass (1.0 = off)")
+    ap.add_argument("--repetition-penalty", type=float, default=1.0,
+                    help="discount tokens already in the prompt or "
+                         "output, HF convention (1.0 = off; applies to "
+                         "greedy too)")
+    ap.add_argument("--stop-byte", type=int, default=-1,
+                    help="freeze a row once it emits this byte; output "
+                         "is trimmed at its first occurrence (-1 = off)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--ckpt-dir", default=None)
     ap.add_argument("--speculative", action="store_true",
@@ -345,10 +397,12 @@ def main(argv=None) -> int:
     prompt = np.frombuffer(args.prompt.encode("utf-8"), np.uint8)[None, :].astype(np.int32)
     if args.beams:
         if args.speculative or args.temperature not in (0.0, 1.0) \
-                or args.top_k or args.top_p != 1.0:
+                or args.top_k or args.top_p != 1.0 \
+                or args.repetition_penalty != 1.0 or args.stop_byte >= 0:
             raise SystemExit(
                 "--beams is deterministic; drop --speculative/"
-                "--temperature/--top-k/--top-p"
+                "--temperature/--top-k/--top-p/--repetition-penalty/"
+                "--stop-byte"
             )
         if not 1 <= args.beams <= cfg.vocab:
             raise SystemExit(
@@ -365,10 +419,13 @@ def main(argv=None) -> int:
     elif args.speculative:
         # greedy-only: refuse explicitly-requested sampling rather than
         # silently dropping it (temperature 0 IS greedy — honor it)
-        if args.temperature not in (0.0, 1.0) or args.top_k or args.top_p != 1.0:
+        if args.temperature not in (0.0, 1.0) or args.top_k \
+                or args.top_p != 1.0 or args.repetition_penalty != 1.0 \
+                or args.stop_byte >= 0:
             raise SystemExit(
                 "--speculative decodes greedily (lossless vs the target's "
-                "greedy stream); drop --temperature/--top-k/--top-p"
+                "greedy stream); drop --temperature/--top-k/--top-p/"
+                "--repetition-penalty/--stop-byte"
             )
         from tpulab.models.quant import quantize_decode_params
         from tpulab.models.speculative import speculative_generate
@@ -382,7 +439,12 @@ def main(argv=None) -> int:
     else:
         out = generate(params, prompt, cfg, steps=args.steps,
                        temperature=args.temperature, seed=args.seed,
-                       top_k=args.top_k, top_p=args.top_p)
-    text = bytes(int(t) & 0xFF for t in out[0]).decode("utf-8", errors="replace")
+                       top_k=args.top_k, top_p=args.top_p,
+                       repetition_penalty=args.repetition_penalty,
+                       stop_token=args.stop_byte)
+    toks = [int(t) for t in out[0]]
+    if args.stop_byte >= 0 and args.stop_byte in toks:
+        toks = toks[: toks.index(args.stop_byte)]
+    text = bytes(t & 0xFF for t in toks).decode("utf-8", errors="replace")
     print(args.prompt + text)
     return 0

@@ -354,13 +354,11 @@ def _attention(x, layer, cfg: LabformerConfig, mesh: Optional[Mesh], positions):
                 _ulysses_body, axis="sp", causal=True, local_impl=cfg.attn_impl
             )
         else:
-            from tpulab.parallel.ring import _ring_body_flash
+            from tpulab.parallel.ring import _ring_body_flash, use_flash
 
-            s_local = s // mesh.shape["sp"]
-            use_flash = cfg.attn_impl == "flash" or (
-                cfg.attn_impl == "auto" and s_local >= 1024
-            )
-            ring_fn = _ring_body_flash if use_flash else _ring_body
+            ring_fn = (_ring_body_flash
+                       if use_flash(cfg.attn_impl, s // mesh.shape["sp"])
+                       else _ring_body)
             body = functools.partial(ring_fn, axis="sp", causal=True)
         # check_vma=False: the ulysses body may lower a pallas_call
         # (flash local attention), which carries no vma metadata
@@ -369,8 +367,9 @@ def _attention(x, layer, cfg: LabformerConfig, mesh: Optional[Mesh], positions):
             check_vma=False,
         )(q, k, v)
     else:
-        use_flash = cfg.attn_impl == "flash" or (cfg.attn_impl == "auto" and s >= 1024)
-        if use_flash:
+        from tpulab.parallel.ring import use_flash
+
+        if use_flash(cfg.attn_impl, s):
             from tpulab.ops.pallas.attention import flash_attention
 
             o = flash_attention(q, k, v, causal=True)

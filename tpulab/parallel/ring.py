@@ -125,6 +125,17 @@ def _ring_body(q, k, v, *, axis: str, causal: bool):
     return out.astype(q.dtype)
 
 
+FLASH_AUTO_TOKENS = 1024  # "auto" switches to flash from this many local tokens
+
+
+def use_flash(local_impl: str, n_tokens: int) -> bool:
+    """The ONE flash-selection predicate every sp/attention site shares:
+    "flash" always, "auto" from FLASH_AUTO_TOKENS local tokens, "dense"
+    never."""
+    return local_impl == "flash" or (
+        local_impl == "auto" and n_tokens >= FLASH_AUTO_TOKENS)
+
+
 def _pick_flash_block(s: int, cap: int = 512) -> int:
     """Largest divisor of ``s`` at most ``cap`` (trace-time ints) — the
     flash inner call must not pad (non-causal pad is rejected, and pad
@@ -186,9 +197,7 @@ def _ring_body_flash(q, k, v, *, axis: str, causal: bool):
 def _ring_attention_sharded(q, k, v, *, mesh: Mesh, axis: str, causal: bool,
                             local_impl: str = "dense"):
     spec = P(None, axis, None, None)  # (batch, seq, heads, d): seq sharded
-    if local_impl == "flash" or (
-        local_impl == "auto" and q.shape[1] // mesh.shape[axis] >= 1024
-    ):
+    if use_flash(local_impl, q.shape[1] // mesh.shape[axis]):
         body = functools.partial(_ring_body_flash, axis=axis, causal=causal)
     else:
         body = functools.partial(_ring_body, axis=axis, causal=causal)
@@ -422,7 +431,7 @@ def _zigzag_local_body(axis: str, local_impl: str, s_local: int):
     """Pick the zigzag per-device body for ``local_impl`` (same contract
     as ring's: "dense" | "flash" | "auto", auto -> flash from 1024
     local tokens)."""
-    if local_impl == "flash" or (local_impl == "auto" and s_local >= 1024):
+    if use_flash(local_impl, s_local):
         return functools.partial(_zigzag_body_flash, axis=axis)
     return functools.partial(_zigzag_body, axis=axis)
 
@@ -491,7 +500,7 @@ def _ulysses_local_attention(q, k, v, causal: bool, local_impl: str):
     O(seq) memory where the dense reference materializes the (h/p, s, s)
     score tensor; trainable via the kernel's custom_vjp.  ``auto`` picks
     flash from 1024 gathered tokens (mirrors labformer's attn_impl)."""
-    if local_impl == "flash" or (local_impl == "auto" and q.shape[1] >= 1024):
+    if use_flash(local_impl, q.shape[1]):
         from tpulab.ops.pallas.attention import flash_attention
 
         return flash_attention(q, k, v, causal=causal)

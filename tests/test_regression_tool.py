@@ -1,0 +1,80 @@
+"""tools/check_regression.py: the machine-readable baseline diff."""
+
+import json
+import subprocess
+import sys
+import pathlib
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _run(tmp_path, rows, baselines, extra=()):
+    bench = tmp_path / "bench.jsonl"
+    bench.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+    bfile = tmp_path / "baselines.json"
+    bfile.write_text(json.dumps({"baselines": baselines}))
+    proc = subprocess.run(
+        [sys.executable, str(ROOT / "tools" / "check_regression.py"),
+         str(bench), "--baselines", str(bfile), *extra],
+        capture_output=True, text=True,
+    )
+    return proc, bfile
+
+
+BASE = {
+    "m_ms": {"value": 1.0, "tol_rel": 0.2, "direction": "lower",
+             "measured": "r2"},
+    "m_tps": {"value": 100.0, "tol_rel": 0.2, "direction": "higher",
+              "measured": "r2"},
+}
+
+
+def test_ok_and_missing_pass(tmp_path):
+    proc, _ = _run(tmp_path, [{"metric": "m_ms", "value": 1.1}], BASE)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "[ok] m_ms" in proc.stdout
+    assert "[missing] m_tps" in proc.stdout
+
+
+def test_regression_fails_both_directions(tmp_path):
+    proc, _ = _run(tmp_path, [
+        {"metric": "m_ms", "value": 1.5},     # slower: regressed
+        {"metric": "m_tps", "value": 70.0},   # fewer tok/s: regressed
+    ], BASE)
+    assert proc.returncode == 1
+    assert proc.stdout.count("[regressed]") == 2
+
+
+def test_update_ratchets_only_improvements(tmp_path):
+    proc, bfile = _run(tmp_path, [
+        {"metric": "m_ms", "value": 0.5},     # 2x faster: improved
+        {"metric": "m_tps", "value": 95.0},   # within tol: ok
+    ], BASE, extra=("--update", "--date", "r4"))
+    assert proc.returncode == 0
+    new = json.loads(bfile.read_text())["baselines"]
+    assert new["m_ms"]["value"] == 0.5 and new["m_ms"]["measured"] == "r4"
+    assert new["m_tps"]["value"] == 100.0  # untouched
+
+
+def test_null_and_garbage_rows_ignored(tmp_path):
+    proc, _ = _run(tmp_path, [
+        {"metric": "m_ms", "value": None, "error": "relay down"},
+    ], BASE)
+    assert proc.returncode == 0
+    assert "[missing] m_ms" in proc.stdout
+
+
+def test_unknown_metric_surfaces(tmp_path):
+    proc, _ = _run(tmp_path, [
+        {"metric": "m_ms", "value": 1.0},
+        {"metric": "renamed_metric_ms", "value": 9.9},
+    ], BASE)
+    assert proc.returncode == 0
+    assert "[unknown] renamed_metric_ms" in proc.stdout
+
+
+def test_update_requires_date(tmp_path):
+    proc, _ = _run(tmp_path, [{"metric": "m_ms", "value": 0.5}], BASE,
+                   extra=("--update",))
+    assert proc.returncode == 2
+    assert "--date" in proc.stderr

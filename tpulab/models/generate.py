@@ -394,6 +394,11 @@ def main(argv=None) -> int:
     if step is not None:
         print(f"[generate] loaded checkpoint step {step}")
 
+    if args.stop_byte >= cfg.vocab:
+        raise SystemExit(
+            f"--stop-byte must be a byte in [0, {cfg.vocab - 1}] (or -1 "
+            f"= off); got {args.stop_byte}"
+        )
     prompt = np.frombuffer(args.prompt.encode("utf-8"), np.uint8)[None, :].astype(np.int32)
     if args.beams:
         if args.speculative or args.temperature not in (0.0, 1.0) \

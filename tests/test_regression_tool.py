@@ -78,3 +78,32 @@ def test_update_requires_date(tmp_path):
                    extra=("--update",))
     assert proc.returncode == 2
     assert "--date" in proc.stderr
+
+
+def test_unusable_inputs_exit_2(tmp_path):
+    import subprocess
+    proc = subprocess.run(
+        [sys.executable, str(ROOT / "tools" / "check_regression.py"),
+         str(tmp_path / "absent.jsonl")],
+        capture_output=True, text=True)
+    assert proc.returncode == 2, proc.stderr
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json")
+    bench = tmp_path / "b.jsonl"
+    bench.write_text('{"metric": "m_ms", "value": 1.0}\n')
+    proc = subprocess.run(
+        [sys.executable, str(ROOT / "tools" / "check_regression.py"),
+         str(bench), "--baselines", str(bad)],
+        capture_output=True, text=True)
+    assert proc.returncode == 2, proc.stderr
+
+
+def test_update_refuses_on_mixed_run(tmp_path):
+    proc, bfile = _run(tmp_path, [
+        {"metric": "m_ms", "value": 0.5},    # improved
+        {"metric": "m_tps", "value": 10.0},  # regressed
+    ], BASE, extra=("--update", "--date", "r4"))
+    assert proc.returncode == 1
+    assert "NOT ratcheting" in proc.stderr
+    new = json.loads(bfile.read_text())["baselines"]
+    assert new["m_ms"]["value"] == 1.0  # untouched

@@ -258,3 +258,47 @@ class TestElasticResume:
         )
         _, straight = train(steps=8, batch=4, seq=32, cfg=TINY, log=_quiet)
         assert abs(resumed_mesh - straight) < 1e-4, (resumed_mesh, straight)
+
+
+class TestElasticRecovery:
+    """--recover: roll back to the latest snapshot on a non-finite loss
+    and continue (bounded budget); --inject-fault exercises it with a
+    one-shot transient (SURVEY.md section 5.3's fault-injection tier)."""
+
+    def test_injected_fault_recovers_bit_identical(self, tmp_path):
+        """A transient fault at step 7 (snapshot at 5) must roll back,
+        replay deterministically, and land EXACTLY where the fault-free
+        run lands — rollback loses no information beyond the replay."""
+        d = str(tmp_path / "rec")
+        msgs = []
+        _, recovered = train(
+            steps=10, batch=4, seq=32, cfg=TINY, ckpt_dir=d, save_every=5,
+            recover=2, inject_fault=(7,), log=lambda m: msgs.append(str(m)),
+        )
+        _, straight = train(steps=10, batch=4, seq=32, cfg=TINY, log=_quiet)
+        assert any("[fault]" in m for m in msgs), msgs
+        assert any("[recover]" in m and "snapshot 5" in m for m in msgs), msgs
+        assert abs(recovered - straight) < 1e-6, (recovered, straight)
+
+    def test_budget_exhaustion_fails_fast(self, tmp_path):
+        """Faults at more steps than the budget covers must surface the
+        original FloatingPointError, not loop forever."""
+        d = str(tmp_path / "rec")
+        with pytest.raises(FloatingPointError, match="non-finite loss"):
+            train(
+                steps=10, batch=4, seq=32, cfg=TINY, ckpt_dir=d,
+                save_every=5, recover=1, inject_fault=(6, 7), log=_quiet,
+            )
+
+    def test_fault_before_any_snapshot_fails_fast(self, tmp_path):
+        """No snapshot to roll back to -> the pre-recovery contract."""
+        d = str(tmp_path / "rec")
+        with pytest.raises(FloatingPointError, match="non-finite loss"):
+            train(
+                steps=10, batch=4, seq=32, cfg=TINY, ckpt_dir=d,
+                save_every=50, recover=3, inject_fault=(2,), log=_quiet,
+            )
+
+    def test_recover_requires_ckpt_dir(self):
+        with pytest.raises(ValueError, match="recover"):
+            train(steps=2, batch=2, seq=32, cfg=TINY, recover=1, log=_quiet)

@@ -65,9 +65,17 @@ def main(argv=None) -> int:
         ap.error("--update requires --date (provenance must move with "
                  "the ratcheted value)")
 
-    table = json.loads(pathlib.Path(args.baselines).read_text())
-    base = table["baselines"]
-    rows = load_rows(args.bench)
+    try:
+        table = json.loads(pathlib.Path(args.baselines).read_text())
+        base = table["baselines"]
+    except (OSError, ValueError, KeyError) as e:
+        print(f"baselines unusable ({args.baselines}): {e}", file=sys.stderr)
+        return 2
+    try:
+        rows = load_rows(args.bench)
+    except OSError as e:
+        print(f"bench input unusable: {e}", file=sys.stderr)
+        return 2
     if not rows:
         print("no bench rows found", file=sys.stderr)
         return 2
@@ -107,9 +115,15 @@ def main(argv=None) -> int:
         print(f"[unknown] {m}: measured but not in the baseline table")
 
     if args.update and improved:
-        pathlib.Path(args.baselines).write_text(
-            json.dumps(table, indent=2) + "\n")
-        print(f"ratcheted {len(improved)} baseline(s) -> {args.baselines}")
+        if regressed:
+            # a half-broken run must not permanently tighten baselines
+            # for the metrics that happened to look good
+            print("NOT ratcheting: this run also contains regressions — "
+                  "fix or rerun before --update", file=sys.stderr)
+        else:
+            pathlib.Path(args.baselines).write_text(
+                json.dumps(table, indent=2) + "\n")
+            print(f"ratcheted {len(improved)} baseline(s) -> {args.baselines}")
 
     print(f"summary: {len(ok)} ok, {len(improved)} improved, "
           f"{len(regressed)} regressed, {len(missing)} missing")

@@ -81,6 +81,44 @@ def build_optimizer(
     return optax.chain(*chain)
 
 
+def _restore_latest(manager, step: int, params, opt_state):
+    """Restore a snapshot and re-place it onto the LIVE templates.
+
+    The snapshot may come from a different topology (mesh <-> single
+    device), and orbax returns COMMITTED single-device arrays that a
+    mesh-sharded jitted step rejects.  Committed template leaves get
+    their sharding back; uncommitted / numpy template leaves stay
+    uncommitted (jnp.asarray) so jit keeps the freedom to place them.
+    Shared by --resume and the non-finite-loss recovery rollback.
+    """
+    import jax
+    import jax.numpy as _jnp
+    import orbax.checkpoint as ocp
+
+    restored = manager.restore(
+        step,
+        args=ocp.args.Composite(
+            state=ocp.args.StandardRestore(
+                {"params": params, "opt_state": opt_state})
+        ),
+    )
+
+    def _replace(t, r):
+        if isinstance(t, jax.Array) and getattr(t, "committed", False):
+            return jax.device_put(r, t.sharding)
+        # jnp.asarray would keep a committed restored array
+        # committed — round-trip through host to truly uncommit
+        return _jnp.asarray(jax.device_get(r))
+
+    state = jax.tree_util.tree_map(
+        _replace,
+        {"params": params, "opt_state": opt_state},
+        {"params": restored.state["params"],
+         "opt_state": restored.state["opt_state"]},
+    )
+    return state["params"], state["opt_state"]
+
+
 def train(
     steps: int = 50,
     batch: int = 8,
@@ -110,6 +148,8 @@ def train(
     zero1: bool = False,
     zero2: bool = False,
     data_dir: Optional[str] = None,
+    recover: int = 0,
+    inject_fault: tuple = (),
 ):
     """Run the loop; returns (final_step, last_loss).
 
@@ -130,6 +170,12 @@ def train(
     # refuse rather than silently no-op: a user asking for ZeRO-1 is
     # counting on the optimizer-memory shard — running replicated and
     # reporting success would be a lie
+    if recover and not ckpt_dir:
+        raise ValueError(
+            "--recover rolls back to checkpoints: give --ckpt-dir (and a "
+            "save_every that snapshots often enough to bound lost work)"
+        )
+    inject_fault = tuple(inject_fault or ())
     zero1 = bool(zero1 or zero2)  # stage 2 builds on stage 1's layouts
     if zero1 and model != "labformer":
         raise ValueError("zero1/zero2 are implemented for the labformer trainer")
@@ -317,52 +363,52 @@ def train(
         )
         if resume and manager.latest_step() is not None:
             start_step = manager.latest_step()
-            restored = manager.restore(
-                start_step,
-                args=ocp.args.Composite(
-                    state=ocp.args.StandardRestore({"params": params, "opt_state": opt_state})
-                ),
-            )
-            # re-place onto the LIVE template's placement: the snapshot
-            # may come from a different topology (mesh <-> single
-            # device), and orbax returns COMMITTED single-device arrays
-            # that a mesh-sharded jitted step rejects.  Committed
-            # template leaves get their sharding back; uncommitted /
-            # numpy template leaves stay uncommitted (jnp.asarray) so
-            # jit keeps the freedom to place them.
-            import jax.numpy as _jnp
-
-            def _replace(t, r):
-                if isinstance(t, jax.Array) and getattr(t, "committed", False):
-                    return jax.device_put(r, t.sharding)
-                # jnp.asarray would keep a committed restored array
-                # committed — round-trip through host to truly uncommit
-                return _jnp.asarray(jax.device_get(r))
-
-            template = {"params": params, "opt_state": opt_state}
-            state = jax.tree_util.tree_map(
-                _replace,
-                template,
-                {
-                    "params": restored.state["params"],
-                    "opt_state": restored.state["opt_state"],
-                },
-            )
-            params = state["params"]
-            opt_state = state["opt_state"]
+            params, opt_state = _restore_latest(
+                manager, start_step, params, opt_state)
             log(f"[train] resumed from step {start_step}")
 
     loss = float("nan")
+    fired_faults: set = set()
+    recoveries = 0
     try:
         with maybe_trace(trace_dir):
-            for step in range(start_step, steps):
+            step = start_step
+            while step < steps:
                 data = batch_at(step)
                 t0 = time.perf_counter()
                 params, opt_state, loss = do_step(params, opt_state, data)
                 loss = float(loss)
                 dt = (time.perf_counter() - t0) * 1e3
-                if not np.isfinite(loss):  # fail fast — the CSC-macro analog
-                    raise FloatingPointError(f"non-finite loss {loss} at step {step}")
+                if step in inject_fault and step not in fired_faults:
+                    # fault injection (SURVEY.md section 5.3 names this
+                    # as the aux capability the reference lacks): fake a
+                    # transient non-finite loss ONCE per listed step — a
+                    # replayed step after rollback sees the real loss,
+                    # modeling a hardware transient rather than a
+                    # deterministic data poison
+                    fired_faults.add(step)
+                    log(f"[fault] injected non-finite loss at step {step}")
+                    loss = float("nan")
+                if not np.isfinite(loss):
+                    can_recover = (
+                        recover > 0 and recoveries < recover
+                        and manager is not None
+                        and manager.latest_step() is not None
+                    )
+                    if not can_recover:
+                        # fail fast — the CSC-macro analog
+                        raise FloatingPointError(
+                            f"non-finite loss {loss} at step {step}")
+                    recoveries += 1
+                    manager.wait_until_finished()  # an in-flight async save
+                    rollback = manager.latest_step()
+                    log(f"[recover] non-finite loss at step {step}: "
+                        f"rolling back to snapshot {rollback} "
+                        f"({recoveries}/{recover})")
+                    params, opt_state = _restore_latest(
+                        manager, rollback, params, opt_state)
+                    step = rollback
+                    continue
                 log(f"[train] step {step} loss {loss:.4f} ({dt:.1f} ms)")
                 if eval_every and (step + 1) % eval_every == 0:
                     val = eval_loss(params, step)
@@ -378,6 +424,7 @@ def train(
                             )
                         ),
                     )
+                step += 1
     finally:
         for _ld in _box.values():
             # IO failures during streaming degrade rows to token 0; the
@@ -437,6 +484,16 @@ def main(argv=None) -> int:
                     help="ZeRO-2: additionally shard gradients over dp "
                          "(reduce-scatter instead of all-reduce; implies "
                          "--zero1)")
+    ap.add_argument("--recover", type=int, default=0,
+                    help="on a non-finite loss, roll back to the latest "
+                         "checkpoint and continue, at most N times "
+                         "(0 = fail fast). Deterministic NaNs re-fail "
+                         "and exhaust the budget; transients recover.")
+    ap.add_argument("--inject-fault", type=int, action="append", default=[],
+                    metavar="STEP",
+                    help="fault injection: fake a transient non-finite "
+                         "loss at STEP (once; repeatable flag) to "
+                         "exercise --recover")
     ap.add_argument("--data-dir", default=None,
                     help="stream byte tokens from files via the native "
                          "prefetching loader (default: synthetic stream)")
@@ -466,6 +523,8 @@ def main(argv=None) -> int:
         zero1=args.zero1,
         zero2=args.zero2,
         data_dir=args.data_dir,
+        recover=args.recover,
+        inject_fault=tuple(args.inject_fault),
     )
     print(json.dumps({"final_step": step, "loss": loss}))
     return 0

@@ -160,12 +160,14 @@ def _prefill(params, prompt, cfg: LabformerConfig, cache_len: int):
     return logits, k_caches, v_caches
 
 
-def apply_repetition_penalty(logits, seen, penalty: float):
+def apply_repetition_penalty(logits, seen, penalty):
     """HF-convention repetition discount: for every token marked in
     ``seen`` (b, vocab) bool, positive logits divide by ``penalty`` and
     negative multiply — both strictly lower the score for penalty > 1.
+    ``penalty`` is a scalar or any array broadcastable against
+    ``logits`` (the paged engine passes a per-slot (S, 1) column).
     Module-level so the math is unit-testable in isolation."""
-    pen = jnp.float32(penalty)
+    pen = jnp.asarray(penalty, jnp.float32)
     discounted = jnp.where(logits > 0, logits / pen, logits * pen)
     return jnp.where(seen, discounted, logits)
 

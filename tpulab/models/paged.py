@@ -52,7 +52,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tpulab.models.generate import _attend_cached, _prefill
+from tpulab.models.generate import (_attend_cached, _prefill,
+                                    apply_repetition_penalty)
 from tpulab.models.labformer import LabformerConfig, _mlp, _rmsnorm, _rope
 from tpulab.models.quant import embed_lookup, qmat, unembed
 from tpulab.parallel.ring import NEG_INF
@@ -223,11 +224,16 @@ def _scatter_prefill(kpool, vpool, k_seq, v_seq, table_row, start, p,
 
 
 @jax.jit
-def _sample_tokens(logits, temps, keys):
+def _sample_tokens(logits, temps, keys, penalties, seen):
     """Per-slot next token: greedy where temperature == 0, else a
     categorical draw from the slot's own PRNG stream.  Returns
     ``(tokens (S,), next_keys (S, 2))`` — keys advance every tick so a
-    slot's samples form one deterministic stream per seed."""
+    slot's samples form one deterministic stream per seed.
+
+    ``penalties`` (S,) f32 with ``seen`` (S, vocab) bool applies the
+    HF-convention repetition discount per slot (1.0 = off); it feeds
+    the greedy argmax too, matching ``generate``."""
+    logits = apply_repetition_penalty(logits, seen, penalties[:, None])
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     # split FIRST, then consume one half and carry the other: feeding
     # the same key to categorical and to the next tick would correlate
@@ -258,6 +264,8 @@ class _Request:
     max_new: int
     temperature: float = 0.0    # 0 = greedy
     seed: int = 0
+    repetition_penalty: float = 1.0  # HF convention; 1.0 = off
+    stop_byte: int = -1         # finish early after emitting it; -1 = off
     out: List[int] = field(default_factory=list)
 
 
@@ -326,6 +334,8 @@ class PagedEngine:
         # request walks its own PRNG stream (seeded at admission)
         self.temps = np.zeros(slots, np.float32)
         self.keys = np.zeros((slots, 2), np.uint32)
+        self.penalties = np.ones(slots, np.float32)
+        self.seen = np.zeros((slots, cfg.vocab), bool)
         self.active: List[Optional[_Request]] = [None] * slots
         self.pending: List[_Request] = []
         self._done: Dict[int, np.ndarray] = {}
@@ -351,10 +361,15 @@ class PagedEngine:
 
     # ------------------------------------------------------------- admission
     def submit(self, prompt, max_new: int, *, temperature: float = 0.0,
-               seed: int = 0) -> int:
+               seed: int = 0, repetition_penalty: float = 1.0,
+               stop_byte: int = -1) -> int:
         """Queue a request.  ``temperature == 0`` decodes greedily;
         otherwise the slot samples from its own seeded PRNG stream —
-        per-request sampling coexists with greedy slots in one batch."""
+        per-request sampling coexists with greedy slots in one batch.
+        ``repetition_penalty`` discounts bytes already in the request's
+        prompt or output (HF convention; applies to greedy too);
+        ``stop_byte >= 0`` finishes the request early right after that
+        byte is emitted (it IS the final output token — callers trim)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if len(prompt) == 0:
             raise ValueError("empty prompt")
@@ -364,6 +379,13 @@ class PagedEngine:
             raise ValueError(f"max_new must be >= 1, got {max_new}")
         if not temperature >= 0:  # rejects negatives AND NaN
             raise ValueError(f"temperature must be >= 0, got {temperature}")
+        if not repetition_penalty > 0:  # rejects <= 0 AND NaN
+            raise ValueError(
+                f"repetition_penalty must be > 0, got {repetition_penalty}")
+        if not -1 <= stop_byte < self.cfg.vocab:
+            raise ValueError(
+                f"stop_byte must be -1 (off) or a byte in "
+                f"[0, {self.cfg.vocab - 1}], got {stop_byte}")
         need = self._blocks_needed(len(prompt) + max_new)
         if need > min(self.max_blocks, self.n_usable_blocks):
             raise ValueError(
@@ -374,7 +396,8 @@ class PagedEngine:
         rid = self._next_id
         self._next_id += 1
         self.pending.append(
-            _Request(rid, prompt, max_new, float(temperature), int(seed))
+            _Request(rid, prompt, max_new, float(temperature), int(seed),
+                     float(repetition_penalty), int(stop_byte))
         )
         return rid
 
@@ -460,6 +483,10 @@ class PagedEngine:
             self.keys[s] = np.asarray(
                 jax.random.PRNGKey(req.seed), np.uint32
             )
+            self.penalties[s] = req.repetition_penalty
+            self.seen[s] = False
+            if req.repetition_penalty != 1.0:
+                self.seen[s, req.prompt] = True
             self.active[s] = req
 
     def _register_prefix(self, prompt: np.ndarray, row: np.ndarray):
@@ -536,6 +563,7 @@ class PagedEngine:
         toks, new_keys = _sample_tokens(
             logits, jnp.asarray(self.temps),
             jnp.asarray(self.keys, jnp.uint32),
+            jnp.asarray(self.penalties), jnp.asarray(self.seen),
         )
         nxt = np.asarray(toks)
         # np.array (copy), not np.asarray: a zero-copy view of a jax
@@ -550,13 +578,19 @@ class PagedEngine:
             req.out.append(int(nxt[s]))
             self.lengths[s] += 1
             self.last_tok[s] = nxt[s]
-            if len(req.out) >= req.max_new:
+            self.seen[s, int(nxt[s])] = True
+            stopped = req.stop_byte >= 0 and int(nxt[s]) == req.stop_byte
+            if stopped or len(req.out) >= req.max_new:
+                # deref what ADMISSION allocated (prompt + max_new),
+                # regardless of how early the request finished
                 used = self._blocks_needed(len(req.prompt) + req.max_new)
                 for b in self.tables[s, :used]:
                     self._deref(int(b))
                 self.tables[s] = TRASH
                 self.lengths[s] = 0
                 self.temps[s] = 0.0
+                self.penalties[s] = 1.0
+                self.seen[s] = False
                 self.active[s] = None
                 self._done[req.req_id] = np.asarray(req.out, np.int32)
                 self.counters["requests_done"] += 1

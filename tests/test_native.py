@@ -4,6 +4,7 @@ Builds artifacts on demand with tools/build_native.py (g++ is part of
 the toolchain contract); the daemon runs on the CPU backend.
 """
 
+import json
 import os
 import pathlib
 import shutil
@@ -389,3 +390,27 @@ class TestDaemonSampling:
         assert s3 == 0 and len(greedy) == 8
         # hot sampling almost surely diverges from greedy within 8 bytes
         assert a != greedy
+
+
+class TestDaemonSamplingControls:
+    def test_stop_byte_over_socket(self, daemon):
+        """The engine's stop-byte control rides the wire: the response
+        ends at (and includes) the stop byte while the unstopped stream
+        continues past it."""
+        base_status, base = _raw_request_bytes(
+            daemon, b'{"lab": "generate", "config": {"steps": 8}}', b"hi")
+        assert base_status == 0 and len(base) == 8
+        stop = base[3]
+        first = base.index(bytes([stop]))
+        hdr = json.dumps({"lab": "generate",
+                          "config": {"steps": 8, "stop_byte": stop}}).encode()
+        status, out = _raw_request_bytes(daemon, hdr, b"hi")
+        assert status == 0
+        assert out == base[:first + 1], (out, base, stop)
+
+    def test_bad_penalty_rejected_over_socket(self, daemon):
+        hdr = json.dumps({"lab": "generate",
+                          "config": {"steps": 4,
+                                     "repetition_penalty": -1.0}}).encode()
+        status, out = _raw_request(daemon, hdr, b"hi")
+        assert status == 1 and "repetition_penalty" in out

@@ -161,11 +161,14 @@ class _GenerateService:
             return st
 
     def generate(self, engine, prompt, steps: int, *,
-                 temperature: float = 0.0, seed: int = 0):
+                 temperature: float = 0.0, seed: int = 0,
+                 repetition_penalty: float = 1.0, stop_byte: int = -1):
         st = self._state_for(engine)
         with st.cond:
             rid = engine.submit(prompt, max_new=steps,
-                                temperature=temperature, seed=seed)
+                                temperature=temperature, seed=seed,
+                                repetition_penalty=repetition_penalty,
+                                stop_byte=stop_byte)
             if not st.stepper_alive:
                 st.stepper_alive = True
                 threading.Thread(
@@ -280,7 +283,9 @@ def _handle_generate(header: dict, payload: bytes) -> bytes:
     hit the engine's refcounted prefix cache and every request after
     the first skips compilation entirely.  Config keys: ``steps``
     (default 64), ``ckpt_dir`` (trainer snapshot; default random demo
-    weights), ``temperature`` + ``seed`` (default greedy)."""
+    weights), ``temperature`` + ``seed`` (default greedy),
+    ``repetition_penalty`` (HF convention; 1.0 = off) and ``stop_byte``
+    (finish right after emitting it; -1 = off)."""
     import numpy as np
 
     config = header.get("config") or {}
@@ -296,6 +301,8 @@ def _handle_generate(header: dict, payload: bytes) -> bytes:
         engine, prompt, steps,
         temperature=float(config.get("temperature", 0.0)),
         seed=int(config.get("seed", 0)),
+        repetition_penalty=float(config.get("repetition_penalty", 1.0)),
+        stop_byte=int(config.get("stop_byte", -1)),
     )
     return bytes(int(t) & 0xFF for t in out)
 

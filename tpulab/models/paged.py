@@ -484,9 +484,11 @@ class PagedEngine:
                 jax.random.PRNGKey(req.seed), np.uint32
             )
             self.penalties[s] = req.repetition_penalty
+            # unconditional: step() marks emitted tokens for every slot,
+            # so the prompt side must match or `seen` would mean
+            # different things for penalized vs plain requests
             self.seen[s] = False
-            if req.repetition_penalty != 1.0:
-                self.seen[s, req.prompt] = True
+            self.seen[s, req.prompt] = True
             self.active[s] = req
 
     def _register_prefix(self, prompt: np.ndarray, row: np.ndarray):

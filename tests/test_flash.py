@@ -101,3 +101,39 @@ class TestFlashBackward:
         assert _bwd_block(96) == 96
         for b in (1024, 768, 512, 96, 24):
             assert b % _bwd_block(b) == 0
+
+
+class TestIndependentBackwardBlocks:
+    """bwd_block_q/bwd_block_k tile the backward kernels independently
+    of the forward (0 = inherit + VMEM halving); gradients must be
+    invariant to the tiling choice."""
+
+    def test_grads_match_inherited_blocks(self, rng):
+        import jax
+
+        q, k, v = _qkv(rng, s=128)
+        tgt = jnp.asarray(rng.standard_normal(q.shape), jnp.float32)
+
+        def grads(**kw):
+            fn = lambda q, k, v: flash_attention(
+                q, k, v, causal=True, block_q=64, block_k=64, **kw)
+            loss = lambda q, k, v: jnp.sum((fn(q, k, v) - tgt) ** 2)
+            return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+        base = grads()
+        for bq, bk in [(32, 32), (32, 64), (128, 32)]:
+            got = grads(bwd_block_q=bq, bwd_block_k=bk)
+            for g, w, name in zip(got, base, "q k v".split()):
+                np.testing.assert_allclose(
+                    np.asarray(g), np.asarray(w), rtol=1e-5, atol=1e-5,
+                    err_msg=f"d{name} mismatch at bwd blocks ({bq},{bk})")
+
+    def test_indivisible_bwd_blocks_raise(self, rng):
+        import jax
+
+        q, k, v = _qkv(rng, s=128)
+        fn = lambda q, k, v: flash_attention(
+            q, k, v, causal=True, block_q=64, block_k=64, bwd_block_q=48)
+        loss = lambda q: jnp.sum(fn(q, k, v) ** 2)
+        with pytest.raises(ValueError, match="divisible"):
+            jax.grad(loss)(q)

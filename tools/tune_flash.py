@@ -32,11 +32,16 @@ sys.path.insert(0, str(ROOT))
 
 
 def bench_config(s: int, bq: int, bk: int, *, heads: int = 8, d: int = 64,
-                 reps: int = 5, bwd: bool = True):
+                 reps: int = 5, bwd: bool = True,
+                 bwd_bq: int = 0, bwd_bk: int = 0,
+                 fwd_ms: float | None = None):
+    """``fwd_ms`` reuses a previously measured forward time (phase 2
+    fixes the forward blocks, so re-benchmarking them per backward combo
+    would multiply chip time ~16x for nothing)."""
     import jax
     import jax.numpy as jnp
 
-    from tpulab.ops.pallas.attention import flash_attention
+    from tpulab.ops.pallas.attention import _bwd_block, flash_attention
     from tpulab.runtime.device import commit, default_device
     from tpulab.runtime.timing import measure_ms
 
@@ -48,10 +53,18 @@ def bench_config(s: int, bq: int, bk: int, *, heads: int = 8, d: int = 64,
         for _ in range(3)
     )
     row = {"seq": s, "block_q": bq, "block_k": bk}
+    if bwd:
+        # record the tiles the backward ACTUALLY runs with: explicit
+        # overrides pass through, the inherit path applies the VMEM
+        # halving — best[] winners must name benchmarked tiles
+        row["bwd_block_q"] = bwd_bq or _bwd_block(bq)
+        row["bwd_block_k"] = bwd_bk or _bwd_block(bk)
     fwd = jax.jit(lambda q, k, v: flash_attention(q, k, v, block_q=bq, block_k=bk))
     fwd_flops = heads * (4 * s * s * d) // 2
     try:
-        ms, _ = measure_ms(fwd, (q, k, v), warmup=2, reps=reps)
+        ms = fwd_ms
+        if ms is None:
+            ms, _ = measure_ms(fwd, (q, k, v), warmup=2, reps=reps)
         row["fwd_ms"] = round(ms, 4)
         row["fwd_tflops"] = round(fwd_flops / (ms / 1e3) / 1e12, 2)
     except Exception as e:
@@ -64,7 +77,8 @@ def bench_config(s: int, bq: int, bk: int, *, heads: int = 8, d: int = 64,
                    device, jnp.bfloat16)
 
         def loss(q, k, v):
-            o = flash_attention(q, k, v, block_q=bq, block_k=bk)
+            o = flash_attention(q, k, v, block_q=bq, block_k=bk,
+                                bwd_block_q=bwd_bq, bwd_block_k=bwd_bk)
             return jnp.sum(o.astype(jnp.float32) * w.astype(jnp.float32))
 
         g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
@@ -103,22 +117,47 @@ def main(argv=None) -> int:
 
     peak = generation_limits(dev.device_kind).get("bf16_peak_tflops_per_chip")
 
+    combos = (
+        [(b, b) for b in args.blocks] if args.quick
+        else list(itertools.product(args.blocks, args.blocks))
+    )
+
+    def annotate_and_keep(row, rows):
+        if peak and "fwd_tflops" in row:
+            row["fwd_mfu_pct"] = round(100 * row["fwd_tflops"] / peak, 1)
+        if peak and "bwd_tflops" in row:
+            row["bwd_mfu_pct"] = round(100 * row["bwd_tflops"] / peak, 1)
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+
     rows = []
     for s in args.seqs:
-        combos = (
-            [(b, b) for b in args.blocks] if args.quick
-            else list(itertools.product(args.blocks, args.blocks))
-        )
         for bq, bk in combos:
             if s % bq or s % bk:
                 continue
-            row = bench_config(s, bq, bk)
-            if peak and "fwd_tflops" in row:
-                row["fwd_mfu_pct"] = round(100 * row["fwd_tflops"] / peak, 1)
-            if peak and "bwd_tflops" in row:
-                row["bwd_mfu_pct"] = round(100 * row["bwd_tflops"] / peak, 1)
-            rows.append(row)
-            print(json.dumps(row), flush=True)
+            annotate_and_keep(bench_config(s, bq, bk), rows)
+
+    # phase 2: with each seq's best FORWARD blocks fixed, sweep the
+    # backward tiles independently (the dq and dkv kernels' optimum need
+    # not match the forward's — bwd_block_q/bwd_block_k on
+    # flash_attention pass them through the custom_vjp); the forward
+    # time is reused, not re-benchmarked
+    for s in args.seqs:
+        cand = [r for r in rows if r["seq"] == s and "fwd_ms" in r]
+        if not cand:
+            continue
+        fb = min(cand, key=lambda r: r["fwd_ms"])
+        for bwd_bq, bwd_bk in combos:
+            if s % bwd_bq or s % bwd_bk:
+                continue
+            if (bwd_bq, bwd_bk) == (fb.get("bwd_block_q"),
+                                    fb.get("bwd_block_k")):
+                continue  # phase 1 already measured this exact config
+            annotate_and_keep(
+                bench_config(s, fb["block_q"], fb["block_k"],
+                             bwd_bq=bwd_bq, bwd_bk=bwd_bk,
+                             fwd_ms=fb["fwd_ms"]),
+                rows)
 
     best = {}
     for s in args.seqs:
@@ -128,6 +167,9 @@ def main(argv=None) -> int:
         cand_b = [r for r in rows if r["seq"] == s and "fwdbwd_ms" in r]
         if cand_b:
             best[f"fwdbwd_s{s}"] = min(cand_b, key=lambda r: r["fwdbwd_ms"])
+        cand_bo = [r for r in rows if r["seq"] == s and "bwd_ms" in r]
+        if cand_bo:
+            best[f"bwd_s{s}"] = min(cand_bo, key=lambda r: r["bwd_ms"])
     report = {
         "device_kind": dev.device_kind,
         "peak_tflops_bf16": peak,

@@ -348,9 +348,11 @@ def _bwd_block(block: int, cap: int = 512) -> int:
 
 def _flash_bwd_call(q, k, v, o, lse, do, block_q: int, block_k: int,
                     causal: bool, interpret: bool, dlse=None):
+    # blocks arrive FINAL (the vjp wrapper applies the inherit-time
+    # _bwd_block VMEM halving; explicit tuner overrides pass through)
     bh, s, d = q.shape
-    bq = _bwd_block(block_q)
-    bk = _bwd_block(block_k)
+    bq = block_q
+    bk = block_k
     _check_blocks(s, bq, bk)
     n_q = s // bq
     n_k = s // bk
@@ -424,38 +426,53 @@ def _flash_bwd_call(q, k, v, o, lse, do, block_q: int, block_k: int,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def _flash_bhsd_lse(q, k, v, block_q: int, block_k: int, causal: bool,
-                    interpret: bool):
+                    interpret: bool, bwd_block_q: int = 0,
+                    bwd_block_k: int = 0):
     """(bh, s, d) attention returning ``(o, lse)``; both differentiable
-    (the lse cotangent folds into the delta term of the backward)."""
+    (the lse cotangent folds into the delta term of the backward).
+
+    ``bwd_block_q/bwd_block_k`` tile the two BACKWARD kernels
+    independently of the forward (0 = inherit): the dq and dkv passes
+    have different reuse patterns than the forward, so their optimum
+    need not match — tools/tune_flash.py sweeps them separately."""
     return _flash_fwd_call(q, k, v, block_q, block_k, causal, interpret)
 
 
-def _flash_bhsd_lse_fwd(q, k, v, block_q, block_k, causal, interpret):
+def _flash_bhsd_lse_fwd(q, k, v, block_q, block_k, causal, interpret,
+                        bwd_block_q, bwd_block_k):
     o, lse = _flash_fwd_call(q, k, v, block_q, block_k, causal, interpret)
     return (o, lse), (q, k, v, o, lse)
 
 
-def _flash_bhsd_lse_bwd(block_q, block_k, causal, interpret, res, ct):
+def _flash_bhsd_lse_bwd(block_q, block_k, causal, interpret,
+                        bwd_block_q, bwd_block_k, res, ct):
     do, dlse = ct
     q, k, v, o, lse = res
-    return _flash_bwd_call(q, k, v, o, lse, do, block_q, block_k, causal,
+    # explicit bwd blocks are used AS GIVEN (the tuner sweeps true tile
+    # sizes); only the inherit path applies the VMEM-budget halving
+    bq = bwd_block_q or _bwd_block(block_q)
+    bk = bwd_block_k or _bwd_block(block_k)
+    _check_blocks(q.shape[1], bq, bk)
+    return _flash_bwd_call(q, k, v, o, lse, do, bq, bk, causal,
                            interpret, dlse=dlse)
 
 
 _flash_bhsd_lse.defvjp(_flash_bhsd_lse_fwd, _flash_bhsd_lse_bwd)
 
 
-@functools.partial(jax.jit, static_argnums=(3, 4, 5, 6))
+@functools.partial(jax.jit, static_argnums=(3, 4, 5, 6, 7, 8))
 def _flash_bhsd(q, k, v, block_q: int, block_k: int, causal: bool,
-                interpret: bool):
+                interpret: bool, bwd_block_q: int = 0, bwd_block_k: int = 0):
     # dropping lse makes its cotangent a zeros array — delta' == delta
-    return _flash_bhsd_lse(q, k, v, block_q, block_k, causal, interpret)[0]
+    return _flash_bhsd_lse(q, k, v, block_q, block_k, causal, interpret,
+                           bwd_block_q, bwd_block_k)[0]
 
 
 def _flash_bshd(q, k, v, causal: bool, block_q: int, block_k: int,
-                interpret: Optional[bool], with_lse: bool):
+                interpret: Optional[bool], with_lse: bool,
+                bwd_block_q: int = 0, bwd_block_k: int = 0):
     """Shared (batch, seq, heads, d) wrapper: padding + layout + kernel."""
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
@@ -501,12 +518,15 @@ def _flash_bshd(q, k, v, causal: bool, block_q: int, block_k: int,
     qb = jnp.moveaxis(q, 2, 1).reshape(b * h, sp, d)
     kb = jnp.moveaxis(k, 2, 1).reshape(b * h, sp, d)
     vb = jnp.moveaxis(v, 2, 1).reshape(b * h, sp, d)
+    # the backward tiles the PADDED length; inherit-0 passes through
     if with_lse:
-        ob, lseb = _flash_bhsd_lse(qb, kb, vb, block_q, block_k, causal, interpret)
+        ob, lseb = _flash_bhsd_lse(qb, kb, vb, block_q, block_k, causal,
+                                   interpret, bwd_block_q, bwd_block_k)
         o = jnp.moveaxis(ob.reshape(b, h, sp, d), 1, 2)[:, :s]
         lse = jnp.moveaxis(lseb.reshape(b, h, sp), 1, 2)[:, :s]  # (b, s, h)
         return o, lse
-    ob = _flash_bhsd(qb, kb, vb, block_q, block_k, causal, interpret)
+    ob = _flash_bhsd(qb, kb, vb, block_q, block_k, causal, interpret,
+                     bwd_block_q, bwd_block_k)
     return jnp.moveaxis(ob.reshape(b, h, sp, d), 1, 2)[:, :s]
 
 
@@ -519,13 +539,18 @@ def flash_attention(
     block_q: int = 1024,
     block_k: int = 1024,
     interpret: Optional[bool] = None,
+    bwd_block_q: int = 0,
+    bwd_block_k: int = 0,
 ) -> jax.Array:
     """Exact attention over (batch, seq, heads, head_dim), O(seq) memory.
 
     ``seq`` is padded to a block multiple internally (padded K columns
-    are masked off; padded Q rows are cropped)."""
+    are masked off; padded Q rows are cropped).  ``bwd_block_q`` /
+    ``bwd_block_k`` tile the backward kernels independently (0 =
+    inherit the forward blocks); they must divide the padded seq."""
     return _flash_bshd(q, k, v, causal, block_q, block_k, interpret,
-                       with_lse=False)
+                       with_lse=False, bwd_block_q=bwd_block_q,
+                       bwd_block_k=bwd_block_k)
 
 
 def flash_attention_with_lse(
@@ -537,6 +562,8 @@ def flash_attention_with_lse(
     block_q: int = 1024,
     block_k: int = 1024,
     interpret: Optional[bool] = None,
+    bwd_block_q: int = 0,
+    bwd_block_k: int = 0,
 ):
     """Like :func:`flash_attention` but also returns the per-row
     logsumexp, shape (batch, seq, heads) f32 — the merge state for
@@ -545,4 +572,5 @@ def flash_attention_with_lse(
     are differentiable (the lse cotangent folds into the backward's
     delta term)."""
     return _flash_bshd(q, k, v, causal, block_q, block_k, interpret,
-                       with_lse=True)
+                       with_lse=True, bwd_block_q=bwd_block_q,
+                       bwd_block_k=bwd_block_k)

@@ -39,6 +39,23 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = -1e30
 
 
+def _dot_f32(a, b, dims):
+    """dot_general with f32 accumulation and dtype-matched precision.
+
+    bf16 operands: one native MXU pass (bf16xbf16->f32).  Mosaic rejects
+    ``precision=HIGHEST`` on bf16 operands ("Bad lhs type": the fp32
+    contract precision demands f32 inputs), so HIGHEST — which forces the
+    exact multi-pass f32 matmul instead of rounding f32 through bf16
+    passes — is applied only when both operands really are f32."""
+    exact = a.dtype == jnp.float32 and b.dtype == jnp.float32
+    return jax.lax.dot_general(
+        a, b, (dims, ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=(jax.lax.Precision.HIGHEST if exact
+                   else jax.lax.Precision.DEFAULT),
+    )
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
                   block_q: int, block_k: int, n_k: int, causal: bool, scale: float):
     kb = pl.program_id(2)
@@ -77,11 +94,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
         q = q_ref[0]                                      # (bq, d)
         k = k_ref[0]                                      # (bk, d)
         v = v_ref[0]                                      # (bk, d)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        )                                                 # (bq, bk) f32
+        s = _dot_f32(q, k, ((1,), (1,)))  # (bq, bk) f32
         if scale != 1.0:
             s = s * np.float32(scale)
 
@@ -99,15 +112,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
         l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
         # p rides in v's dtype (bf16 when the model is bf16): exp outputs
         # lie in [0, 1] where bf16's 8 mantissa bits keep the p@v dot
-        # within flash's usual tolerance, at one MXU pass.  For f32
-        # operands, precision=HIGHEST forces the exact multi-pass f32
-        # matmul (DEFAULT would round f32 through bf16 passes); for bf16
-        # operands it is a no-op (bf16 is already a single native pass).
-        acc_ref[:] = acc_ref[:] * alpha + jax.lax.dot_general(
-            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        )
+        # within flash's usual tolerance, at one MXU pass.
+        acc_ref[:] = acc_ref[:] * alpha + _dot_f32(p.astype(v.dtype), v, ((1,), (0,)))
         m_ref[:] = m_new
         l_ref[:] = l_new
 
@@ -219,29 +225,17 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0]                                         # (bq, d)
         lse = lse_ref[0]                                       # (bq, 1)
         delta = delta_ref[0]                                   # (bq, 1)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        )                                                      # (bq, bk)
+        s = _dot_f32(q, k, ((1,), (1,)))  # (bq, bk)
         if scale != 1.0:
             s = s * np.float32(scale)
         p = jnp.exp(s - lse)
         if masked:
             p = _causal_p_mask(p, qb, kb, block_q, block_k)
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        )                                                      # (bq, bk)
+        dp = _dot_f32(do, v, ((1,), (1,)))  # (bq, bk)
         ds = p * (dp - delta)
         # with the wrapper's prescaled q, d(q')/dq folds the 1/sqrt(d)
         # outside the custom_vjp — no in-kernel rescale of dq
-        dq = jax.lax.dot_general(
-            ds.astype(k.dtype), k, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        )
+        dq = _dot_f32(ds.astype(k.dtype), k, ((1,), (0,)))
         if scale != 1.0:
             dq = dq * np.float32(scale)
         dq_acc[:] += dq
@@ -284,34 +278,18 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0]                                         # (bq, d)
         lse = lse_ref[0]                                       # (bq, 1)
         delta = delta_ref[0]                                   # (bq, 1)
-        s = jax.lax.dot_general(
-            q, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        )                                                      # (bq, bk)
+        s = _dot_f32(q, k, ((1,), (1,)))  # (bq, bk)
         if scale != 1.0:
             s = s * np.float32(scale)
         p = jnp.exp(s - lse)
         if masked:
             p = _causal_p_mask(p, qb, kb, block_q, block_k)
-        dv_acc[:] += jax.lax.dot_general(
-            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        )
-        dp = jax.lax.dot_general(
-            do, v, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        )                                                      # (bq, bk)
+        dv_acc[:] += _dot_f32(p.astype(do.dtype), do, ((0,), (0,)))
+        dp = _dot_f32(do, v, ((1,), (1,)))  # (bq, bk)
         ds = p * (dp - delta)
         # dk = ds^T @ q' directly: q' already carries 1/sqrt(d) (the
         # wrapper prescale), so no post-dot rescale pass is needed
-        dk = jax.lax.dot_general(
-            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32,
-            precision=jax.lax.Precision.HIGHEST,
-        )
+        dk = _dot_f32(ds.astype(q.dtype), q, ((0,), (0,)))
         if scale != 1.0:
             dk = dk * np.float32(scale)
         dk_acc[:] += dk

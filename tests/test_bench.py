@@ -94,5 +94,5 @@ def test_run_benchmarks_isolates_failures(monkeypatch):
         raise RuntimeError("synthetic failure")
 
     monkeypatch.setattr(tb, "bench_sort", boom)
-    rows = tb.run_benchmarks(only="hw2_sort")
+    rows = list(tb.run_benchmarks(only="hw2_sort"))
     assert rows == [{"metric": "hw2_sort", "error": "RuntimeError: synthetic failure"}]

@@ -137,3 +137,69 @@ class TestIndependentBackwardBlocks:
         loss = lambda q: jnp.sum(fn(q, k, v) ** 2)
         with pytest.raises(ValueError, match="divisible"):
             jax.grad(loss)(q)
+
+
+class TestSlidingWindow:
+    """window > 0: each query sees its `window` most recent keys only."""
+
+    def _dense_window(self, q, k, v, window):
+        import jax
+
+        s = q.shape[1]
+        d = q.shape[-1]
+        scale = 1.0 / np.sqrt(d)
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+        qpos = np.arange(s)[:, None]
+        kpos = np.arange(s)[None, :]
+        keep = (kpos <= qpos) & (kpos > qpos - window)
+        logits = jnp.where(keep[None, None], logits, -jnp.inf)
+        w = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", w, v.astype(jnp.float32)).astype(q.dtype)
+
+    @pytest.mark.parametrize("window,bq,bk", [
+        (64, 64, 64),     # window == block: interior blocks fully visible
+        (100, 64, 32),    # window not a block multiple: both edges masked
+        (17, 32, 32),     # window << block: single diagonal-straddling band
+        (256, 128, 64),   # window == seq: must equal full causal
+    ])
+    def test_forward_matches_dense_window(self, rng, window, bq, bk):
+        q, k, v = _qkv(rng, s=256)
+        got = np.asarray(flash_attention(
+            q, k, v, causal=True, window=window, block_q=bq, block_k=bk))
+        want = np.asarray(self._dense_window(q, k, v, window))
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+    def test_window_seq_equals_full_causal(self, rng):
+        q, k, v = _qkv(rng, s=128)
+        got = np.asarray(flash_attention(
+            q, k, v, causal=True, window=128, block_q=64, block_k=64))
+        want = np.asarray(flash_attention(q, k, v, causal=True,
+                                          block_q=64, block_k=64))
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+    def test_grads_match_dense_window(self, rng):
+        import jax
+
+        q, k, v = _qkv(rng, s=128)
+        f = lambda q, k, v: flash_attention(
+            q, k, v, causal=True, window=48, block_q=32, block_k=32).sum()
+        fr = lambda q, k, v: self._dense_window(q, k, v, 48).sum()
+        got = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+        want = jax.grad(fr, argnums=(0, 1, 2))(q, k, v)
+        for g, w in zip(got, want):
+            np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                       rtol=3e-5, atol=3e-5)
+
+    def test_reference_oracle_agrees(self, rng):
+        """attention_reference(window=...) is the model tier's dense
+        window path — it must match the kernel too."""
+        q, k, v = _qkv(rng, s=128)
+        got = np.asarray(attention_reference(q, k, v, causal=True, window=32))
+        want = np.asarray(self._dense_window(q, k, v, 32))
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+    def test_noncausal_window_raises(self, rng):
+        q, k, v = _qkv(rng, s=128)
+        with pytest.raises(NotImplementedError):
+            flash_attention(q, k, v, causal=False, window=32,
+                            block_q=64, block_k=64)

@@ -70,6 +70,44 @@ class TestForward:
         assert np.isfinite(np.asarray(logits)).all()
 
 
+class TestSlidingWindowModel:
+    def test_windowed_forward_matches_masked_oracle(self, rng):
+        """cfg.attn_window must equal dense attention with the window
+        mask — checked through the full model forward."""
+        import dataclasses
+
+        wcfg = dataclasses.replace(CFG, attn_window=5)
+        params = init_params(wcfg, seed=0)
+        tok = jnp.asarray(rng.integers(0, 256, (2, 16)).astype(np.int32))
+        got = np.asarray(forward(params, tok, wcfg))
+        assert np.all(np.isfinite(got))
+        # window >= seq is exactly full causal
+        wide = dataclasses.replace(CFG, attn_window=16)
+        np.testing.assert_allclose(
+            np.asarray(forward(params, tok, wide)),
+            np.asarray(forward(params, tok, CFG)),
+            rtol=1e-6, atol=1e-6,
+        )
+        # window < seq is a different function
+        assert not np.allclose(got, np.asarray(forward(params, tok, CFG)))
+
+    def test_window_rejected_on_sp_mesh(self, rng):
+        import dataclasses
+
+        mesh = cpu_test_mesh({"sp": 2})
+        wcfg = dataclasses.replace(CFG, attn_window=5)
+        params = init_params(wcfg, seed=0)
+        tok = jnp.asarray(rng.integers(0, 256, (2, 16)).astype(np.int32))
+        with pytest.raises(NotImplementedError, match="attn_window"):
+            forward(params, tok, wcfg, mesh=mesh)
+
+    def test_negative_window_rejected(self):
+        import dataclasses
+
+        with pytest.raises(ValueError, match="attn_window"):
+            dataclasses.replace(CFG, attn_window=-1)
+
+
 class TestTraining:
     def test_loss_decreases(self, rng):
         params, opt_state, step = init_train_state(CFG, mesh=None, seed=0)

@@ -9,7 +9,7 @@ steady-state median (compile excluded, inputs pre-committed).
 from __future__ import annotations
 
 import functools
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, Iterator, List, Optional
 
 import numpy as np
 
@@ -499,7 +499,7 @@ def bench_reduce(n: int = 1 << 24, reps: int = 50) -> Dict[str, Any]:
     }
 
 
-def run_benchmarks(only: Optional[str] = None, **kw) -> List[Dict[str, Any]]:
+def run_benchmarks(only: Optional[str] = None, **kw) -> Iterator[Dict[str, Any]]:
     """Run all registered benchmarks (or one, by substring match).
 
     Extra kwargs (``reps``, ``size``, ``nc``, ``use_pallas``, ...) are
@@ -530,20 +530,25 @@ def run_benchmarks(only: Optional[str] = None, **kw) -> List[Dict[str, Any]]:
         registry["lab3_classify_1024"] = bench_lab3
     except ImportError:
         pass
-    rows = []
-    for name, fn in registry.items():
-        if only and only not in name:
-            continue
-        base_fn = fn.func if isinstance(fn, functools.partial) else fn
-        params = list(inspect.signature(base_fn).parameters)
-        bound = (
-            set(params[: len(fn.args)]) | set(fn.keywords)
-            if isinstance(fn, functools.partial)
-            else set()
-        )
-        accepted = {k: v for k, v in kw.items() if k in params and k not in bound}
-        try:
-            rows.append(fn(**accepted))
-        except Exception as e:  # one broken bench must not hide the rest
-            rows.append({"metric": name, "error": f"{type(e).__name__}: {e}"})
-    return rows
+    def _rows():
+        for name, fn in registry.items():
+            if only and only not in name:
+                continue
+            base_fn = fn.func if isinstance(fn, functools.partial) else fn
+            params = list(inspect.signature(base_fn).parameters)
+            bound = (
+                set(params[: len(fn.args)]) | set(fn.keywords)
+                if isinstance(fn, functools.partial)
+                else set()
+            )
+            accepted = {k: v for k, v in kw.items() if k in params and k not in bound}
+            try:
+                yield fn(**accepted)
+            except Exception as e:  # one broken bench must not hide the rest
+                yield {"metric": name, "error": f"{type(e).__name__}: {e}"}
+
+    # generator, not list: bench.py streams each row the moment its
+    # benchmark finishes — a 16-entry registry at reps=30 runs for tens
+    # of minutes, and a silent stdout for that long is indistinguishable
+    # from a wedged relay
+    return _rows()

@@ -17,5 +17,8 @@ def run_bench_cli(extra: List[str]) -> int:
     cfg = coerce_cli_kwargs(extra or [])
     results = run_benchmarks(**cfg)
     for row in results:
-        print(json.dumps(row))
+        # flush per row: run_benchmarks streams, and piped stdout is
+        # block-buffered — without this a tens-of-minutes registry shows
+        # nothing until exit
+        print(json.dumps(row), flush=True)
     return 0

@@ -36,7 +36,7 @@ def init_kv_cache(cfg: LabformerConfig, batch: int, max_seq: int):
     return jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype)
 
 
-def _attend_cached(q, k_cache, v_cache, pos):
+def _attend_cached(q, k_cache, v_cache, pos, window: int = 0):
     """q: (b, w, h, d) window at positions pos..pos+w-1; caches
     (b, S, kv, d).  Window row r attends keys [0, pos+r] — causal within
     the window and over the cache, so any stale cache KV PAST the
@@ -56,7 +56,12 @@ def _attend_cached(q, k_cache, v_cache, pos):
     s = jnp.einsum("bqcgd,bkcd->bcgqk", qg, k_cache).astype(jnp.float32)
     key_pos = jnp.arange(k_cache.shape[1])[None, :]            # (1, S)
     q_pos = pos + jnp.arange(w)[:, None]                       # (w, 1)
-    valid = (key_pos <= q_pos)[None, None, None, :, :]         # (1,1,1,w,S)
+    valid = key_pos <= q_pos
+    if window:
+        # sliding-window decode: cache keys older than the window are
+        # masked (matches the training-side flash window mask exactly)
+        valid = jnp.logical_and(valid, key_pos > q_pos - window)
+    valid = valid[None, None, None, :, :]                      # (1,1,1,w,S)
     s = jnp.where(valid, s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum("bcgqk,bkcd->bqcgd", p, v_cache.astype(jnp.float32))
@@ -77,7 +82,7 @@ def _decode_block(x, layer, k_cache, v_cache, pos, cfg: LabformerConfig):
     k = _rope(k, positions, cfg.rope_theta)
     k_cache = jax.lax.dynamic_update_slice(k_cache, k, (0, pos, 0, 0))
     v_cache = jax.lax.dynamic_update_slice(v_cache, v, (0, pos, 0, 0))
-    o = _attend_cached(q, k_cache, v_cache, pos)
+    o = _attend_cached(q, k_cache, v_cache, pos, cfg.attn_window)
     x = x + qmat(o.reshape(b, w, cfg.d_model), layer["wo"])
     y, _ = _mlp(_rmsnorm(x, layer["ln2"]), layer, cfg)  # aux unused at decode
     x = x + y
@@ -133,10 +138,12 @@ def _prefill(params, prompt, cfg: LabformerConfig, cache_len: int):
         if flash_prefill:
             from tpulab.ops.pallas.attention import flash_attention
 
-            return flash_attention(q, k, v, causal=True)
+            return flash_attention(q, k, v, causal=True,
+                                   window=cfg.attn_window)
         from tpulab.parallel.ring import attention_reference
 
-        return attention_reference(q, k, v, causal=True)
+        return attention_reference(q, k, v, causal=True,
+                                   window=cfg.attn_window)
 
     def layer_step(x, layer):
         xn = _rmsnorm(x, layer["ln1"])

@@ -64,6 +64,12 @@ class LabformerConfig:
     # attention backend: "dense" (O(s^2) reference), "flash" (Pallas
     # blockwise, O(s) memory), or "auto" (flash from 1024 tokens up)
     attn_impl: str = "auto"
+    # sliding-window attention (Mistral-style): 0 => full causal; > 0 =>
+    # each query sees its attn_window most recent tokens, itself
+    # included.  The flash kernel skips K blocks wholly outside the
+    # window, so long-context compute drops to O(seq * window).
+    # Single-device attention only (sp paths keep full causal reach).
+    attn_window: int = 0
     # sequence-parallel strategy when the mesh has sp > 1: "ring"
     # (ppermute K/V rotation, O(seq/p) peak memory) or "ulysses"
     # (all_to_all head/sequence transpose; needs heads % (sp*tp) == 0)
@@ -100,6 +106,8 @@ class LabformerConfig:
                 f"n_heads={self.n_heads} must be a multiple of "
                 f"n_kv_heads={self.n_kv_heads}"
             )
+        if self.attn_window < 0:
+            raise ValueError(f"attn_window must be >= 0, got {self.attn_window}")
 
     @property
     def head_dim(self) -> int:
@@ -324,6 +332,14 @@ def _attention(x, layer, cfg: LabformerConfig, mesh: Optional[Mesh], positions):
     # ulysses paths run unchanged
     k, v = repeat_kv(k, v, h)
     if mesh is not None and "sp" in mesh.axis_names and mesh.shape["sp"] > 1:
+        if cfg.attn_window:
+            # the sp bodies run full causal reach; silently dropping the
+            # window would change the model function between topologies
+            raise NotImplementedError(
+                "attn_window is single-device attention only (sp > 1 "
+                "paths do not window); shrink the mesh's sp axis or set "
+                "attn_window=0"
+            )
         spec = _restrict(P("dp", "sp", "tp", None), mesh)
         if cfg.sp_impl == "zigzag":
             # load-balanced causal ring.  The activations are ALREADY in
@@ -372,11 +388,12 @@ def _attention(x, layer, cfg: LabformerConfig, mesh: Optional[Mesh], positions):
         if use_flash(cfg.attn_impl, s):
             from tpulab.ops.pallas.attention import flash_attention
 
-            o = flash_attention(q, k, v, causal=True)
+            o = flash_attention(q, k, v, causal=True, window=cfg.attn_window)
         else:
             from tpulab.parallel.ring import attention_reference
 
-            o = attention_reference(q, k, v, causal=True)
+            o = attention_reference(q, k, v, causal=True,
+                                    window=cfg.attn_window)
     return o.reshape(b, s, d) @ layer["wo"]
 
 

@@ -81,7 +81,8 @@ def _rope_at(x, pos, theta: float):
     return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
 
 
-def _paged_attend(q, kpool_l, vpool_l, tables, lengths, block_size: int):
+def _paged_attend(q, kpool_l, vpool_l, tables, lengths, block_size: int,
+                  window: int = 0):
     """q (S, 1, h, d); pools (P, BS, kv, d); tables (S, M); lengths (S,)
     = number of valid logical positions.  Gathers each slot's logical
     key space (M*BS positions) and masks to [0, length).  Grouped heads
@@ -96,6 +97,13 @@ def _paged_attend(q, kpool_l, vpool_l, tables, lengths, block_size: int):
     qg = q.reshape(S, 1, kvh, g, dh)
     s = jnp.einsum("bqcgd,bkcd->bcgqk", qg, k).astype(jnp.float32)
     valid = jnp.arange(M * block_size)[None, :] < lengths[:, None]
+    if window:
+        # sliding-window serving: the newest valid position is the
+        # query itself (length - 1); keys below length - window are out
+        valid = jnp.logical_and(
+            valid,
+            jnp.arange(M * block_size)[None, :] > lengths[:, None] - 1 - window,
+        )
     s = jnp.where(valid[:, None, None, None, :], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum("bcgqk,bkcd->bqcgd", p, v.astype(jnp.float32))
@@ -133,7 +141,7 @@ def paged_decode_step(params, tokens, kpool, vpool, tables, lengths,
         kpool_l = kpool_l.at[blk, off].set(k[:, 0])
         vpool_l = vpool_l.at[blk, off].set(v[:, 0])
         o = _paged_attend(q, kpool_l, vpool_l, tables, lengths + 1,
-                          block_size)
+                          block_size, window=cfg.attn_window)
         x = x + qmat(o.reshape(S, 1, cfg.d_model), layer["wo"])
         y, _ = _mlp(_rmsnorm(x, layer["ln2"]), layer, cfg)
         return x + y, (kpool_l, vpool_l)
@@ -184,7 +192,7 @@ def paged_extend(params, tokens, kpool, vpool, table_row, start, n_valid,
         # generate._attend_cached IS the windowed causal attend over a
         # gathered key space (row r reads keys [0, start+r]) — one copy
         # of the numerics-sensitive recipe, shared with dense decode
-        o = _attend_cached(q, kg, vg, start)
+        o = _attend_cached(q, kg, vg, start, cfg.attn_window)
         x = x + qmat(o.reshape(1, bucket, cfg.d_model), layer["wo"])
         y, _ = _mlp(_rmsnorm(x, layer["ln2"]), layer, cfg)
         return x + y, (kpool_l, vpool_l)

@@ -57,7 +57,8 @@ def _dot_f32(a, b, dims):
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
-                  block_q: int, block_k: int, n_k: int, causal: bool, scale: float):
+                  block_q: int, block_k: int, n_k: int, causal: bool,
+                  scale: float, window: int = 0):
     kb = pl.program_id(2)
     qb = pl.program_id(1)
 
@@ -73,13 +74,20 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
     # skipped steps untouched, halving MXU work for long sequences.  The
     # final o_ref write below stays OUTSIDE the skip: for short-q rows
     # the last K steps are all masked, and kb == n_k-1 must still flush.
-    active = (kb * block_k <= qb * block_q + block_q - 1) if causal else None
-    # ...and of the active blocks, only those CROSSING the diagonal need
-    # the positional mask; interior (fully-visible) blocks skip the two
-    # iotas + compare + select — three VPU passes over (bq, bk) that,
-    # with d=64 halving the MXU, otherwise rival the matmul time
-    diag = (
-        (kb * block_k + block_k - 1 > qb * block_q) if causal else None
+    #
+    # Sliding window (window > 0, causal only): row q sees k in
+    # (q - window, q].  K blocks entirely below the union's lower edge
+    # (k_hi < q_lo - window + 1) are skipped too — compute drops from
+    # O(s^2) to O(s * window).  Blocks crossing EITHER the diagonal or
+    # the window's lower edge take the masked branch.
+    # ...and of the active blocks, only those CROSSING the diagonal (or
+    # the window edge) need the positional mask; interior (fully-visible)
+    # blocks skip the iotas + compares + selects — VPU passes over
+    # (bq, bk) that, with d=64 halving the MXU, otherwise rival the
+    # matmul time
+    active, diag = (
+        _block_edges(qb, kb, block_q, block_k, window) if causal
+        else (None, None)
     )
 
     def _compute(masked: bool):
@@ -101,7 +109,10 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
         if masked:
             q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
             k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-            s = jnp.where(k_pos <= q_pos, s, np.float32(NEG_INF))
+            keep = k_pos <= q_pos
+            if window:
+                keep = jnp.logical_and(keep, k_pos > q_pos - window)
+            s = jnp.where(keep, s, np.float32(NEG_INF))
 
         m_prev = m_ref[:]                                  # (bq, 1)
         l_prev = l_ref[:]
@@ -152,7 +163,7 @@ def _check_blocks(s: int, block_q: int, block_k: int) -> None:
 
 
 def _flash_fwd_call(q, k, v, block_q: int, block_k: int, causal: bool,
-                    interpret: bool):
+                    interpret: bool, window: int = 0):
     """(bh, s, d) fused attention; returns (o, lse) with lse (bh, s) f32."""
     bh, s, d = q.shape
     _check_blocks(s, block_q, block_k)
@@ -175,7 +186,7 @@ def _flash_fwd_call(q, k, v, block_q: int, block_k: int, causal: bool,
     )
     kernel = functools.partial(
         _flash_kernel, block_q=block_q, block_k=block_k, n_k=n_k,
-        causal=causal, scale=scale,
+        causal=causal, scale=scale, window=window,
     )
     o, lse = pl.pallas_call(
         kernel,
@@ -196,19 +207,44 @@ def _flash_fwd_call(q, k, v, block_q: int, block_k: int, causal: bool,
     return o, lse[..., 0]
 
 
-def _causal_p_mask(p, qb, kb, block_q: int, block_k: int):
-    """Zero the strictly-upper (future) positions of a p block.
+def _causal_p_mask(p, qb, kb, block_q: int, block_k: int, window: int = 0):
+    """Zero the strictly-upper (future) positions of a p block, and —
+    for sliding-window attention — positions past the window's reach.
 
     The backward reconstructs p = exp(s - lse) WITHOUT the forward's
     -inf pre-masking, so masked positions must be zeroed explicitly."""
     q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, p.shape, 0)
     k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, p.shape, 1)
-    return jnp.where(k_pos <= q_pos, p, np.float32(0.0))
+    keep = k_pos <= q_pos
+    if window:
+        keep = jnp.logical_and(keep, k_pos > q_pos - window)
+    return jnp.where(keep, p, np.float32(0.0))
+
+
+def _block_edges(qb, kb, block_q: int, block_k: int, window: int):
+    """(active, edge) predicates for a causal[, windowed] (qb, kb) block.
+
+    ``active``: the block intersects some row's visible range.  ``edge``:
+    the block crosses the diagonal or the window's lower edge and needs
+    the positional mask; active blocks with ``not edge`` are fully
+    visible.  Shared by the forward and both backward kernels so the
+    three grids agree exactly on which blocks exist."""
+    q_lo = qb * block_q
+    q_hi = qb * block_q + block_q - 1
+    k_lo = kb * block_k
+    k_hi = kb * block_k + block_k - 1
+    active = k_lo <= q_hi
+    edge = k_hi > q_lo
+    if window:
+        active = jnp.logical_and(active, k_hi >= q_lo - (window - 1))
+        edge = jnp.logical_or(edge, k_lo < q_hi - (window - 1))
+    return active, edge
 
 
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          dq_ref, dq_acc, *, block_q: int, block_k: int,
-                         n_k: int, causal: bool, scale: float):
+                         n_k: int, causal: bool, scale: float,
+                         window: int = 0):
     kb = pl.program_id(2)
     qb = pl.program_id(1)
 
@@ -230,7 +266,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = s * np.float32(scale)
         p = jnp.exp(s - lse)
         if masked:
-            p = _causal_p_mask(p, qb, kb, block_q, block_k)
+            p = _causal_p_mask(p, qb, kb, block_q, block_k, window)
         dp = _dot_f32(do, v, ((1,), (1,)))  # (bq, bk)
         ds = p * (dp - delta)
         # with the wrapper's prescaled q, d(q')/dq folds the 1/sqrt(d)
@@ -241,10 +277,9 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_acc[:] += dq
 
     if causal:
-        # diagonal split as in the forward: only blocks crossing the
-        # diagonal pay the positional mask's VPU passes
-        active = kb * block_k <= qb * block_q + block_q - 1
-        diag = kb * block_k + block_k - 1 > qb * block_q
+        # diagonal/window split as in the forward: only blocks crossing
+        # an edge pay the positional mask's VPU passes
+        active, diag = _block_edges(qb, kb, block_q, block_k, window)
         pl.when(jnp.logical_and(active, diag))(
             functools.partial(_compute, masked=True)
         )
@@ -261,7 +296,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           dk_ref, dv_ref, dk_acc, dv_acc, *, block_q: int,
-                          block_k: int, n_q: int, causal: bool, scale: float):
+                          block_k: int, n_q: int, causal: bool, scale: float,
+                          window: int = 0):
     qb = pl.program_id(2)
     kb = pl.program_id(1)
 
@@ -283,7 +319,7 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = s * np.float32(scale)
         p = jnp.exp(s - lse)
         if masked:
-            p = _causal_p_mask(p, qb, kb, block_q, block_k)
+            p = _causal_p_mask(p, qb, kb, block_q, block_k, window)
         dv_acc[:] += _dot_f32(p.astype(do.dtype), do, ((0,), (0,)))
         dp = _dot_f32(do, v, ((1,), (1,)))  # (bq, bk)
         ds = p * (dp - delta)
@@ -295,10 +331,10 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc[:] += dk
 
     if causal:
-        # a K block only sees gradient from Q blocks reaching it, and
-        # only diagonal-crossing blocks pay the positional mask
-        active = qb * block_q + block_q - 1 >= kb * block_k
-        diag = kb * block_k + block_k - 1 > qb * block_q
+        # a K block only sees gradient from Q blocks reaching it (and,
+        # windowed, from Q blocks whose window still covers it); only
+        # edge-crossing blocks pay the positional mask
+        active, diag = _block_edges(qb, kb, block_q, block_k, window)
         pl.when(jnp.logical_and(active, diag))(
             functools.partial(_compute, masked=True)
         )
@@ -325,7 +361,8 @@ def _bwd_block(block: int, cap: int = 512) -> int:
 
 
 def _flash_bwd_call(q, k, v, o, lse, do, block_q: int, block_k: int,
-                    causal: bool, interpret: bool, dlse=None):
+                    causal: bool, interpret: bool, dlse=None,
+                    window: int = 0):
     # blocks arrive FINAL (the vjp wrapper applies the inherit-time
     # _bwd_block VMEM halving; explicit tuner overrides pass through)
     bh, s, d = q.shape
@@ -363,7 +400,7 @@ def _flash_bwd_call(q, k, v, o, lse, do, block_q: int, block_k: int,
     dq = pl.pallas_call(
         functools.partial(
             _flash_bwd_dq_kernel, block_q=bq, block_k=bk, n_k=n_k,
-            causal=causal, scale=scale,
+            causal=causal, scale=scale, window=window,
         ),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         grid=(bh, n_q, n_k),
@@ -386,7 +423,7 @@ def _flash_bwd_call(q, k, v, o, lse, do, block_q: int, block_k: int,
     dk, dv = pl.pallas_call(
         functools.partial(
             _flash_bwd_dkv_kernel, block_q=bq, block_k=bk, n_q=n_q,
-            causal=causal, scale=scale,
+            causal=causal, scale=scale, window=window,
         ),
         out_shape=(
             jax.ShapeDtypeStruct(k.shape, k.dtype),
@@ -404,10 +441,10 @@ def _flash_bwd_call(q, k, v, o, lse, do, block_q: int, block_k: int,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def _flash_bhsd_lse(q, k, v, block_q: int, block_k: int, causal: bool,
                     interpret: bool, bwd_block_q: int = 0,
-                    bwd_block_k: int = 0):
+                    bwd_block_k: int = 0, window: int = 0):
     """(bh, s, d) attention returning ``(o, lse)``; both differentiable
     (the lse cotangent folds into the delta term of the backward).
 
@@ -415,17 +452,19 @@ def _flash_bhsd_lse(q, k, v, block_q: int, block_k: int, causal: bool,
     independently of the forward (0 = inherit): the dq and dkv passes
     have different reuse patterns than the forward, so their optimum
     need not match — tools/tune_flash.py sweeps them separately."""
-    return _flash_fwd_call(q, k, v, block_q, block_k, causal, interpret)
+    return _flash_fwd_call(q, k, v, block_q, block_k, causal, interpret,
+                           window)
 
 
 def _flash_bhsd_lse_fwd(q, k, v, block_q, block_k, causal, interpret,
-                        bwd_block_q, bwd_block_k):
-    o, lse = _flash_fwd_call(q, k, v, block_q, block_k, causal, interpret)
+                        bwd_block_q, bwd_block_k, window):
+    o, lse = _flash_fwd_call(q, k, v, block_q, block_k, causal, interpret,
+                             window)
     return (o, lse), (q, k, v, o, lse)
 
 
 def _flash_bhsd_lse_bwd(block_q, block_k, causal, interpret,
-                        bwd_block_q, bwd_block_k, res, ct):
+                        bwd_block_q, bwd_block_k, window, res, ct):
     do, dlse = ct
     q, k, v, o, lse = res
     # explicit bwd blocks are used AS GIVEN (the tuner sweeps true tile
@@ -434,26 +473,32 @@ def _flash_bhsd_lse_bwd(block_q, block_k, causal, interpret,
     bk = bwd_block_k or _bwd_block(block_k)
     _check_blocks(q.shape[1], bq, bk)
     return _flash_bwd_call(q, k, v, o, lse, do, bq, bk, causal,
-                           interpret, dlse=dlse)
+                           interpret, dlse=dlse, window=window)
 
 
 _flash_bhsd_lse.defvjp(_flash_bhsd_lse_fwd, _flash_bhsd_lse_bwd)
 
 
-@functools.partial(jax.jit, static_argnums=(3, 4, 5, 6, 7, 8))
+@functools.partial(jax.jit, static_argnums=(3, 4, 5, 6, 7, 8, 9))
 def _flash_bhsd(q, k, v, block_q: int, block_k: int, causal: bool,
-                interpret: bool, bwd_block_q: int = 0, bwd_block_k: int = 0):
+                interpret: bool, bwd_block_q: int = 0, bwd_block_k: int = 0,
+                window: int = 0):
     # dropping lse makes its cotangent a zeros array — delta' == delta
     return _flash_bhsd_lse(q, k, v, block_q, block_k, causal, interpret,
-                           bwd_block_q, bwd_block_k)[0]
+                           bwd_block_q, bwd_block_k, window)[0]
 
 
 def _flash_bshd(q, k, v, causal: bool, block_q: int, block_k: int,
                 interpret: Optional[bool], with_lse: bool,
-                bwd_block_q: int = 0, bwd_block_k: int = 0):
+                bwd_block_q: int = 0, bwd_block_k: int = 0,
+                window: int = 0):
     """Shared (batch, seq, heads, d) wrapper: padding + layout + kernel."""
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
+    if window and not causal:
+        raise NotImplementedError("sliding window requires causal=True")
+    if window < 0:
+        raise ValueError(f"window must be >= 0, got {window}")
     b, s, h, d = q.shape
     # fold the softmax scale into q ONCE here (f32 math, back to q's
     # dtype) instead of a per-K-step pass over every (bq, bk) score
@@ -499,12 +544,13 @@ def _flash_bshd(q, k, v, causal: bool, block_q: int, block_k: int,
     # the backward tiles the PADDED length; inherit-0 passes through
     if with_lse:
         ob, lseb = _flash_bhsd_lse(qb, kb, vb, block_q, block_k, causal,
-                                   interpret, bwd_block_q, bwd_block_k)
+                                   interpret, bwd_block_q, bwd_block_k,
+                                   window)
         o = jnp.moveaxis(ob.reshape(b, h, sp, d), 1, 2)[:, :s]
         lse = jnp.moveaxis(lseb.reshape(b, h, sp), 1, 2)[:, :s]  # (b, s, h)
         return o, lse
     ob = _flash_bhsd(qb, kb, vb, block_q, block_k, causal, interpret,
-                     bwd_block_q, bwd_block_k)
+                     bwd_block_q, bwd_block_k, window)
     return jnp.moveaxis(ob.reshape(b, h, sp, d), 1, 2)[:, :s]
 
 
@@ -519,16 +565,22 @@ def flash_attention(
     interpret: Optional[bool] = None,
     bwd_block_q: int = 0,
     bwd_block_k: int = 0,
+    window: int = 0,
 ) -> jax.Array:
     """Exact attention over (batch, seq, heads, head_dim), O(seq) memory.
 
     ``seq`` is padded to a block multiple internally (padded K columns
     are masked off; padded Q rows are cropped).  ``bwd_block_q`` /
     ``bwd_block_k`` tile the backward kernels independently (0 =
-    inherit the forward blocks); they must divide the padded seq."""
+    inherit the forward blocks); they must divide the padded seq.
+
+    ``window`` > 0 (causal only) restricts each query to its ``window``
+    most recent keys, itself included — Mistral-style sliding-window
+    attention.  K blocks wholly outside the window are skipped, so
+    compute AND gradient cost drop to O(seq * window)."""
     return _flash_bshd(q, k, v, causal, block_q, block_k, interpret,
                        with_lse=False, bwd_block_q=bwd_block_q,
-                       bwd_block_k=bwd_block_k)
+                       bwd_block_k=bwd_block_k, window=window)
 
 
 def flash_attention_with_lse(
@@ -542,6 +594,7 @@ def flash_attention_with_lse(
     interpret: Optional[bool] = None,
     bwd_block_q: int = 0,
     bwd_block_k: int = 0,
+    window: int = 0,
 ):
     """Like :func:`flash_attention` but also returns the per-row
     logsumexp, shape (batch, seq, heads) f32 — the merge state for
@@ -551,4 +604,4 @@ def flash_attention_with_lse(
     delta term)."""
     return _flash_bshd(q, k, v, causal, block_q, block_k, interpret,
                        with_lse=True, bwd_block_q=bwd_block_q,
-                       bwd_block_k=bwd_block_k)
+                       bwd_block_k=bwd_block_k, window=window)

@@ -62,11 +62,13 @@ def _causal_bias(q_pos, k_pos):
     return jnp.where(mask, 0.0, NEG_INF).astype(jnp.float32)
 
 
-def attention_reference(q, k, v, causal: bool = True):
+def attention_reference(q, k, v, causal: bool = True, window: int = 0):
     """Single-device scaled-dot-product attention oracle.
 
     Shapes ``(..., seq, heads, head_dim)``; softmax in f32, matching the
-    numerics of the distributed paths.
+    numerics of the distributed paths.  ``window`` > 0 (causal only)
+    restricts each query to its ``window`` most recent keys — the dense
+    oracle for the flash kernel's sliding-window mode.
     """
     d = q.shape[-1]
     qs = q / np.sqrt(d).astype(q.dtype)
@@ -74,6 +76,11 @@ def attention_reference(q, k, v, causal: bool = True):
     if causal:
         n_q, n_k = q.shape[-3], k.shape[-3]
         s = s + _causal_bias(jnp.arange(n_q), jnp.arange(n_k))
+        if window:
+            reach = jnp.arange(n_q)[:, None] - jnp.arange(n_k)[None, :]
+            s = jnp.where(reach >= window, NEG_INF, s)
+    elif window:
+        raise NotImplementedError("sliding window requires causal=True")
     m = jnp.max(s, axis=-1, keepdims=True)
     p = jnp.exp(s - m)
     o = jnp.einsum("...hqk,...khd->...qhd", p, v.astype(jnp.float32))

@@ -91,7 +91,7 @@ class TestSlidingWindowModel:
         # window < seq is a different function
         assert not np.allclose(got, np.asarray(forward(params, tok, CFG)))
 
-    def test_window_rejected_on_sp_mesh(self, rng):
+    def test_window_rejected_on_ring_sp_mesh(self, rng):
         import dataclasses
 
         mesh = cpu_test_mesh({"sp": 2})
@@ -100,6 +100,20 @@ class TestSlidingWindowModel:
         tok = jnp.asarray(rng.integers(0, 256, (2, 16)).astype(np.int32))
         with pytest.raises(NotImplementedError, match="attn_window"):
             forward(params, tok, wcfg, mesh=mesh)
+
+    def test_ulysses_sp_windows_match_single_device(self, rng):
+        """Ulysses gathers the full sequence per head group, so the
+        window mask applies globally — sp output must equal the
+        single-device windowed forward."""
+        import dataclasses
+
+        mesh = cpu_test_mesh({"sp": 2})
+        wcfg = dataclasses.replace(CFG, attn_window=5, sp_impl="ulysses")
+        params = init_params(wcfg, seed=0)
+        tok = jnp.asarray(rng.integers(0, 256, (2, 16)).astype(np.int32))
+        got = np.asarray(forward(params, tok, wcfg, mesh=mesh))
+        want = np.asarray(forward(params, tok, wcfg))
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
 
     def test_negative_window_rejected(self):
         import dataclasses

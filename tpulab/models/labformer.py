@@ -67,8 +67,9 @@ class LabformerConfig:
     # sliding-window attention (Mistral-style): 0 => full causal; > 0 =>
     # each query sees its attn_window most recent tokens, itself
     # included.  The flash kernel skips K blocks wholly outside the
-    # window, so long-context compute drops to O(seq * window).
-    # Single-device attention only (sp paths keep full causal reach).
+    # window, so long-context compute drops to O(seq * window).  On
+    # sp > 1 meshes only sp_impl="ulysses" supports it (each head group
+    # windows the gathered sequence); ring/zigzag raise.
     attn_window: int = 0
     # sequence-parallel strategy when the mesh has sp > 1: "ring"
     # (ppermute K/V rotation, O(seq/p) peak memory) or "ulysses"
@@ -332,13 +333,14 @@ def _attention(x, layer, cfg: LabformerConfig, mesh: Optional[Mesh], positions):
     # ulysses paths run unchanged
     k, v = repeat_kv(k, v, h)
     if mesh is not None and "sp" in mesh.axis_names and mesh.shape["sp"] > 1:
-        if cfg.attn_window:
-            # the sp bodies run full causal reach; silently dropping the
-            # window would change the model function between topologies
+        if cfg.attn_window and cfg.sp_impl != "ulysses":
+            # the ring/zigzag bodies run full causal reach; silently
+            # dropping the window would change the model function
+            # between topologies.  Ulysses windows fine: each head group
+            # sees the WHOLE gathered sequence locally.
             raise NotImplementedError(
-                "attn_window is single-device attention only (sp > 1 "
-                "paths do not window); shrink the mesh's sp axis or set "
-                "attn_window=0"
+                "attn_window over sp > 1 requires sp_impl='ulysses' "
+                "(ring/zigzag bodies do not window)"
             )
         spec = _restrict(P("dp", "sp", "tp", None), mesh)
         if cfg.sp_impl == "zigzag":
@@ -367,7 +369,8 @@ def _attention(x, layer, cfg: LabformerConfig, mesh: Optional[Mesh], positions):
             # the gathered-sequence local attention inherits attn_impl:
             # flash keeps sp long-context training O(seq) per device
             body = functools.partial(
-                _ulysses_body, axis="sp", causal=True, local_impl=cfg.attn_impl
+                _ulysses_body, axis="sp", causal=True,
+                local_impl=cfg.attn_impl, window=cfg.attn_window,
             )
         else:
             from tpulab.parallel.ring import _ring_body_flash, use_flash

@@ -500,7 +500,8 @@ def zigzag_ring_attention(
                            local_impl=local_impl)
 
 
-def _ulysses_local_attention(q, k, v, causal: bool, local_impl: str):
+def _ulysses_local_attention(q, k, v, causal: bool, local_impl: str,
+                             window: int = 0):
     """The per-head-group full-sequence attention inside Ulysses.
 
     ``flash`` streams the gathered sequence through the Pallas kernel —
@@ -510,11 +511,12 @@ def _ulysses_local_attention(q, k, v, causal: bool, local_impl: str):
     if use_flash(local_impl, q.shape[1]):
         from tpulab.ops.pallas.attention import flash_attention
 
-        return flash_attention(q, k, v, causal=causal)
-    return attention_reference(q, k, v, causal=causal)
+        return flash_attention(q, k, v, causal=causal, window=window)
+    return attention_reference(q, k, v, causal=causal, window=window)
 
 
-def _ulysses_body(q, k, v, *, axis: str, causal: bool, local_impl: str = "dense"):
+def _ulysses_body(q, k, v, *, axis: str, causal: bool,
+                  local_impl: str = "dense", window: int = 0):
     """Per-device Ulysses attention (runs in shard_map).
 
     In: (batch, seq/p, heads, d) sequence-sharded.  all_to_all re-shards
@@ -525,18 +527,19 @@ def _ulysses_body(q, k, v, *, axis: str, causal: bool, local_impl: str = "dense"
     qh = jax.lax.all_to_all(q, axis, split_axis=2, concat_axis=1, tiled=True)
     kh = jax.lax.all_to_all(k, axis, split_axis=2, concat_axis=1, tiled=True)
     vh = jax.lax.all_to_all(v, axis, split_axis=2, concat_axis=1, tiled=True)
-    o = _ulysses_local_attention(qh, kh, vh, causal, local_impl)
+    o = _ulysses_local_attention(qh, kh, vh, causal, local_impl, window)
     return jax.lax.all_to_all(o, axis, split_axis=1, concat_axis=2, tiled=True)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("mesh", "axis", "causal", "local_impl")
+    jax.jit, static_argnames=("mesh", "axis", "causal", "local_impl", "window")
 )
 def _ulysses_sharded(q, k, v, *, mesh: Mesh, axis: str, causal: bool,
-                     local_impl: str = "dense"):
+                     local_impl: str = "dense", window: int = 0):
     spec = P(None, axis, None, None)
     body = functools.partial(
-        _ulysses_body, axis=axis, causal=causal, local_impl=local_impl
+        _ulysses_body, axis=axis, causal=causal, local_impl=local_impl,
+        window=window,
     )
     # check_vma=False: pallas_call (the flash local attention) does not
     # annotate varying-mesh-axes metadata on its out_shape
@@ -555,6 +558,7 @@ def ulysses_attention(
     axis: str = "sp",
     causal: bool = True,
     local_impl: str = "dense",
+    window: int = 0,
 ) -> jax.Array:
     """Exact attention via all-to-all head/sequence transposition.
 
@@ -572,5 +576,6 @@ def ulysses_attention(
     spec = NamedSharding(mesh, P(None, axis, None, None))
     q, k, v = (jax.device_put(commit(x, mesh_anchor(mesh)), spec) for x in (q, k, v))
     return _ulysses_sharded(
-        q, k, v, mesh=mesh, axis=axis, causal=causal, local_impl=local_impl
+        q, k, v, mesh=mesh, axis=axis, causal=causal, local_impl=local_impl,
+        window=window,
     )

@@ -13,6 +13,18 @@ the headline prints last so a line-oriented consumer reading the final
 line gets the BASELINE.json metric.  A failing registry entry emits an
 ``{"metric": ..., "error": ...}`` line and never blocks the headline.
 
+Wedge-proofing (round 4): a single stalled registry entry used to hang
+the whole process before the headline ever printed — rounds 2 and 3
+both closed with a null BENCH, and round 4's first attempt stalled
+mid-registry (``labformer_decode_int8``) with the headline unmeasured.
+The parent process now (1) measures the headline FIRST in a child
+process, (2) streams the registry from a second child with a per-entry
+stall budget, and (3) always prints the held headline last.  Stalled
+children are ABANDONED, never killed (timeout-killing a pending chip
+claim is what orphans claims and wedges the relay); they write to temp
+files, not pipes, so an abandoned child finishes harmlessly and
+releases its claim when the relay recovers.
+
 Usage: ``python bench.py [--headline-only] [--only SUBSTR] [--reps N]``
 """
 
@@ -22,6 +34,8 @@ import argparse
 import json
 import os
 import sys
+
+HEADLINE_METRIC = "lab2_roberts_1024x1024_median_ms"
 
 
 def _backend_alive_with_retry() -> str | None:
@@ -160,6 +174,144 @@ def _last_good_headline() -> dict | None:
     return max(rows, key=lambda t: t[0])[1]
 
 
+class _ChildTail:
+    """Spawn a child writing to a temp file; poll complete lines.
+
+    Temp files instead of pipes for two reasons: an undrained pipe
+    blocks a chatty child (fake wedge), and an ABANDONED child keeps a
+    valid stdout — it can finish its chip work and release the claim
+    instead of dying on SIGPIPE mid-claim when the parent moves on.
+    """
+
+    def __init__(self, argv: list[str]):
+        import subprocess
+        import tempfile
+
+        self._f = tempfile.TemporaryFile(mode="w+b")  # binary: byte-exact seeks
+        self._err = tempfile.TemporaryFile(mode="w+b")
+        self._off = 0
+        self._buf = ""
+        self.proc = subprocess.Popen(argv, stdout=self._f, stderr=self._err)
+
+    def poll_lines(self) -> list[str]:
+        """New complete lines since the last call (non-blocking).
+
+        ``os.pread``, never seek/read: Popen dup2's the SAME open file
+        description into the child, so a parent seek would reposition
+        the child's write offset mid-write and clobber unread rows.
+        """
+        end = os.fstat(self._f.fileno()).st_size
+        if end > self._off:
+            self._buf += os.pread(
+                self._f.fileno(), end - self._off, self._off
+            ).decode("utf-8", errors="replace")
+            self._off = end
+        if "\n" not in self._buf:
+            return []
+        done, self._buf = self._buf.rsplit("\n", 1)
+        return [ln for ln in done.splitlines() if ln.strip()]
+
+    def exited(self):
+        return self.proc.poll()
+
+    def stderr_tail(self, n: int = 300) -> str:
+        size = os.fstat(self._err.fileno()).st_size
+        tail = os.pread(self._err.fileno(), size, 0).decode(
+            "utf-8", errors="replace")
+        tail = tail.strip().splitlines()
+        return tail[-1][:n] if tail else ""
+
+
+def _measure_headline(reps: int, budget_s: float,
+                      child_argv: list[str] | None = None) -> dict | None:
+    """Headline row via a child process, or None on stall/failure.
+
+    The child is never killed on stall — abandoned per claim discipline.
+    """
+    import time
+
+    argv = child_argv or [sys.executable, os.path.abspath(__file__),
+                          "--headline-child", "--reps", str(reps)]
+    tail = _ChildTail(argv)
+    t0 = time.monotonic()
+    row = None
+    while True:
+        rc = tail.exited()  # check BEFORE polling: lines written just
+        for ln in tail.poll_lines():  # before exit must not be lost
+            try:
+                cand = json.loads(ln)
+            except ValueError:
+                continue
+            if not isinstance(cand, dict):  # stray numeric/str debug prints
+                continue
+            if cand.get("metric") == HEADLINE_METRIC:
+                row = cand
+        if rc is not None:
+            if row is None and rc != 0:
+                print(f"[bench] headline child exited rc={rc}: "
+                      f"{tail.stderr_tail()}", file=sys.stderr, flush=True)
+            return row
+        if time.monotonic() - t0 >= budget_s:
+            print(f"[bench] headline child still running after "
+                  f"{budget_s:.0f}s — abandoned unkilled (claim discipline)",
+                  file=sys.stderr, flush=True)
+            return None
+        time.sleep(2.0)
+
+
+def _stream_registry(only: str | None, reps: int, budget_s: float,
+                     child_argv: list[str] | None = None) -> None:
+    """Relay registry rows from a child; per-entry stall budget.
+
+    Prints each non-headline row as it lands.  If the child goes
+    ``budget_s`` without completing the entry it announced (marker
+    lines ``{"__bench_starting__": name}``), prints an error row naming
+    the stalled entry and abandons the child.
+    """
+    import time
+
+    argv = child_argv or [sys.executable, os.path.abspath(__file__),
+                          "--registry-child", "--reps", str(reps)]
+    if only and not child_argv:
+        argv += ["--only", only]
+    tail = _ChildTail(argv)
+    current = None
+    last_progress = time.monotonic()
+    while True:
+        rc = tail.exited()  # check BEFORE polling: lines written just
+        lines = tail.poll_lines()  # before exit must not be lost
+        if lines:
+            last_progress = time.monotonic()
+        for ln in lines:
+            try:
+                row = json.loads(ln)
+            except ValueError:
+                continue
+            if not isinstance(row, dict):  # stray numeric/str debug prints
+                continue
+            if "__bench_starting__" in row:
+                current = row["__bench_starting__"]
+                continue
+            m = str(row.get("metric", ""))
+            if not ("lab2" in m and "1024x1024" in m):  # headline prints last
+                print(json.dumps(row), flush=True)
+        if rc is not None:
+            if rc != 0:
+                print(json.dumps({
+                    "metric": current or "registry",
+                    "error": f"registry child exited rc={rc}: "
+                             f"{tail.stderr_tail()}"}), flush=True)
+            return
+        if time.monotonic() - last_progress >= budget_s:
+            print(json.dumps({
+                "metric": current or "registry",
+                "error": f"no output for {budget_s:.0f}s (relay stall?) — "
+                         f"remaining registry entries skipped; child "
+                         f"abandoned unkilled"}), flush=True)
+            return
+        time.sleep(2.0)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument(
@@ -169,13 +321,31 @@ def main(argv=None) -> int:
     ap.add_argument("--reps", type=int, default=30)
     ap.add_argument("--skip-probe", action="store_true",
                     help="skip the backend-liveness subprocess probe")
+    ap.add_argument("--headline-child", action="store_true",
+                    help=argparse.SUPPRESS)  # internal: measure headline only
+    ap.add_argument("--registry-child", action="store_true",
+                    help=argparse.SUPPRESS)  # internal: stream registry rows
     args = ap.parse_args(argv)
+
+    if args.headline_child:
+        from tpulab.bench_image import bench_lab2
+
+        print(json.dumps(bench_lab2(size=1024, reps=args.reps)), flush=True)
+        return 0
+
+    if args.registry_child:
+        from tpulab.bench import run_benchmarks
+
+        for extra in run_benchmarks(only=args.only, reps=args.reps,
+                                    yield_markers=True):
+            print(json.dumps(extra), flush=True)
+        return 0
 
     if not args.skip_probe:
         err = _backend_alive_with_retry()
         if err:
             row = {
-                "metric": "lab2_roberts_1024x1024_median_ms",
+                "metric": HEADLINE_METRIC,
                 "value": None,
                 "unit": "ms",
                 "vs_baseline": None,
@@ -189,28 +359,37 @@ def main(argv=None) -> int:
             print(json.dumps(row), flush=True)
             return 0
 
-    from tpulab.bench_image import bench_lab2
+    budget_s = float(os.environ.get("TPULAB_BENCH_ENTRY_BUDGET_S", "600"))
+    # headline FIRST (while the relay is known-live), printed LAST:
+    # 11 outer trials + reported min/IQR tame the run-to-run variance
+    # of a ~24 us kernel (VERDICT round 2, weak #4)
+    row = _measure_headline(args.reps, budget_s)
 
     if not args.headline_only:
-        from tpulab.bench import run_benchmarks
+        _stream_registry(args.only, args.reps, budget_s)
 
-        for extra in run_benchmarks(only=args.only, reps=args.reps):
-            m = str(extra.get("metric", ""))
-            if not ("lab2" in m and "1024x1024" in m):  # headline prints last
-                print(json.dumps(extra), flush=True)
-
-    # headline last: 11 outer trials + reported min/IQR tame the
-    # run-to-run variance of a ~24 us kernel (VERDICT round 2, weak #4)
-    row = bench_lab2(size=1024, reps=args.reps)
-    headline = {
-        "metric": row["metric"],
-        "value": row["value"],
-        "unit": row["unit"],
-        "vs_baseline": row["vs_baseline"],
-    }
-    for k in ("min_ms", "p25_ms", "p75_ms", "iqr_ms", "n_trials"):
-        if k in row:
-            headline[k] = row[k]
+    if row is None:
+        headline = {
+            "metric": HEADLINE_METRIC,
+            "value": None,
+            "unit": "ms",
+            "vs_baseline": None,
+            "error": f"headline measurement produced no row within "
+                     f"{budget_s:.0f}s (relay stall mid-run?)",
+        }
+        last = _last_good_headline()
+        if last is not None:
+            headline["stale_last_measured"] = last
+    else:
+        headline = {
+            "metric": row["metric"],
+            "value": row["value"],
+            "unit": row["unit"],
+            "vs_baseline": row["vs_baseline"],
+        }
+        for k in ("min_ms", "p25_ms", "p75_ms", "iqr_ms", "n_trials"):
+            if k in row:
+                headline[k] = row[k]
     print(json.dumps(headline), flush=True)
     return 0
 

@@ -96,3 +96,92 @@ def test_run_benchmarks_isolates_failures(monkeypatch):
     monkeypatch.setattr(tb, "bench_sort", boom)
     rows = list(tb.run_benchmarks(only="hw2_sort"))
     assert rows == [{"metric": "hw2_sort", "error": "RuntimeError: synthetic failure"}]
+
+
+def test_run_benchmarks_markers(monkeypatch):
+    """yield_markers announces each entry before it runs, so the stall
+    watchdog can name the entry a wedge swallowed."""
+    import tpulab.bench as tb
+
+    monkeypatch.setattr(tb, "bench_sort", lambda **kw: {"metric": "s", "value": 1})
+    rows = list(tb.run_benchmarks(only="hw2_sort", yield_markers=True))
+    assert rows == [{"__bench_starting__": "hw2_sort"},
+                    {"metric": "s", "value": 1}]
+
+
+# --- wedge-proof parent logic (bench.py at repo root) ---------------------
+
+def _load_root_bench():
+    import importlib.util
+    import pathlib
+
+    path = pathlib.Path(__file__).resolve().parent.parent / "bench.py"
+    spec = importlib.util.spec_from_file_location("root_bench", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_measure_headline_returns_row():
+    import sys
+
+    bench = _load_root_bench()
+    code = ("import json; print(json.dumps({'metric':"
+            " 'lab2_roberts_1024x1024_median_ms', 'value': 0.03,"
+            " 'unit': 'ms', 'vs_baseline': 5.9}))")
+    got = bench._measure_headline(1, budget_s=30,
+                                  child_argv=[sys.executable, "-c", code])
+    assert got is not None and got["value"] == 0.03
+
+
+def test_measure_headline_stall_abandons_unkilled():
+    import sys
+    import time
+
+    bench = _load_root_bench()
+    t0 = time.monotonic()
+    got = bench._measure_headline(
+        1, budget_s=2,
+        child_argv=[sys.executable, "-c", "import time; time.sleep(6)"])
+    assert got is None
+    assert time.monotonic() - t0 < 5  # gave up at the budget, didn't wait out
+
+
+def test_stream_registry_relays_and_reports_stall(capsys):
+    import json as _json
+    import sys
+
+    bench = _load_root_bench()
+    # marker -> good row -> marker -> sleep past budget: the error row
+    # must name the SECOND entry and the good row must have been relayed
+    code = (
+        "import json, time, sys\n"
+        "print(json.dumps({'__bench_starting__': 'fast_one'}), flush=True)\n"
+        "print(json.dumps({'metric': 'fast_one', 'value': 1}), flush=True)\n"
+        "print(json.dumps({'__bench_starting__': 'wedged_one'}), flush=True)\n"
+        "time.sleep(8)\n"
+    )
+    bench._stream_registry(None, 1, budget_s=2,
+                           child_argv=[sys.executable, "-c", code])
+    out = [_json.loads(ln) for ln in capsys.readouterr().out.splitlines()]
+    assert {"metric": "fast_one", "value": 1} in out
+    assert any(r.get("metric") == "wedged_one" and "relay stall" in r.get("error", "")
+               for r in out)
+
+
+def test_stream_registry_suppresses_headline_row(capsys):
+    import json as _json
+    import sys
+
+    bench = _load_root_bench()
+    code = (
+        "import json\n"
+        "print(json.dumps({'metric': 'lab2_roberts_1024x1024_median_ms',"
+        " 'value': 9}), flush=True)\n"
+        "print(json.dumps({'metric': 'other', 'value': 2}), flush=True)\n"
+    )
+    bench._stream_registry(None, 1, budget_s=30,
+                           child_argv=[sys.executable, "-c", code])
+    out = [_json.loads(ln) for ln in capsys.readouterr().out.splitlines()]
+    assert {"metric": "other", "value": 2} in out
+    assert not any(r.get("metric", "").startswith("lab2_roberts") for r in out)

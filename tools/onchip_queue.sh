@@ -1,0 +1,19 @@
+#!/bin/bash
+# Sequential on-chip evidence queue (single chip -- no contention).
+cd /root/repo || exit 1
+L=results/logs
+mkdir -p "$L"
+date > $L/queue.status
+echo "== bench ==" >> $L/queue.status
+python bench.py > $L/bench_r4.log 2>&1
+echo "bench rc=$? $(date)" >> $L/queue.status
+echo "== flash_train_proof ==" >> $L/queue.status
+python tools/flash_train_proof.py > $L/flash_train.log 2>&1
+echo "flash_train rc=$? $(date)" >> $L/queue.status
+echo "== tune_flash ==" >> $L/queue.status
+python tools/tune_flash.py > $L/tune_flash.log 2>&1
+echo "tune_flash rc=$? $(date)" >> $L/queue.status
+echo "== serving_tpu ==" >> $L/queue.status
+python tools/serving_tpu.py > $L/serving_tpu.log 2>&1
+echo "serving_tpu rc=$? $(date)" >> $L/queue.status
+echo "QUEUE DONE $(date)" >> $L/queue.status

@@ -499,11 +499,16 @@ def bench_reduce(n: int = 1 << 24, reps: int = 50) -> Dict[str, Any]:
     }
 
 
-def run_benchmarks(only: Optional[str] = None, **kw) -> Iterator[Dict[str, Any]]:
+def run_benchmarks(only: Optional[str] = None, yield_markers: bool = False,
+                   **kw) -> Iterator[Dict[str, Any]]:
     """Run all registered benchmarks (or one, by substring match).
 
     Extra kwargs (``reps``, ``size``, ``nc``, ``use_pallas``, ...) are
     forwarded to each benchmark that declares the parameter.
+
+    ``yield_markers`` inserts ``{"__bench_starting__": name}`` before
+    each entry so a streaming consumer (bench.py's stall watchdog) can
+    name the entry a relay wedge swallowed.
     """
     import inspect
 
@@ -542,6 +547,8 @@ def run_benchmarks(only: Optional[str] = None, **kw) -> Iterator[Dict[str, Any]]
                 else set()
             )
             accepted = {k: v for k, v in kw.items() if k in params and k not in bound}
+            if yield_markers:
+                yield {"__bench_starting__": name}
             try:
                 yield fn(**accepted)
             except Exception as e:  # one broken bench must not hide the rest

@@ -1,19 +1,38 @@
 #!/bin/bash
 # Sequential on-chip evidence queue (single chip -- no contention).
+# Each stage is gated on a live relay probe; probes are waited on,
+# never killed (claim discipline).  Logs land in results/logs/.
 cd /root/repo || exit 1
 L=results/logs
 mkdir -p "$L"
+
+wait_relay() {
+  while true; do
+    if python -c "import jax, jax.numpy as jnp; x = jnp.ones((128, 128)); (x @ x).block_until_ready(); print('compile-ok')" \
+        > /tmp/queue_probe.out 2>&1 && grep -q compile-ok /tmp/queue_probe.out; then
+      return 0
+    fi
+    sleep 120
+  done
+}
+
+stage() {  # stage <name> <cmd...>
+  name=$1; shift
+  echo "== $name wait-relay $(date)" >> $L/queue.status
+  wait_relay
+  echo "== $name start $(date)" >> $L/queue.status
+  "$@" > "$L/$name.log" 2>&1
+  echo "== $name rc=$? $(date)" >> $L/queue.status
+}
+
 date > $L/queue.status
-echo "== bench ==" >> $L/queue.status
-python bench.py > $L/bench_r4.log 2>&1
-echo "bench rc=$? $(date)" >> $L/queue.status
-echo "== flash_train_proof ==" >> $L/queue.status
-python tools/flash_train_proof.py > $L/flash_train.log 2>&1
-echo "flash_train rc=$? $(date)" >> $L/queue.status
-echo "== tune_flash ==" >> $L/queue.status
-python tools/tune_flash.py > $L/tune_flash.log 2>&1
-echo "tune_flash rc=$? $(date)" >> $L/queue.status
-echo "== serving_tpu ==" >> $L/queue.status
-python tools/serving_tpu.py > $L/serving_tpu.log 2>&1
-echo "serving_tpu rc=$? $(date)" >> $L/queue.status
+# do not start while the pre-wedge bench still holds/awaits chip claims
+stage bench_r4        python bench.py --skip-probe
+stage train_mfu       python tools/train_mfu_probe.py
+stage flash_train     python tools/flash_train_proof.py
+stage tune_flash      python tools/tune_flash.py
+stage serving_tpu     python tools/serving_tpu.py
+stage ref_harness2    python tools/run_reference_harness.py --backend tpu --lab lab2 --k-times 5
+stage ref_harness3    python tools/run_reference_harness.py --backend tpu --lab lab3 --k-times 5
+stage parity          python tools/pallas_tpu_parity.py
 echo "QUEUE DONE $(date)" >> $L/queue.status

@@ -1,0 +1,139 @@
+"""Diagnose the s=2048 train-step MFU gap (round-4 measurement: 21.7%
+of bf16 peak vs 52-67% for the s=512 forward).
+
+Candidate causes, each isolated on the real chip:
+
+  1. flash-vs-dense in the TRAIN step at s=2048 (``attn_impl`` forced
+     both ways) — if dense trains faster at this seq, the auto
+     threshold (flash at seq >= 1024) is set too low for this chip and
+     the custom_vjp backward is the drag;
+  2. forward-only at s=2048 both ways — separates forward kernel cost
+     from the backward;
+  3. the s=512 train step — same config as the forward bench, so the
+     fwd:train ratio is measured at matched seq (healthy is ~3-4x with
+     optimizer overhead; 11x would indict the backward).
+
+Writes ``results/train_mfu_probe.json``.  CPU-safe (numbers meaningless
+there) but refuses to overwrite a TPU artifact from CPU.
+
+Usage: python tools/train_mfu_probe.py [--reps 5]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import sys
+
+import numpy as np
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+
+def _measure(cfg_kw, s: int, b: int, reps: int, train: bool,
+             smoke: bool = False):
+    import jax
+    import jax.numpy as jnp
+
+    from tpulab.bench import _mfu_fields, labformer_fwd_flops
+    from tpulab.models.labformer import (
+        LabformerConfig,
+        forward,
+        init_train_state,
+    )
+    from tpulab.runtime.device import commit, default_device
+    from tpulab.runtime.timing import measure_ms
+
+    dims = (dict(d_model=64, n_heads=2, n_layers=2, d_ff=128) if smoke
+            else dict(d_model=512, n_heads=8, n_layers=8, d_ff=2048))
+    cfg = LabformerConfig(
+        max_seq=s, dtype=jnp.bfloat16, **dims, **cfg_kw,
+    )
+    device = default_device()
+    params, opt_state, step = init_train_state(cfg, mesh=None, seed=0)
+    params = jax.device_put(params, device)
+    rng = np.random.default_rng(0)
+    if train:
+        opt_state = jax.device_put(opt_state, device)
+        tokens = commit(
+            rng.integers(0, cfg.vocab, (b, s + 1)).astype(np.int32), device
+        )
+        fn = lambda p, o, t: step(p, o, t)[2]
+        args = (params, opt_state, tokens)
+        flops = 3 * labformer_fwd_flops(cfg, b, s)
+    else:
+        tokens = commit(
+            rng.integers(0, cfg.vocab, (b, s)).astype(np.int32), device
+        )
+        fn = jax.jit(lambda p, t: forward(p, t, cfg))
+        args = (params, tokens)
+        flops = labformer_fwd_flops(cfg, b, s)
+    ms, _ = measure_ms(fn, args, warmup=2, reps=reps, outer=3)
+    row = {"median_ms": round(ms, 3),
+           "tokens_per_s": round(b * s / (ms / 1e3), 1),
+           **_mfu_fields(flops, ms, device)}
+    return row
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--reps", type=int, default=5)
+    ap.add_argument("--out", default=None,
+                    help="default: results/train_mfu_probe.json "
+                         "(smoke runs go to *_smoke.json so a code-path "
+                         "check can never clobber real evidence)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny dims + short seqs: code-path check only")
+    args = ap.parse_args(argv)
+    if args.out is None:
+        stem = "train_mfu_probe_smoke" if args.smoke else "train_mfu_probe"
+        args.out = str(ROOT / "results" / f"{stem}.json")
+
+    import jax
+
+    dev = jax.devices()[0]
+    out = pathlib.Path(args.out)
+    if dev.platform != "tpu" and out.exists():
+        try:
+            prior = json.loads(out.read_text()).get("platform")
+        except (OSError, ValueError):
+            prior = None
+        if prior == "tpu":
+            print("refusing: would overwrite a TPU artifact from "
+                  f"{dev.platform}", file=sys.stderr)
+            return 2
+
+    report = {"device_kind": dev.device_kind, "platform": dev.platform,
+              "smoke": bool(args.smoke), "cases": {}}
+    if args.smoke and out.exists():
+        try:
+            if not json.loads(out.read_text()).get("smoke", True):
+                print(f"refusing: --smoke would overwrite real evidence "
+                      f"at {out}", file=sys.stderr)
+                return 2
+        except (OSError, ValueError):
+            pass
+    big, small, b = (512, 256, 2) if args.smoke else (2048, 512, 8)
+    cases = [
+        (f"train_s{big}_flash", dict(attn_impl="flash"), big, b, True),
+        (f"train_s{big}_dense", dict(attn_impl="dense"), big, b, True),
+        (f"fwd_s{big}_flash", dict(attn_impl="flash"), big, b, False),
+        (f"fwd_s{big}_dense", dict(attn_impl="dense"), big, b, False),
+        (f"train_s{small}_dense", dict(attn_impl="dense"), small, b, True),
+        (f"fwd_s{small}_dense", dict(attn_impl="dense"), small, b, False),
+    ]
+    for name, kw, s, b_, train in cases:
+        try:
+            report["cases"][name] = _measure(kw, s, b_, args.reps, train,
+                                             smoke=args.smoke)
+        except Exception as e:  # keep partial evidence on a relay drop
+            report["cases"][name] = {"error": f"{type(e).__name__}: {e}"}
+        print(json.dumps({name: report["cases"][name]}), flush=True)
+        out.write_text(json.dumps(report, indent=2) + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

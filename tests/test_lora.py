@@ -1,0 +1,251 @@
+"""LoRA parameter-efficient finetuning (labformer.lora_rank > 0).
+
+Claims under test:
+  * zero-initialized B makes the adapted model start bit-identical;
+  * the finetune step updates ONLY adapter leaves (base frozen bitwise)
+    and its optimizer state covers the adapter subtree alone;
+  * finetuning actually learns (loss decreases on a cyclic stream);
+  * merge_lora folds the adapters so the merged base-structure model
+    reproduces the adapter-active forward, and serving surfaces refuse
+    unmerged adapter models instead of silently dropping the finetune;
+  * the sharded path (tp mesh) matches the single-device finetune.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpulab.models.labformer import (
+    LabformerConfig,
+    _split_lora,
+    forward,
+    init_params,
+    init_train_state,
+    merge_lora,
+)
+
+
+def _cfg(**kw):
+    base = dict(d_model=32, n_heads=4, n_layers=2, d_ff=64, max_seq=64,
+                lora_rank=4)
+    base.update(kw)
+    return LabformerConfig(**base)
+
+
+def _tokens(cfg, b=4, s=17, seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, cfg.vocab, (b, s)).astype(np.int32)
+
+
+def test_lora_init_is_identity():
+    """B == 0 at init: adapter-active forward == base forward bitwise."""
+    cfg = _cfg()
+    params = init_params(cfg, seed=0)
+    base_cfg = dataclasses.replace(cfg, lora_rank=0)
+    lora_tree, base_params = _split_lora(params)
+    toks = jnp.asarray(_tokens(cfg))
+    got = forward(params, toks, cfg)
+    want = forward(base_params, toks, base_cfg)
+    assert np.array_equal(np.asarray(got), np.asarray(want))
+    # and the adapter tree is exactly the four expected leaves
+    assert sorted(lora_tree["blocks"]) == [
+        "wq_lora_a", "wq_lora_b", "wv_lora_a", "wv_lora_b"]
+
+
+def test_finetune_updates_adapters_only():
+    cfg = _cfg()
+    params, opt_state, step = init_train_state(cfg, mesh=None, seed=0)
+    toks = _tokens(cfg, s=33)
+    before_lora, before_base = _split_lora(jax.device_get(params))
+    params2, opt_state, loss = step(params, opt_state, jnp.asarray(toks))
+    assert np.isfinite(float(loss))
+    after_lora, after_base = _split_lora(jax.device_get(params2))
+    for k, v in before_base["blocks"].items():
+        assert np.array_equal(np.asarray(v), np.asarray(after_base["blocks"][k])), (
+            f"base leaf {k} moved under the lora step")
+    assert np.array_equal(np.asarray(before_base["embed"]),
+                          np.asarray(after_base["embed"]))
+    # A starts gaussian and B zero; after one step with nonzero grads
+    # both must move (B gets grads through A@B's product rule)
+    moved = {k: not np.array_equal(np.asarray(before_lora["blocks"][k]),
+                                   np.asarray(after_lora["blocks"][k]))
+             for k in before_lora["blocks"]}
+    assert all(moved.values()), moved
+
+
+def test_opt_state_covers_adapters_only():
+    cfg = _cfg()
+    params, opt_state, _ = init_train_state(cfg, mesh=None, seed=0)
+    lora_tree, _ = _split_lora(params)
+    n_lora = sum(np.size(x) for x in jax.tree_util.tree_leaves(lora_tree))
+    n_all = sum(np.size(x) for x in jax.tree_util.tree_leaves(params))
+    n_opt = sum(np.size(x) for x in jax.tree_util.tree_leaves(opt_state))
+    # adamw keeps two moments (+ scalar counts); full-model state would
+    # be ~2x n_all — adapter-only is ~2x n_lora, orders smaller
+    assert n_opt < 3 * n_lora + 16
+    assert n_opt < n_all  # sanity: far below even ONE model copy
+
+
+def test_finetune_learns():
+    import optax
+
+    cfg = _cfg()
+    # adapters take a finetune-scale LR (the base head/embedding are
+    # frozen, so the default pretrain LR barely moves the loss in a
+    # 40-step horizon: measured 5.52 -> 5.43 at 3e-4 vs -> 5.05 at 1e-2)
+    params, opt_state, step = init_train_state(
+        cfg, mesh=None, seed=0, optimizer=optax.adamw(1e-2))
+    cyc = np.tile(np.arange(33, dtype=np.int32) % 7, (4, 1))
+    losses = []
+    for _ in range(40):
+        params, opt_state, loss = step(params, opt_state, jnp.asarray(cyc))
+        losses.append(float(loss))
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]) - 0.1, (
+        losses[:5], losses[-5:])
+
+
+def test_merge_matches_adapter_forward():
+    cfg = _cfg()
+    params, opt_state, step = init_train_state(cfg, mesh=None, seed=0)
+    toks = _tokens(cfg, s=33)
+    # a few steps so the adapters are nonzero and the fold is non-trivial
+    for _ in range(5):
+        params, opt_state, _ = step(params, opt_state, jnp.asarray(toks))
+    merged, merged_cfg = merge_lora(params, cfg)
+    assert merged_cfg.lora_rank == 0
+    assert not any("_lora_" in k for k in merged["blocks"])
+    toks_eval = jnp.asarray(_tokens(cfg, seed=3))
+    got = np.asarray(forward(merged, toks_eval, merged_cfg), np.float32)
+    want = np.asarray(forward(params, toks_eval, cfg), np.float32)
+    # fold is f32 then cast back to the param dtype: rounding-level skew
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def test_merge_noop_without_lora():
+    cfg = _cfg(lora_rank=0)
+    params = init_params(cfg, seed=0)
+    merged, merged_cfg = merge_lora(params, cfg)
+    assert merged is params and merged_cfg is cfg
+
+
+def test_serving_refuses_unmerged_adapters():
+    cfg = _cfg()
+    params = init_params(cfg, seed=0)
+    from tpulab.models.generate import generate_jit
+    from tpulab.models.paged import PagedEngine
+
+    with pytest.raises(ValueError, match="merge_lora"):
+        generate_jit(params, jnp.zeros((1, 4), jnp.int32),
+                     jax.random.PRNGKey(0), cfg, steps=2)
+    with pytest.raises(ValueError, match="merge_lora"):
+        PagedEngine(params, cfg, slots=1, n_blocks=8, block_size=8,
+                    max_seq=32)
+    # the blessed path works end to end
+    merged, mcfg = merge_lora(params, cfg)
+    out = generate_jit(merged, jnp.zeros((1, 4), jnp.int32),
+                       jax.random.PRNGKey(0), mcfg, steps=2)
+    assert out.shape == (1, 2)
+
+
+def test_lora_rejects_zero1():
+    cfg = _cfg()
+    from tpulab.models.labformer import make_train_step
+
+    with pytest.raises(ValueError, match="zero1"):
+        make_train_step(cfg, mesh=None, zero1=True)
+
+
+def test_lora_sharded_matches_single_device():
+    """tp-sharded finetune step == single-device finetune step."""
+    from tpulab.parallel.mesh import make_mesh
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs a multi-device mesh")
+    cfg = _cfg()
+    toks = _tokens(cfg, b=4, s=33)
+
+    params_s, opt_s, step_s = init_train_state(cfg, mesh=None, seed=0)
+    mesh = make_mesh({"tp": 2})
+    params_m, opt_m, step_m = init_train_state(cfg, mesh, seed=0)
+    for _ in range(3):
+        params_s, opt_s, loss_s = step_s(params_s, opt_s, jnp.asarray(toks))
+        params_m, opt_m, loss_m = step_m(params_m, opt_m, jnp.asarray(toks))
+    assert np.isclose(float(loss_s), float(loss_m), atol=1e-5)
+    ls, _ = _split_lora(jax.device_get(params_s))
+    lm, _ = _split_lora(jax.device_get(params_m))
+    for k in ls["blocks"]:
+        np.testing.assert_allclose(
+            np.asarray(ls["blocks"][k], np.float32),
+            np.asarray(lm["blocks"][k], np.float32),
+            atol=1e-5, rtol=1e-4)
+
+
+def test_train_cli_lora(tmp_path):
+    """The driver surface: tpulab.train --lora-rank runs and learns."""
+    from tpulab.train import train
+
+    logs = []
+    step, loss = train(steps=5, batch=2, seq=32, lora_rank=2,
+                       log=lambda *a: logs.append(a))
+    assert step == 5 and np.isfinite(loss)
+
+
+def test_warm_start_grafts_pretrained_base(tmp_path):
+    """--init-from: pretrained base weights land bitwise in the finetune
+    state; adapter leaves keep their fresh (delta == 0) init."""
+    from tpulab.models.generate import load_params
+    from tpulab.train import _warm_start, train
+
+    pre = str(tmp_path / "pre")
+    train(steps=4, batch=2, seq=32, ckpt_dir=pre, save_every=2,
+          log=lambda *a: None)
+
+    cfg = LabformerConfig(d_model=128, n_heads=8, n_layers=4, d_ff=512,
+                          max_seq=32, lora_rank=2)
+    params, _, _ = init_train_state(cfg, mesh=None, seed=1)
+    grafted = _warm_start(params, cfg, pre)
+
+    want, step = load_params(dataclasses.replace(cfg, lora_rank=0), pre)
+    assert step == 4
+    g_lora, g_base = _split_lora(grafted)
+    for k, v in want["blocks"].items():
+        assert np.array_equal(np.asarray(g_base["blocks"][k]), np.asarray(v)), k
+    assert np.array_equal(np.asarray(g_base["embed"]), np.asarray(want["embed"]))
+    p_lora, _ = _split_lora(params)
+    for k in p_lora["blocks"]:
+        assert np.array_equal(np.asarray(g_lora["blocks"][k]),
+                              np.asarray(p_lora["blocks"][k])), k
+
+
+def test_train_init_from_end_to_end(tmp_path):
+    from tpulab.train import train
+
+    pre = str(tmp_path / "pre")
+    train(steps=2, batch=2, seq=32, ckpt_dir=pre, save_every=2,
+          log=lambda *a: None)
+    step, loss = train(steps=3, batch=2, seq=32, lora_rank=2,
+                       init_from=pre, log=lambda *a: None)
+    assert step == 3 and np.isfinite(loss)
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        train(steps=1, init_from=pre, resume=True, ckpt_dir=pre)
+
+
+def test_generate_cli_merges_lora_checkpoint(tmp_path, capsys):
+    """train --lora-rank checkpoint -> generate --lora-rank: the CLI
+    restores the adapter leaves and folds them before serving (without
+    the flag a partial restore would silently drop the finetune)."""
+    from tpulab.models import generate as gen_cli
+    from tpulab.train import train
+
+    ck = str(tmp_path / "ck")
+    train(steps=4, batch=2, seq=32, lora_rank=2, ckpt_dir=ck,
+          save_every=2, log=lambda *a: None)
+    rc = gen_cli.main(["--ckpt-dir", ck, "--lora-rank", "2",
+                       "--steps", "4", "--temperature", "0",
+                       "--prompt", "ab"])
+    out = capsys.readouterr().out
+    assert rc in (0, None)
+    assert "merged LoRA adapters (rank 2)" in out

@@ -14,6 +14,7 @@ from tpulab.models.labformer import (
     init_train_state,
     loss_fn,
     make_train_step,
+    merge_lora,
     shard_params,
 )
 

@@ -75,6 +75,13 @@ def beam_search(
     search; returns ``(tokens (steps,), total_log_prob)``.
 
     ``beams=1`` reduces exactly to greedy decoding."""
+    if cfg.lora_rank:
+        # this decode path reads base weights only — serving an
+        # adapter-active model here would silently drop the finetune
+        raise ValueError(
+            "beam_search with lora_rank > 0: fold the adapters first "
+            "(labformer.merge_lora(params, cfg))"
+        )
     prompt = np.asarray(prompt, np.int32).reshape(1, -1)
     if steps < 1:
         raise ValueError(f"steps must be >= 1, got {steps}")

@@ -248,6 +248,13 @@ def generate_jit(
 
     Returns (b, steps) int32.  One jitted program end to end.
     """
+    if cfg.lora_rank:
+        # the cached decode path reads base weights only — serving an
+        # adapter-active model here would silently drop the finetune
+        raise ValueError(
+            "generate with lora_rank > 0: fold the adapters first "
+            "(labformer.merge_lora(params, cfg))"
+        )
     b, p = prompt.shape
     use_penalty = repetition_penalty != 1.0
 
@@ -384,6 +391,14 @@ def main(argv=None) -> int:
                          "is trimmed at its first occurrence (-1 = off)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--ckpt-dir", default=None)
+    ap.add_argument("--lora-rank", type=int, default=0,
+                    help="the checkpoint was finetuned with this LoRA "
+                         "rank: restore the adapter leaves too and fold "
+                         "them (merge_lora) before serving.  Without "
+                         "this, a partial restore against the base "
+                         "template would silently drop the finetune.")
+    ap.add_argument("--lora-alpha", type=float, default=16.0,
+                    help="LoRA scale numerator used at finetune time")
     ap.add_argument("--speculative", action="store_true",
                     help="greedy speculative decode with the int8-"
                          "quantized model as draft (lossless: same "
@@ -396,12 +411,22 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     cfg = demo_config()
+    if args.lora_rank:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, lora_rank=args.lora_rank,
+                                  lora_alpha=args.lora_alpha)
     try:
         params, step = load_params(cfg, args.ckpt_dir, seed=args.seed)
     except FileNotFoundError as e:
         raise SystemExit(str(e))
     if step is not None:
         print(f"[generate] loaded checkpoint step {step}")
+    if args.lora_rank:
+        from tpulab.models.labformer import merge_lora
+
+        params, cfg = merge_lora(params, cfg)
+        print(f"[generate] merged LoRA adapters (rank {args.lora_rank})")
 
     if args.stop_byte >= cfg.vocab:
         raise SystemExit(

@@ -90,6 +90,15 @@ class LabformerConfig:
     # layers).  Without it top-1 routing collapses onto one expert under
     # training and the all_to_all dispatch path becomes dead weight.
     moe_aux_weight: float = 0.01
+    # LoRA (Hu et al. 2021) parameter-efficient finetuning: rank > 0
+    # adds low-rank adapters q/v-side (wq += x@A@B * alpha/rank, B
+    # zero-initialized so the adapted model starts bit-identical).  The
+    # finetune step (make_train_step under lora_rank > 0) optimizes
+    # ONLY adapter leaves — base grads are never computed (XLA DCEs the
+    # weight-grad matmuls) and optimizer state is O(rank) per layer.
+    # Serve via merge_lora (folds B@A into the base weights).
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
 
     def __post_init__(self):
         # silent-fallback guard: a typoed impl name must not run another
@@ -109,6 +118,8 @@ class LabformerConfig:
             )
         if self.attn_window < 0:
             raise ValueError(f"attn_window must be >= 0, got {self.attn_window}")
+        if self.lora_rank < 0:
+            raise ValueError(f"lora_rank must be >= 0, got {self.lora_rank}")
 
     @property
     def head_dim(self) -> int:
@@ -156,6 +167,16 @@ def init_params(cfg: LabformerConfig, seed: int = 0) -> Dict[str, Any]:
     else:
         params["blocks"]["w1"] = dense(L, d, ff)
         params["blocks"]["w2"] = dense(L, ff, d)
+    if cfg.lora_rank:
+        r = cfg.lora_rank
+        kv = cfg.kv_heads * cfg.head_dim
+        # A gaussian, B zero (Hu et al. 2021 section 4.1): the adapter
+        # delta starts at exactly 0, so the finetune begins bit-identical
+        # to the base model
+        params["blocks"]["wq_lora_a"] = dense(L, d, r, scale=1.0 / r)
+        params["blocks"]["wq_lora_b"] = np.zeros((L, r, d), dt)
+        params["blocks"]["wv_lora_a"] = dense(L, d, r, scale=1.0 / r)
+        params["blocks"]["wv_lora_b"] = np.zeros((L, r, kv), dt)
     return params
 
 
@@ -171,6 +192,13 @@ _SPECS = {
     "wv": P("pp", None, "tp"),
     "wo": P("pp", "tp", None),
     "router": P("pp", None, None),
+    # LoRA adapters: A's rank dim is tiny — replicate; B's out dim
+    # shards like its base weight's out dim so x@A@B partitions exactly
+    # as x@W does under tp
+    "wq_lora_a": P("pp", None, None),
+    "wq_lora_b": P("pp", None, "tp"),
+    "wv_lora_a": P("pp", None, None),
+    "wv_lora_b": P("pp", None, "tp"),
 }
 _SPECS_DENSE = {"w1": P("pp", None, "tp"), "w2": P("pp", "tp", None)}
 _SPECS_MOE = {"w1": P("pp", ("dp", "sp"), None, "tp"), "w2": P("pp", ("dp", "sp"), "tp", None)}
@@ -184,6 +212,9 @@ def param_specs(cfg: LabformerConfig) -> Dict[str, Any]:
     block.update({k: mlp[k] for k in ("w1", "w2")})
     if cfg.n_experts:
         block["router"] = _SPECS["router"]
+    if cfg.lora_rank:
+        for k in ("wq_lora_a", "wq_lora_b", "wv_lora_a", "wv_lora_b"):
+            block[k] = _SPECS[k]
     return {
         "embed": _SPECS["embed"],
         "final_norm": _SPECS["final_norm"],
@@ -323,9 +354,18 @@ def repeat_kv(k, v, n_heads: int):
 def _attention(x, layer, cfg: LabformerConfig, mesh: Optional[Mesh], positions):
     b, s, d = x.shape
     h, dh, kvh = cfg.n_heads, cfg.head_dim, cfg.kv_heads
-    q = (x @ layer["wq"]).reshape(b, s, h, dh)
+    q_proj = x @ layer["wq"]
+    v_proj = x @ layer["wv"]
+    if cfg.lora_rank:
+        # x@A@B * alpha/r rides next to the frozen base projection; the
+        # rank-r intermediate keeps the adapter matmuls O(d*r) — tiny
+        # next to the d*d base — and B's tp sharding matches wq's
+        scale = jnp.asarray(cfg.lora_alpha / cfg.lora_rank, x.dtype)
+        q_proj = q_proj + (x @ layer["wq_lora_a"]) @ layer["wq_lora_b"] * scale
+        v_proj = v_proj + (x @ layer["wv_lora_a"]) @ layer["wv_lora_b"] * scale
+    q = q_proj.reshape(b, s, h, dh)
     k = (x @ layer["wk"]).reshape(b, s, kvh, dh)
-    v = (x @ layer["wv"]).reshape(b, s, kvh, dh)
+    v = v_proj.reshape(b, s, kvh, dh)
     q = _rope(q, positions, cfg.rope_theta)
     k = _rope(k, positions, cfg.rope_theta)
     # GQA training: K/V live (and get gradients) at kv_heads width; the
@@ -588,6 +628,13 @@ def make_train_step(
     zero1 = bool(zero1 or zero2)
     use_zero1 = bool(zero1 and mesh is not None)
     use_zero2 = bool(zero2 and mesh is not None)
+    if cfg.lora_rank:
+        if zero1 or zero2:
+            raise ValueError(
+                "lora_rank > 0 with zero1/zero2 is pointless: the "
+                "optimizer state is already O(rank) per layer"
+            )
+        return optimizer, _make_lora_step(cfg, mesh, optimizer, accum)
 
     def _constrain_grads(grads):
         return jax.tree_util.tree_map(
@@ -597,28 +644,10 @@ def make_train_step(
 
     @jax.jit
     def train_step(params, opt_state, tokens):
-        if accum <= 1:
-            loss, grads = jax.value_and_grad(loss_fn)(params, tokens, cfg, mesh)
-            if use_zero2:
-                grads = _constrain_grads(grads)
-        else:
-            micro = tokens.reshape(accum, tokens.shape[0] // accum, tokens.shape[1])
-
-            def one(carry, mb):
-                loss_acc, grads_acc = carry
-                loss, grads = jax.value_and_grad(loss_fn)(params, mb, cfg, mesh)
-                if use_zero2:
-                    grads = _constrain_grads(grads)
-                grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc, grads)
-                return (loss_acc + loss, grads_acc), None
-
-            zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
-            if use_zero2:
-                zeros = _constrain_grads(zeros)
-            (loss, grads), _ = jax.lax.scan(one, (jnp.float32(0.0), zeros), micro)
-            inv = jnp.float32(1.0 / accum)
-            loss = loss * inv
-            grads = jax.tree_util.tree_map(lambda g: g * inv.astype(g.dtype), grads)
+        loss, grads = _accum_value_and_grad(
+            lambda p, t: loss_fn(p, t, cfg, mesh), params, tokens, accum,
+            post_grads=_constrain_grads if use_zero2 else None,
+        )
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         if use_zero1:
@@ -628,6 +657,104 @@ def make_train_step(
         return params, opt_state, loss
 
     return optimizer, train_step
+
+
+def _accum_value_and_grad(loss_of, wrt, tokens, accum, post_grads=None):
+    """Shared (micro)batch machinery of the full and LoRA train steps.
+
+    ``loss_of(tree, tokens) -> loss``; differentiates w.r.t. ``tree``.
+    ``accum > 1`` scans microbatches and averages; ``post_grads`` (the
+    ZeRO-2 sharding constraint) applies per microbatch so the
+    accumulation buffer itself carries the constrained layout.
+    """
+    post = post_grads or (lambda g: g)
+    if accum <= 1:
+        loss, grads = jax.value_and_grad(loss_of)(wrt, tokens)
+        return loss, post(grads)
+    micro = tokens.reshape(accum, tokens.shape[0] // accum, tokens.shape[1])
+
+    def one(carry, mb):
+        loss_acc, grads_acc = carry
+        loss, grads = jax.value_and_grad(loss_of)(wrt, mb)
+        grads_acc = jax.tree_util.tree_map(jnp.add, grads_acc, post(grads))
+        return (loss_acc + loss, grads_acc), None
+
+    zeros = post(jax.tree_util.tree_map(jnp.zeros_like, wrt))
+    (loss, grads), _ = jax.lax.scan(one, (jnp.float32(0.0), zeros), micro)
+    inv = jnp.float32(1.0 / accum)
+    return loss * inv, jax.tree_util.tree_map(
+        lambda g: g * inv.astype(g.dtype), grads
+    )
+
+
+def _split_lora(params):
+    """(adapter_subtree, base_params) — split by the ``_lora_`` leaf names."""
+    blocks = params["blocks"]
+    lora = {"blocks": {k: v for k, v in blocks.items() if "_lora_" in k}}
+    base = dict(params)
+    base["blocks"] = {k: v for k, v in blocks.items() if "_lora_" not in k}
+    return lora, base
+
+
+def _join_lora(base, lora):
+    out = dict(base)
+    out["blocks"] = {**base["blocks"], **lora["blocks"]}
+    return out
+
+
+def _make_lora_step(cfg: LabformerConfig, mesh: Optional[Mesh], optimizer,
+                    accum: int = 1):
+    """Finetune step: gradients and optimizer over ADAPTER leaves only.
+
+    ``value_and_grad`` differentiates w.r.t. the lora subtree alone, so
+    XLA dead-code-eliminates every base weight-gradient matmul — the
+    step costs forward + activation backprop + O(rank) adapter grads,
+    and ``opt_state`` holds moments for the adapters only.
+    """
+    import optax
+
+    @jax.jit
+    def lora_step(params, opt_state, tokens):
+        lora, base = _split_lora(params)
+        loss, grads = _accum_value_and_grad(
+            lambda lt, t: loss_fn(_join_lora(base, lt), t, cfg, mesh),
+            lora, tokens, accum,
+        )
+        updates, opt_state = optimizer.update(grads, opt_state, lora)
+        lora = optax.apply_updates(lora, updates)
+        return _join_lora(base, lora), opt_state, loss
+
+    return lora_step
+
+
+def merge_lora(params, cfg: LabformerConfig):
+    """Fold the adapters into the base weights for serving.
+
+    Returns ``(merged_params, merged_cfg)``: plain base-structure params
+    (``wq += A@B * alpha/rank``, adapter leaves dropped) and the config
+    with ``lora_rank=0`` — the pair every decode/serving surface
+    accepts unchanged.  The fold happens in float32 and casts back to
+    the param dtype, so the merged forward matches the adapter-active
+    forward to rounding.
+    """
+    if not cfg.lora_rank:
+        return params, cfg
+    lora, base = _split_lora(params)
+    scale = cfg.lora_alpha / cfg.lora_rank
+    blocks = dict(base["blocks"])
+    for w, a, b in (("wq", "wq_lora_a", "wq_lora_b"),
+                    ("wv", "wv_lora_a", "wv_lora_b")):
+        delta = jnp.einsum(
+            "ldr,lro->ldo",
+            jnp.asarray(lora["blocks"][a], jnp.float32),
+            jnp.asarray(lora["blocks"][b], jnp.float32),
+        ) * scale
+        blocks[w] = (jnp.asarray(blocks[w], jnp.float32) + delta).astype(
+            blocks[w].dtype
+        )
+    merged = dict(base)
+    merged["blocks"] = blocks
+    return merged, dataclasses.replace(cfg, lora_rank=0)
 
 
 def init_train_state(
@@ -644,6 +771,9 @@ def init_train_state(
     optimizer, train_step = make_train_step(
         cfg, mesh, optimizer, accum=accum, zero1=zero1, zero2=zero2
     )
+    # LoRA finetuning: optimizer state covers the adapter subtree only
+    # (the step never updates base leaves)
+    opt_over = (lambda p: _split_lora(p)[0]) if cfg.lora_rank else (lambda p: p)
     if mesh is not None:
         params = shard_params(params, cfg, mesh)
         # optax's init eagerly creates its step counter; anchor it to the
@@ -651,11 +781,11 @@ def init_train_state(
         # CPU fleet under a TPU-default process) never dispatches — or
         # later cross-backend-transfers — on the default device
         with jax.default_device(mesh_anchor(mesh)):
-            opt_state = optimizer.init(params)
+            opt_state = optimizer.init(opt_over(params))
         if zero1:
             opt_state = shard_opt_state(opt_state, params, cfg, mesh)
     else:
-        opt_state = optimizer.init(params)
+        opt_state = optimizer.init(opt_over(params))
     return params, opt_state, train_step
 
 

@@ -296,6 +296,13 @@ class PagedEngine:
             raise ValueError("max_seq must be a multiple of block_size")
         if prefill_chunk < 0:
             raise ValueError("prefill_chunk must be >= 0 (0 = whole tail)")
+        if cfg.lora_rank:
+            # the paged decode reads base weights only — serving an
+            # adapter-active model would silently drop the finetune
+            raise ValueError(
+                "PagedEngine with lora_rank > 0: fold the adapters first "
+                "(labformer.merge_lora(params, cfg))"
+            )
         self.params = params
         self.cfg = cfg
         self.slots = slots

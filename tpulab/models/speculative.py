@@ -103,6 +103,13 @@ def speculative_generate(
     """
     if draft_cfg.vocab != target_cfg.vocab:
         raise ValueError("draft and target must share a vocabulary")
+    if draft_cfg.lora_rank or target_cfg.lora_rank:
+        # the prefill/verify paths read base weights only — serving an
+        # adapter-active model here would silently drop the finetune
+        raise ValueError(
+            "speculative_generate with lora_rank > 0: fold the adapters "
+            "first (labformer.merge_lora(params, cfg))"
+        )
     prompt = np.asarray(prompt, np.int32)
     b, p = prompt.shape
     cache_len = p + steps + k + 2

@@ -81,6 +81,41 @@ def build_optimizer(
     return optax.chain(*chain)
 
 
+def _warm_start(params, cfg, init_from: str):
+    """Graft a pretrained snapshot's BASE weights into freshly
+    initialized train state (params only — the optimizer starts clean).
+
+    This is the pretrain -> LoRA-finetune bridge: the snapshot was
+    written without adapter leaves and with a full-model opt_state, so
+    a strict ``--resume`` cannot load it into a ``lora_rank > 0`` run.
+    The restore template is the BASE parameter structure (lora_rank=0),
+    restored leaves then replace the live base leaves with each live
+    leaf's placement/sharding preserved; adapter leaves keep their
+    fresh (delta == 0) init.  Works for plain warm starts too.
+    """
+    import dataclasses as _dc
+
+    import jax
+
+    from tpulab.models.generate import load_params
+    from tpulab.models.labformer import _join_lora, _split_lora
+
+    base_cfg = (_dc.replace(cfg, lora_rank=0) if cfg.lora_rank else cfg)
+    restored, step = load_params(base_cfg, init_from)
+    if step is None:
+        raise FileNotFoundError(f"no checkpoint found in {init_from}")
+
+    lora, live_base = _split_lora(params) if cfg.lora_rank else (None, params)
+
+    def place(live, new):
+        if hasattr(live, "sharding"):
+            return jax.device_put(np.asarray(new), live.sharding)
+        return np.asarray(new, getattr(live, "dtype", None))
+
+    grafted = jax.tree_util.tree_map(place, live_base, restored)
+    return _join_lora(grafted, lora) if cfg.lora_rank else grafted
+
+
 def _restore_latest(manager, step: int, params, opt_state):
     """Restore a snapshot and re-place it onto the LIVE templates.
 
@@ -150,6 +185,9 @@ def train(
     data_dir: Optional[str] = None,
     recover: int = 0,
     inject_fault: tuple = (),
+    lora_rank: int = 0,
+    lora_alpha: float = 16.0,
+    init_from: Optional[str] = None,
 ):
     """Run the loop; returns (final_step, last_loss).
 
@@ -179,6 +217,15 @@ def train(
     zero1 = bool(zero1 or zero2)  # stage 2 builds on stage 1's layouts
     if zero1 and model != "labformer":
         raise ValueError("zero1/zero2 are implemented for the labformer trainer")
+    if lora_rank and model != "labformer":
+        raise ValueError("lora_rank applies to the labformer finetune path")
+    if init_from and model != "labformer":
+        raise ValueError("init_from warm-starts the labformer trainer")
+    if init_from and resume:
+        raise ValueError(
+            "init_from (params-only warm start, fresh optimizer) and "
+            "resume (full state restore) are mutually exclusive"
+        )
     if data_dir and model != "labformer":
         raise ValueError(
             "data_dir streams byte tokens — only the labformer consumes it"
@@ -258,6 +305,8 @@ def train(
             n_experts=experts,
             moe_impl=moe_impl,
             moe_aux_weight=moe_aux_weight,
+            lora_rank=lora_rank,
+            lora_alpha=lora_alpha,
         )
         mesh = None
         if mesh_devices:
@@ -288,6 +337,8 @@ def train(
             cfg, mesh, seed=seed, optimizer=optimizer, accum=accum,
             zero1=zero1, zero2=zero2,
         )
+        if init_from:
+            params = _warm_start(params, cfg, init_from)
         if data_dir:
             from tpulab.io.loader import TokenLoader
 
@@ -497,6 +548,16 @@ def main(argv=None) -> int:
     ap.add_argument("--data-dir", default=None,
                     help="stream byte tokens from files via the native "
                          "prefetching loader (default: synthetic stream)")
+    ap.add_argument("--lora-rank", type=int, default=0,
+                    help="LoRA finetuning: adapter rank (0 = full "
+                         "training).  Only adapter leaves get gradients "
+                         "and optimizer state; serve via merge_lora.")
+    ap.add_argument("--lora-alpha", type=float, default=16.0,
+                    help="LoRA scale numerator (delta = A@B * alpha/rank)")
+    ap.add_argument("--init-from", default=None, metavar="CKPT_DIR",
+                    help="warm-start params from a pretrained snapshot "
+                         "(params only, fresh optimizer) — the "
+                         "pretrain -> --lora-rank finetune bridge")
     args = ap.parse_args(argv)
     step, loss = train(
         model=args.model,
@@ -525,6 +586,9 @@ def main(argv=None) -> int:
         data_dir=args.data_dir,
         recover=args.recover,
         inject_fault=tuple(args.inject_fault),
+        lora_rank=args.lora_rank,
+        lora_alpha=args.lora_alpha,
+        init_from=args.init_from,
     )
     print(json.dumps({"final_step": step, "loss": loss}))
     return 0

@@ -30,6 +30,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     sub.add_parser("train", help="train the flagship model (checkpoint/resume)")
     sub.add_parser("generate", help="sample from the flagship model (KV-cache decode)")
     sub.add_parser("daemon", help="start the warm-runtime daemon")
+    sub.add_parser("tokenizer", help="train/inspect a BPE tokenizer")
 
     args, extra = parser.parse_known_args(argv)
 
@@ -65,6 +66,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         from tpulab.daemon import main as daemon_main
 
         return daemon_main(extra)
+
+    if args.command == "tokenizer":
+        from tpulab.io.bpe import main as bpe_main
+
+        return bpe_main(extra)
 
     parser.print_help()
     return 2

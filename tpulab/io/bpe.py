@@ -1,0 +1,215 @@
+"""Byte-pair encoding: a trainable subword tokenizer over raw bytes.
+
+The framework's default token space is the 256 raw bytes (labformer
+``vocab=256`` — SURVEY.md has no tokenizer to mirror; the reference
+suite is not a language stack).  BPE lifts that: ``train_bpe`` learns
+``vocab - 256`` greedy pair merges from a corpus, ``BPETokenizer``
+encodes bytes -> ids (applying merges in learned order, the standard
+GPT-2-style scheme) and decodes ids -> bytes losslessly for ANY input,
+trained-on or not — every base byte stays a token, so coverage is
+total and round-trips are exact.
+
+TPU relevance: a larger vocab moves FLOPs from sequence length into
+the embedding/unembed matmuls — shorter sequences for the same text,
+which is exactly where the MXU wants the work (bigger matmuls, smaller
+attention quadratic).
+
+CLI: ``python -m tpulab tokenizer train --data-dir D --vocab 512 --out
+tok.json`` then ``tpulab train --tokenizer tok.json --data-dir D`` /
+``tpulab generate --tokenizer tok.json``.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+from collections import Counter
+from typing import Iterable, List, Optional, Tuple
+
+import numpy as np
+
+FORMAT = "tpulab-bpe-v1"
+
+
+def train_bpe(corpus: bytes, vocab: int,
+              max_token_bytes: int = 32) -> "BPETokenizer":
+    """Learn ``vocab - 256`` merges by greedy pair frequency.
+
+    Ties break on the lower pair ids (deterministic across runs and
+    platforms).  Training operates on the id sequence directly — no
+    word pre-segmentation — so the tokenizer is byte-faithful over
+    arbitrary binary data, matching the loader's byte-stream model.
+
+    ``max_token_bytes`` caps a merged token's byte expansion: without
+    it, a corpus with long exact repeats (source files, templated logs)
+    lets merges chain exponentially — line, line², line⁴ — until the
+    whole corpus is a handful of memorized mega-tokens that never match
+    fresh text.  Word-scale tokens generalize; corpus-scale ones don't.
+    """
+    if vocab < 256:
+        raise ValueError(f"vocab must be >= 256 (the byte base), got {vocab}")
+    if vocab > 65536:
+        raise ValueError(f"vocab {vocab} > 65536: ids no longer fit int32 "
+                         f"embedding tables comfortably; unsupported")
+    ids: List[int] = list(corpus)
+    merges: List[Tuple[int, int]] = []
+    nbytes: List[int] = [1] * 256
+    for new_id in range(256, vocab):
+        if len(ids) < 2:
+            break
+        counts = Counter(zip(ids, ids[1:]))
+        eligible = [
+            (kv[1], kv[0]) for kv in counts.items()
+            if nbytes[kv[0][0]] + nbytes[kv[0][1]] <= max_token_bytes
+        ]
+        if not eligible:
+            break
+        n, (a, b) = max(((n, pair) for n, pair in eligible),
+                        key=lambda t: (t[0], (-t[1][0], -t[1][1])))
+        if n < 2:
+            break  # nothing repeats: further merges memorize the corpus
+        merges.append((a, b))
+        nbytes.append(nbytes[a] + nbytes[b])
+        ids = _apply_merge(ids, a, b, new_id)
+    return BPETokenizer(merges)
+
+
+def _apply_merge(ids: List[int], a: int, b: int, new_id: int) -> List[int]:
+    out: List[int] = []
+    i, n = 0, len(ids)
+    while i < n:
+        if i + 1 < n and ids[i] == a and ids[i + 1] == b:
+            out.append(new_id)
+            i += 2
+        else:
+            out.append(ids[i])
+            i += 1
+    return out
+
+
+class BPETokenizer:
+    """Merges-ordered byte-pair tokenizer; ids 0..255 are raw bytes."""
+
+    def __init__(self, merges: List[Tuple[int, int]]):
+        self.merges = [tuple(m) for m in merges]
+        # merged id -> byte expansion (built bottom-up: merge i may only
+        # reference ids < 256 + i)
+        self._bytes: List[bytes] = [bytes([i]) for i in range(256)]
+        for a, b in self.merges:
+            self._bytes.append(self._bytes[a] + self._bytes[b])
+
+    @property
+    def vocab(self) -> int:
+        return 256 + len(self.merges)
+
+    def encode(self, data: bytes) -> np.ndarray:
+        """bytes -> int32 ids, applying merges in learned order.
+
+        One pass per merge, in rank order — exactly the sequence of
+        ``_apply_merge`` calls training performed, so encode reproduces
+        the training segmentation.  (Equivalent to the lowest-rank-
+        applicable-pair-first scheme: merging (a,b)->c only creates
+        pairs containing c, and every merge involving c was learned
+        later, so applicable ranks increase monotonically.)
+        """
+        ids = list(data)
+        for rank, (a, b) in enumerate(self.merges):
+            if len(ids) < 2:
+                break
+            ids = _apply_merge(ids, a, b, 256 + rank)
+        return np.asarray(ids, np.int32)
+
+    def decode(self, ids: Iterable[int]) -> bytes:
+        n = self.vocab
+        out = []
+        for i in ids:
+            i = int(i)
+            if not 0 <= i < n:
+                raise ValueError(f"id {i} outside vocab {n}")
+            out.append(self._bytes[i])
+        return b"".join(out)
+
+    # ---------------------------------------------------------- persistence
+
+    def save(self, path: str) -> None:
+        payload = {"format": FORMAT, "vocab": self.vocab,
+                   "merges": [list(m) for m in self.merges]}
+        pathlib.Path(path).write_text(json.dumps(payload))
+
+    @classmethod
+    def load(cls, path: str) -> "BPETokenizer":
+        payload = json.loads(pathlib.Path(path).read_text())
+        if payload.get("format") != FORMAT:
+            raise ValueError(
+                f"{path}: not a {FORMAT} tokenizer file "
+                f"(format={payload.get('format')!r})"
+            )
+        tok = cls([tuple(m) for m in payload["merges"]])
+        if tok.vocab != payload["vocab"]:
+            raise ValueError(
+                f"{path}: merge count disagrees with declared vocab "
+                f"({tok.vocab} != {payload['vocab']})"
+            )
+        return tok
+
+
+def corpus_from_dir(data_dir: str, limit_bytes: int = 1 << 24) -> bytes:
+    """Concatenate the dir's files (sorted, the loader's order) up to
+    ``limit_bytes`` — the training corpus mirror of TokenLoader's
+    stream."""
+    root = pathlib.Path(data_dir)
+    files = sorted(p for p in root.rglob("*") if p.is_file())
+    if not files:
+        raise FileNotFoundError(f"no files under {data_dir}")
+    chunks, total = [], 0
+    for p in files:
+        data = p.read_bytes()[: limit_bytes - total]
+        chunks.append(data)
+        total += len(data)
+        if total >= limit_bytes:
+            break
+    return b"".join(chunks)
+
+
+def main(argv: Optional[list] = None) -> int:
+    """``tpulab tokenizer``: train / inspect / roundtrip a BPE table."""
+    import argparse
+
+    ap = argparse.ArgumentParser(description=main.__doc__)
+    sub = ap.add_subparsers(dest="command", required=True)
+    tr = sub.add_parser("train", help="learn merges from a corpus dir")
+    tr.add_argument("--data-dir", required=True)
+    tr.add_argument("--vocab", type=int, default=512)
+    tr.add_argument("--out", required=True)
+    tr.add_argument("--limit-bytes", type=int, default=1 << 24)
+    ins = sub.add_parser("info", help="print vocab/merge stats")
+    ins.add_argument("tokenizer")
+    enc = sub.add_parser("encode", help="encode stdin text to ids")
+    enc.add_argument("tokenizer")
+    args = ap.parse_args(argv)
+
+    if args.command == "train":
+        corpus = corpus_from_dir(args.data_dir, args.limit_bytes)
+        tok = train_bpe(corpus, args.vocab)
+        tok.save(args.out)
+        sample = corpus[:65536]
+        print(json.dumps({
+            "vocab": tok.vocab, "merges": len(tok.merges),
+            "corpus_bytes": len(corpus),
+            "compression_sample_64k": round(
+                len(sample) / max(len(tok.encode(sample)), 1), 3),
+            "out": args.out,
+        }))
+        return 0
+    if args.command == "info":
+        tok = BPETokenizer.load(args.tokenizer)
+        print(json.dumps({"vocab": tok.vocab, "merges": len(tok.merges)}))
+        return 0
+    if args.command == "encode":
+        import sys
+
+        tok = BPETokenizer.load(args.tokenizer)
+        ids = tok.encode(sys.stdin.buffer.read())
+        print(" ".join(map(str, ids.tolist())))
+        return 0
+    return 2

@@ -399,6 +399,10 @@ def main(argv=None) -> int:
                          "template would silently drop the finetune.")
     ap.add_argument("--lora-alpha", type=float, default=16.0,
                     help="LoRA scale numerator used at finetune time")
+    ap.add_argument("--tokenizer", default=None, metavar="TOK_JSON",
+                    help="BPE tokenizer the checkpoint was trained with "
+                         "(tpulab train --tokenizer): sets the model "
+                         "vocab, encodes the prompt, decodes the output")
     ap.add_argument("--speculative", action="store_true",
                     help="greedy speculative decode with the int8-"
                          "quantized model as draft (lossless: same "
@@ -410,10 +414,16 @@ def main(argv=None) -> int:
                          "exclusive with sampling and --speculative)")
     args = ap.parse_args(argv)
 
-    cfg = demo_config()
-    if args.lora_rank:
-        import dataclasses
+    import dataclasses
 
+    cfg = demo_config()
+    tok = None
+    if args.tokenizer:
+        from tpulab.io.bpe import BPETokenizer
+
+        tok = BPETokenizer.load(args.tokenizer)
+        cfg = dataclasses.replace(cfg, vocab=tok.vocab)
+    if args.lora_rank:
         cfg = dataclasses.replace(cfg, lora_rank=args.lora_rank,
                                   lora_alpha=args.lora_alpha)
     try:
@@ -428,12 +438,18 @@ def main(argv=None) -> int:
         params, cfg = merge_lora(params, cfg)
         print(f"[generate] merged LoRA adapters (rank {args.lora_rank})")
 
-    if args.stop_byte >= cfg.vocab:
+    # a stop BYTE is a byte regardless of the token space: under BPE it
+    # is detected in the DECODED byte stream (the byte may be merged
+    # inside larger tokens, so a raw-id comparison would miss it)
+    stop_limit = 256 if tok is not None else cfg.vocab
+    if args.stop_byte >= stop_limit:
         raise SystemExit(
-            f"--stop-byte must be a byte in [0, {cfg.vocab - 1}] (or -1 "
+            f"--stop-byte must be a byte in [0, {stop_limit - 1}] (or -1 "
             f"= off); got {args.stop_byte}"
         )
-    prompt = np.frombuffer(args.prompt.encode("utf-8"), np.uint8)[None, :].astype(np.int32)
+    raw = args.prompt.encode("utf-8")
+    prompt = (tok.encode(raw)[None, :] if tok is not None
+              else np.frombuffer(raw, np.uint8)[None, :]).astype(np.int32)
     if args.beams:
         if args.speculative or args.temperature not in (0.0, 1.0) \
                 or args.top_k or args.top_p != 1.0 \
@@ -480,10 +496,21 @@ def main(argv=None) -> int:
                        temperature=args.temperature, seed=args.seed,
                        top_k=args.top_k, top_p=args.top_p,
                        repetition_penalty=args.repetition_penalty,
+                       # in-loop freeze only matches raw ids; under BPE
+                       # the stop byte is found post-hoc in the decoded
+                       # bytes (freezing on the raw id is still a valid
+                       # shortcut when the byte survives as a token)
                        stop_token=args.stop_byte)
     toks = [int(t) for t in out[0]]
-    if args.stop_byte >= 0 and args.stop_byte in toks:
-        toks = toks[: toks.index(args.stop_byte)]
-    text = bytes(t & 0xFF for t in toks).decode("utf-8", errors="replace")
-    print(args.prompt + text)
+    if tok is None:
+        if args.stop_byte >= 0 and args.stop_byte in toks:
+            toks = toks[: toks.index(args.stop_byte)]
+        data = bytes(t & 0xFF for t in toks)
+    else:
+        data = tok.decode(toks)
+        if args.stop_byte >= 0:
+            cut = data.find(bytes([args.stop_byte]))
+            if cut >= 0:
+                data = data[:cut]
+    print(args.prompt + data.decode("utf-8", errors="replace"))
     return 0

@@ -188,6 +188,7 @@ def train(
     lora_rank: int = 0,
     lora_alpha: float = 16.0,
     init_from: Optional[str] = None,
+    tokenizer: Optional[str] = None,
 ):
     """Run the loop; returns (final_step, last_loss).
 
@@ -221,6 +222,8 @@ def train(
         raise ValueError("lora_rank applies to the labformer finetune path")
     if init_from and model != "labformer":
         raise ValueError("init_from warm-starts the labformer trainer")
+    if tokenizer and model != "labformer":
+        raise ValueError("tokenizer feeds the labformer byte/BPE LM")
     if init_from and resume:
         raise ValueError(
             "init_from (params-only warm start, fresh optimizer) and "
@@ -295,7 +298,29 @@ def train(
     elif model == "labformer":
         from tpulab.models.labformer import LabformerConfig, init_train_state
 
+        tok = None
+        if tokenizer:
+            # BPE lifts the token space off raw bytes: the model's vocab
+            # comes from the merge table, and batches sample the
+            # pre-encoded corpus (the native byte loader streams the
+            # wrong token space once merges apply)
+            if not data_dir:
+                raise ValueError(
+                    "--tokenizer encodes a corpus: give --data-dir too"
+                )
+            from tpulab.io.bpe import BPETokenizer
+
+            tok = BPETokenizer.load(tokenizer)
+            if cfg is not None and cfg.vocab < tok.vocab:
+                # JAX gather CLAMPS out-of-range embedding ids instead of
+                # raising — a silent-corruption trap, so refuse here
+                raise ValueError(
+                    f"cfg.vocab={cfg.vocab} < tokenizer vocab {tok.vocab}: "
+                    f"encoded ids would silently clamp in the embedding"
+                )
+
         cfg = cfg or LabformerConfig(
+            vocab=tok.vocab if tok else 256,
             d_model=128,
             n_heads=8,
             n_layers=4,
@@ -339,7 +364,29 @@ def train(
         )
         if init_from:
             params = _warm_start(params, cfg, init_from)
-        if data_dir:
+        if tok is not None:
+            from tpulab.io.bpe import corpus_from_dir
+
+            ids = tok.encode(corpus_from_dir(data_dir))
+            need = (seq + 1) * max(4, batch)
+            if len(ids) < need:
+                raise ValueError(
+                    f"corpus encodes to {len(ids)} tokens; need >= {need} "
+                    f"for seq={seq} batch={batch}"
+                )
+            # held-out tail for eval: ~10%, at least eval_batches windows
+            hold = max((seq + 1) * max(eval_batches, 1), len(ids) // 10)
+            hold = min(hold, len(ids) - (seq + 1))
+            train_ids, val_ids = ids[:-hold], ids[-hold:]
+
+            def _windows(src: np.ndarray, rng, rows: int) -> np.ndarray:
+                starts = rng.integers(0, len(src) - seq, rows)
+                return np.stack([src[s:s + seq + 1] for s in starts])
+
+            def batch_at(step: int) -> np.ndarray:
+                rng = np.random.default_rng((seed << 20) ^ step)
+                return _windows(train_ids, rng, batch)
+        elif data_dir:
             from tpulab.io.loader import TokenLoader
 
             # lazy open: start_step is only known after checkpoint
@@ -360,7 +407,21 @@ def train(
         from tpulab.models.labformer import loss_fn as _lm_loss
 
         _eval_fn = jax.jit(_lm_loss, static_argnums=(2, 3))
-        if data_dir:
+        if tok is not None:
+            # validation windows come from the held-out corpus TAIL (the
+            # training sampler never sees it), keyed by the train step
+            # so resumed runs replay identical validation windows
+            def eval_loss(params, step: int = 0):
+                n_eval = step // eval_every if eval_every else 0
+                tot = 0.0
+                for j in range(eval_batches):
+                    rng = np.random.default_rng(
+                        ((seed + 104729) << 20) ^ (n_eval * eval_batches + j)
+                    )
+                    tot += float(_eval_fn(params, _windows(val_ids, rng, batch),
+                                          cfg, mesh))
+                return tot / eval_batches
+        elif data_dir:
             # validation from the SAME corpus, different sampling seed:
             # fresh random windows the training stream almost surely
             # never visited — without this, eval would score synthetic
@@ -558,6 +619,10 @@ def main(argv=None) -> int:
                     help="warm-start params from a pretrained snapshot "
                          "(params only, fresh optimizer) — the "
                          "pretrain -> --lora-rank finetune bridge")
+    ap.add_argument("--tokenizer", default=None, metavar="TOK_JSON",
+                    help="BPE tokenizer (tpulab tokenizer train ...): "
+                         "model vocab = merge table, batches sample the "
+                         "encoded --data-dir corpus")
     args = ap.parse_args(argv)
     step, loss = train(
         model=args.model,
@@ -589,6 +654,7 @@ def main(argv=None) -> int:
         lora_rank=args.lora_rank,
         lora_alpha=args.lora_alpha,
         init_from=args.init_from,
+        tokenizer=args.tokenizer,
     )
     print(json.dumps({"final_step": step, "loss": loss}))
     return 0

@@ -195,7 +195,9 @@ def test_generate_with_tokenizer(tmp_path, capsys):
 
     data = tmp_path / "data"
     data.mkdir()
-    (data / "c.txt").write_bytes(b"hello world " * 500)
+    # big enough that the encoded corpus covers train windows + the
+    # held-out eval tail even at ~32-byte merged tokens
+    (data / "c.txt").write_bytes(b"hello world " * 3000)
     tok = train_bpe((data / "c.txt").read_bytes(), vocab=280)
     tokp = str(tmp_path / "tok.json")
     tok.save(tokp)

@@ -11,7 +11,9 @@ Checks (all compiled, interpret=False, on the real chip):
   - elementwise subtract (lab1 kernel) vs fused-XLA subtract: bit-exact
   - Roberts halo-DMA stencil (lab2) vs XLA roberts_edges: bit-exact
   - Mahalanobis classify (lab3) vs XLA classify_labels: bit-exact labels
-  - flash attention vs naive XLA softmax attention: f32 tolerance
+  - flash attention (fwd + custom_vjp bwd) vs naive XLA attention
+  - paged-attention decode kernel (scalar-prefetch block tables, GQA,
+    ragged lengths, sliding window) vs the XLA gather path
 
 Usage: python tools/pallas_tpu_parity.py [--out results/pallas_tpu_parity.json]
 """
@@ -161,6 +163,38 @@ def run_checks() -> list:
         "tol": 5e-3,  # f32 grads, large-magnitude sum-of-squares loss
         "within_tol": bool(gerr < 5e-3),
     })
+
+    # paged-attention decode kernel (scalar-prefetch block tables) vs
+    # the XLA gather path — GQA grouping + ragged lengths + window
+    from tpulab.models.paged import _paged_attend
+    from tpulab.ops.pallas.paged import paged_attend_pallas
+
+    S, M, BS, P, h, kvh, d = 4, 6, 16, 48, 8, 2, 64
+    q = jnp.asarray(rng.standard_normal((S, 1, h, d)).astype(np.float32) * 0.5,
+                    jnp.bfloat16)
+    kp = jnp.asarray(rng.standard_normal((P, BS, kvh, d)).astype(np.float32) * 0.5,
+                     jnp.bfloat16)
+    vp = jnp.asarray(rng.standard_normal((P, BS, kvh, d)).astype(np.float32),
+                     jnp.bfloat16)
+    tables = jnp.asarray(
+        rng.choice(P, (S, M), replace=False).reshape(S, M), jnp.int32)
+    lengths = jnp.asarray([1, 30, 64, 96], jnp.int32)
+    for window, name in ((0, "pallas_paged_attention"),
+                         (11, "pallas_paged_attention_window")):
+        got = np.asarray(paged_attend_pallas(
+            q, kp, vp, tables, lengths, BS, window, interpret=False
+        ).astype(jnp.float32))
+        want = np.asarray(_paged_attend(
+            q, kp, vp, tables, lengths, BS, window).astype(jnp.float32))
+        perr = float(np.max(np.abs(got - want)))
+        checks.append({
+            "kernel": name,
+            "shape": [S, M, BS, h, kvh, d],
+            "dtype": "bfloat16",
+            "max_abs_err": perr,
+            "tol": 2e-2,  # bf16 inputs, f32 softmax/acc both sides
+            "within_tol": bool(perr < 2e-2),
+        })
     return checks
 
 

@@ -147,6 +147,20 @@ def main(argv=None) -> int:
         "speedup_vs_cold": round(t_cold / t_warm, 3),
     })
 
+    # --- paged-attention kernel vs the XLA gather path, same 8-way
+    # batch at a long context (where the gather's materialized KV copy
+    # costs the most HBM traffic)
+    long_ctx = [(rng.integers(0, cfg.vocab, (256,)).astype(np.int32),
+                 args.steps) for _ in range(8)]
+    for attn in ("gather", "pallas"):
+        t, toks, _ = _run_jobs(params, cfg, dict(eng_kw, attn=attn),
+                               long_ctx, reps=args.reps)
+        scenarios.append({
+            "scenario": f"decode_batch8_ctx256_{attn}",
+            "tokens": toks, "wall_s": round(t, 4),
+            "tokens_per_s": round(toks / t, 1),
+        })
+
     # --- prefill throughput: long prompts, 1 new token each
     long_jobs = [(rng.integers(0, cfg.vocab, (384,)).astype(np.int32), 1)
                  for _ in range(8)]

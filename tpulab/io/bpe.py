@@ -23,7 +23,6 @@ from __future__ import annotations
 
 import json
 import pathlib
-from collections import Counter
 from typing import Iterable, List, Optional, Tuple
 
 import numpy as np
@@ -51,40 +50,51 @@ def train_bpe(corpus: bytes, vocab: int,
     if vocab > 65536:
         raise ValueError(f"vocab {vocab} > 65536: ids no longer fit int32 "
                          f"embedding tables comfortably; unsupported")
-    ids: List[int] = list(corpus)
+    ids = np.frombuffer(corpus, np.uint8).astype(np.int32)
     merges: List[Tuple[int, int]] = []
     nbytes: List[int] = [1] * 256
     for new_id in range(256, vocab):
         if len(ids) < 2:
             break
-        counts = Counter(zip(ids, ids[1:]))
-        eligible = [
-            (kv[1], kv[0]) for kv in counts.items()
-            if nbytes[kv[0][0]] + nbytes[kv[0][1]] <= max_token_bytes
-        ]
-        if not eligible:
+        # pair histogram in C: pack (left, right) into one int64 key
+        pairs = ids[:-1].astype(np.int64) * 65536 + ids[1:]
+        uniq, counts = np.unique(pairs, return_counts=True)
+        left = (uniq >> 16).astype(np.int64)
+        right = (uniq & 0xFFFF).astype(np.int64)
+        lens = np.asarray(nbytes, np.int64)
+        ok = lens[left] + lens[right] <= max_token_bytes
+        if not ok.any():
             break
-        n, (a, b) = max(((n, pair) for n, pair in eligible),
-                        key=lambda t: (t[0], (-t[1][0], -t[1][1])))
-        if n < 2:
+        uniq, counts, left, right = uniq[ok], counts[ok], left[ok], right[ok]
+        best = np.lexsort((uniq, -counts))[0]  # max count, lowest pair tie
+        if counts[best] < 2:
             break  # nothing repeats: further merges memorize the corpus
+        a, b = int(left[best]), int(right[best])
         merges.append((a, b))
         nbytes.append(nbytes[a] + nbytes[b])
         ids = _apply_merge(ids, a, b, new_id)
     return BPETokenizer(merges)
 
 
-def _apply_merge(ids: List[int], a: int, b: int, new_id: int) -> List[int]:
-    out: List[int] = []
-    i, n = 0, len(ids)
-    while i < n:
-        if i + 1 < n and ids[i] == a and ids[i + 1] == b:
-            out.append(new_id)
-            i += 2
-        else:
-            out.append(ids[i])
-            i += 1
-    return out
+def _apply_merge(ids: np.ndarray, a: int, b: int, new_id: int) -> np.ndarray:
+    """Replace every non-overlapping (a, b) with ``new_id``, leftmost
+    first — vectorized except the (rare, short) overlap-resolution loop
+    over match positions."""
+    mask = (ids[:-1] == a) & (ids[1:] == b)
+    idx = np.nonzero(mask)[0]
+    if idx.size == 0:
+        return ids
+    if a == b:
+        # aaa -> (aa)a: drop matches that overlap a kept earlier match
+        keep, last = [], -2
+        for i in idx.tolist():
+            if i > last + 1:
+                keep.append(i)
+                last = i
+        idx = np.asarray(keep, idx.dtype)
+    out = ids.copy()
+    out[idx] = new_id
+    return np.delete(out, idx + 1)
 
 
 class BPETokenizer:
@@ -112,12 +122,12 @@ class BPETokenizer:
         pairs containing c, and every merge involving c was learned
         later, so applicable ranks increase monotonically.)
         """
-        ids = list(data)
+        ids = np.frombuffer(bytes(data), np.uint8).astype(np.int32)
         for rank, (a, b) in enumerate(self.merges):
             if len(ids) < 2:
                 break
             ids = _apply_merge(ids, a, b, 256 + rank)
-        return np.asarray(ids, np.int32)
+        return ids
 
     def decode(self, ids: Iterable[int]) -> bytes:
         n = self.vocab
@@ -163,7 +173,10 @@ def corpus_from_dir(data_dir: str, limit_bytes: int = 1 << 24) -> bytes:
         raise FileNotFoundError(f"no files under {data_dir}")
     chunks, total = [], 0
     for p in files:
-        data = p.read_bytes()[: limit_bytes - total]
+        # bounded read: a single huge file must not be slurped whole
+        # just to keep its first few MB
+        with open(p, "rb") as f:
+            data = f.read(limit_bytes - total)
         chunks.append(data)
         total += len(data)
         if total >= limit_bytes:

@@ -110,15 +110,20 @@ def _paged_attend(q, kpool_l, vpool_l, tables, lengths, block_size: int,
     return o.reshape(S, 1, h, dh).astype(q.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "block_size"))
+@functools.partial(jax.jit, static_argnames=("cfg", "block_size", "attn"))
 def paged_decode_step(params, tokens, kpool, vpool, tables, lengths,
-                      cfg: LabformerConfig, block_size: int):
+                      cfg: LabformerConfig, block_size: int,
+                      attn: str = "gather"):
     """One batched decode step for every slot.
 
     tokens (S,) sit at logical positions ``lengths`` (the next free
     position per slot); each layer writes the new K/V through the block
     table and attends [0, lengths] inclusive.  Inactive slots must
-    point their table at TRASH.  Returns (logits (S, vocab), pools)."""
+    point their table at TRASH.  Returns (logits (S, vocab), pools).
+
+    ``attn``: "gather" (XLA gather + dense attend) or "pallas" (the
+    scalar-prefetch paged kernel, ops/pallas/paged — no materialized KV
+    copy)."""
     S = tokens.shape[0]
     h, dh, kvh = cfg.n_heads, cfg.head_dim, cfg.kv_heads
     x = embed_lookup(params["embed"], tokens, cfg.dtype)[:, None, :]
@@ -140,8 +145,15 @@ def paged_decode_step(params, tokens, kpool, vpool, tables, lengths,
         k = _rope_at(k, pos, cfg.rope_theta)
         kpool_l = kpool_l.at[blk, off].set(k[:, 0])
         vpool_l = vpool_l.at[blk, off].set(v[:, 0])
-        o = _paged_attend(q, kpool_l, vpool_l, tables, lengths + 1,
-                          block_size, window=cfg.attn_window)
+        if attn == "pallas":
+            from tpulab.ops.pallas.paged import paged_attend_pallas
+
+            o = paged_attend_pallas(q, kpool_l, vpool_l, tables,
+                                    lengths + 1, block_size,
+                                    window=cfg.attn_window)
+        else:
+            o = _paged_attend(q, kpool_l, vpool_l, tables, lengths + 1,
+                              block_size, window=cfg.attn_window)
         x = x + qmat(o.reshape(S, 1, cfg.d_model), layer["wo"])
         y, _ = _mlp(_rmsnorm(x, layer["ln2"]), layer, cfg)
         return x + y, (kpool_l, vpool_l)
@@ -291,7 +303,8 @@ class PagedEngine:
 
     def __init__(self, params, cfg: LabformerConfig, *, slots: int = 4,
                  n_blocks: int = 64, block_size: int = 16,
-                 max_seq: int = 256, prefill_chunk: int = 0, mesh=None):
+                 max_seq: int = 256, prefill_chunk: int = 0, mesh=None,
+                 attn: str = "gather"):
         if max_seq % block_size:
             raise ValueError("max_seq must be a multiple of block_size")
         if prefill_chunk < 0:
@@ -303,9 +316,16 @@ class PagedEngine:
                 "PagedEngine with lora_rank > 0: fold the adapters first "
                 "(labformer.merge_lora(params, cfg))"
             )
+        if attn not in ("gather", "pallas"):
+            raise ValueError(f"attn={attn!r}; expected 'gather' or 'pallas'")
+        if attn == "pallas" and mesh is not None:
+            # the kernel is single-device; under tp the gather path's
+            # GSPMD partitioning is the supported route
+            raise ValueError("attn='pallas' does not support mesh serving")
         self.params = params
         self.cfg = cfg
         self.slots = slots
+        self.attn = attn
         self.block_size = block_size
         self.max_blocks = max_seq // block_size
         if mesh is None:
@@ -575,7 +595,7 @@ class PagedEngine:
         logits, self.kpool, self.vpool = paged_decode_step(
             self.params, jnp.asarray(self.last_tok), self.kpool, self.vpool,
             jnp.asarray(self.tables), jnp.asarray(self.lengths),
-            self.cfg, self.block_size,
+            self.cfg, self.block_size, attn=self.attn,
         )
         toks, new_keys = _sample_tokens(
             logits, jnp.asarray(self.temps),

@@ -1,0 +1,151 @@
+"""Pallas paged-attention decode kernel: block tables in SMEM, zero
+gather materialization.
+
+The XLA serving path (models/paged._paged_attend) gathers every slot's
+logical KV out of the block pools (``kpool[tables]``) and then runs a
+dense masked attend — correct, but the gather WRITES a full copy of the
+KV working set to HBM and the attend immediately re-reads it.  Decode
+attention is HBM-bandwidth-bound, so that copy roughly doubles the
+traffic per step.
+
+This kernel reads the pools in place: the block table rides as a
+scalar-prefetch operand (``pltpu.PrefetchScalarGridSpec``), so the
+BlockSpec index_map maps grid step ``j`` straight to pool block
+``tables[s, j]`` — the DMA engine fetches exactly the blocks the slot
+owns, VMEM-sized, with no intermediate copy.  Softmax runs blockwise
+with the usual flash running (max, denom, acc) carried in VMEM scratch
+across the table dimension.
+
+Head grouping (GQA) follows models/paged: query heads reshape to
+(kv_head, group); the group axis is zero-padded to >= 8 sublanes so
+both kernel dots keep legal Mosaic tiles (padded rows attend to real
+keys but their outputs are cropped before returning).  Numerics match
+the gather path: scores and the weighted sum accumulate in f32.
+
+No reference counterpart (the reference suite has no serving tier);
+the design is vLLM's PagedAttention recast onto the TPU memory system.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = float(np.finfo(np.float32).min)
+
+
+def _kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref, o_ref,
+            m_ref, l_ref, acc_ref, *, block_size: int, window: int,
+            out_dtype):
+    s_i = pl.program_id(0)
+    j = pl.program_id(2)
+    n_blocks = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    length = lengths_ref[s_i]
+
+    @pl.when(j * block_size < length)
+    def _attend():
+        qb = q_ref[0, 0]                      # (G, d)
+        kb = k_ref[0, :, 0, :]                # (BS, d)
+        vb = v_ref[0, :, 0, :]
+        scores = jax.lax.dot_general(
+            qb, kb, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )                                      # (G, BS) f32
+        pos = j * block_size + jax.lax.broadcasted_iota(
+            jnp.int32, scores.shape, 1
+        )
+        valid = pos < length
+        if window:
+            # sliding-window serving: the newest valid position is the
+            # query itself (length - 1); keys below length - window are
+            # out of reach
+            valid = jnp.logical_and(valid, pos > length - 1 - window)
+        scores = jnp.where(valid, scores, NEG_INF)
+        m_prev = m_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(scores, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(scores - m_new)
+        l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=1, keepdims=True)
+        acc_ref[...] = acc_ref[...] * alpha + jax.lax.dot_general(
+            p, vb.astype(jnp.float32), (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[...] = m_new
+
+    @pl.when(j == n_blocks - 1)
+    def _finalize():
+        # length == 0 slots divide 0/0 -> NaN, matching the gather
+        # path's all-masked softmax (engines never read idle slots)
+        o_ref[0, 0] = (acc_ref[...] / l_ref[...]).astype(out_dtype)
+
+
+def paged_attend_pallas(q, kpool_l, vpool_l, tables, lengths,
+                        block_size: int, window: int = 0,
+                        interpret: Optional[bool] = None):
+    """Drop-in twin of models/paged._paged_attend.
+
+    q (S, 1, h, d); pools (P, BS, kvh, d); tables (S, M) int32; lengths
+    (S,) int32.  Returns (S, 1, h, d) in q's dtype.
+    """
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    S, one, h, d = q.shape
+    P, BS, kvh, dk = kpool_l.shape
+    assert one == 1 and dk == d
+    if BS != block_size:
+        raise ValueError(f"pool block size {BS} != engine block size "
+                         f"{block_size}")
+    g = h // kvh
+    G = max(g, 8)  # sublane floor for the (G, BS) / (G, d) dots
+    M = tables.shape[1]
+
+    qs = (q / np.sqrt(d).astype(q.dtype)).reshape(S, kvh, g, d)
+    if G != g:
+        qs = jnp.concatenate(
+            [qs, jnp.zeros((S, kvh, G - g, d), qs.dtype)], axis=2
+        )
+    tables_flat = tables.reshape(-1).astype(jnp.int32)
+    lengths = lengths.astype(jnp.int32)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, kvh, M),
+        in_specs=[
+            pl.BlockSpec((1, 1, G, d),
+                         lambda s, c, j, tabs, lens: (s, c, 0, 0)),
+            pl.BlockSpec((1, BS, 1, d),
+                         lambda s, c, j, tabs, lens: (tabs[s * M + j], 0, c, 0)),
+            pl.BlockSpec((1, BS, 1, d),
+                         lambda s, c, j, tabs, lens: (tabs[s * M + j], 0, c, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, G, d),
+                               lambda s, c, j, tabs, lens: (s, c, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((G, 1), jnp.float32),   # running max
+            pltpu.VMEM((G, 1), jnp.float32),   # running denom
+            pltpu.VMEM((G, d), jnp.float32),   # weighted-sum acc
+        ],
+    )
+    kernel = functools.partial(
+        _kernel, block_size=block_size, window=window, out_dtype=q.dtype
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((S, kvh, G, d), q.dtype),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(tables_flat, lengths, qs, kpool_l, vpool_l)
+    return out[:, :, :g, :].reshape(S, 1, h, d)

@@ -368,15 +368,18 @@ def train(
             from tpulab.io.bpe import corpus_from_dir
 
             ids = tok.encode(corpus_from_dir(data_dir))
-            need = (seq + 1) * max(4, batch)
-            if len(ids) < need:
-                raise ValueError(
-                    f"corpus encodes to {len(ids)} tokens; need >= {need} "
-                    f"for seq={seq} batch={batch}"
-                )
             # held-out tail for eval: ~10%, at least eval_batches windows
             hold = max((seq + 1) * max(eval_batches, 1), len(ids) // 10)
-            hold = min(hold, len(ids) - (seq + 1))
+            # the size check must account for the tail it carves off:
+            # a corpus that only just covers `need` would otherwise
+            # shrink to one fixed training window (silent memorization)
+            need = (seq + 1) * max(4, batch)
+            if len(ids) < need + hold:
+                raise ValueError(
+                    f"corpus encodes to {len(ids)} tokens; need >= "
+                    f"{need + hold} (train windows {need} + eval tail "
+                    f"{hold}) for seq={seq} batch={batch}"
+                )
             train_ids, val_ids = ids[:-hold], ids[-hold:]
 
             def _windows(src: np.ndarray, rng, rows: int) -> np.ndarray:

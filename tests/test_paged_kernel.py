@@ -99,6 +99,73 @@ def test_engine_tokens_bit_equal_across_attn_impls():
         assert np.array_equal(a, b), (a, b)
 
 
+def test_int8_kv_cache_tokens_and_memory():
+    """kv_dtype='int8': greedy tokens survive the quantization on a
+    sharpened model (incl. prefix-shared blocks) and the pool data
+    really is int8 at half the bf16 bytes."""
+    cfg = LabformerConfig(d_model=64, n_heads=8, n_kv_heads=4, n_layers=2,
+                          d_ff=128, max_seq=64, dtype=jnp.bfloat16)
+    params = _trained_params(cfg)
+    shared = (np.arange(16) % 7).astype(np.int32)  # 2 full blocks shared
+    prompts = [np.concatenate([shared, (np.arange(4) % 5).astype(np.int32)]),
+               np.concatenate([shared, (np.ones(3) * 3).astype(np.int32)])]
+    outs = {}
+    for kv in ("native", "int8"):
+        eng = PagedEngine(params, cfg, slots=2, n_blocks=16, block_size=8,
+                          max_seq=64, kv_dtype=kv)
+        rids = [eng.submit(p, max_new=6) for p in prompts]
+        got = eng.run()
+        outs[kv] = [np.asarray(got[r]) for r in rids]
+        if kv == "int8":
+            data, scale = eng.kpool
+            assert data.dtype == jnp.int8 and scale.dtype == jnp.float32
+            assert data.nbytes == scale.size * cfg.head_dim  # 1 byte/elt
+        else:
+            assert eng.kpool.dtype == jnp.bfloat16
+    for a, b in zip(outs["native"], outs["int8"]):
+        assert np.array_equal(a, b), (a, b)
+
+
+def test_int8_kv_logits_close():
+    """Quantization error bound on raw decode logits, random model."""
+    from tpulab.models.paged import init_pools, paged_decode_step
+
+    cfg = LabformerConfig(d_model=64, n_heads=4, n_layers=2, d_ff=128,
+                          max_seq=64)
+    params = init_params(cfg, seed=0)
+    rng = np.random.default_rng(0)
+    tables = jnp.asarray(rng.choice(np.arange(1, 9), (2, 4), replace=False)
+                         .reshape(2, 4), jnp.int32)
+    lengths = jnp.asarray([5, 11], jnp.int32)
+    toks = jnp.asarray([3, 4], jnp.int32)
+    outs = {}
+    for kv in ("native", "int8"):
+        kp, vp = init_pools(cfg, 16, 8, kv)
+        # warm the pools with a few decode steps so the attended keys
+        # are real (quantized-on-write) values, not zeros
+        l = lengths - 3
+        for i in range(3):
+            logits, kp, vp = paged_decode_step(
+                params, toks + i, kp, vp, tables, l + i, cfg, 8)
+        outs[kv] = np.asarray(logits, np.float32)
+    err = np.max(np.abs(outs["native"] - outs["int8"]))
+    spread = np.ptp(outs["native"])
+    assert err < 0.05 * spread, (err, spread)
+
+
+def test_int8_kv_refusals():
+    cfg = LabformerConfig(d_model=32, n_heads=4, n_layers=2, d_ff=64,
+                          max_seq=64)
+    params = init_params(cfg, seed=0)
+    with pytest.raises(ValueError, match="gather path"):
+        PagedEngine(params, cfg, slots=1, n_blocks=8, block_size=8,
+                    max_seq=32, attn="pallas", kv_dtype="int8")
+    from tpulab.models.paged import init_pools
+
+    with pytest.raises(ValueError, match="expected"):
+        init_pools(cfg, 8, 8, "fp4")
+
+
 def test_engine_refuses_pallas_with_mesh():
     cfg = LabformerConfig(d_model=32, n_heads=4, n_layers=2, d_ff=64,
                           max_seq=64)

@@ -61,10 +61,56 @@ from tpulab.parallel.ring import NEG_INF
 TRASH = 0  # physical block 0 swallows must-not-land writes
 
 
-def init_pools(cfg: LabformerConfig, n_blocks: int, block_size: int):
-    """K/V pools (L, P, BS, kv, d); block 0 is the trash block."""
+def init_pools(cfg: LabformerConfig, n_blocks: int, block_size: int,
+               kv_dtype: str = "native"):
+    """K/V pools (L, P, BS, kv, d); block 0 is the trash block.
+
+    ``kv_dtype="int8"`` stores each pool as an ``(int8 data, f32
+    per-position-per-head scale)`` pair — symmetric amax quantization
+    along the head dim at write time.  Halves (vs bf16) the KV bytes
+    per context, so the same HBM holds ~2x the concurrent sequences
+    and every decode step reads ~half the attention bytes.  All read
+    paths dequantize through the same helper, so the prefix cache's
+    shared blocks stay consistent across requests.
+    """
     shape = (cfg.n_layers, n_blocks, block_size, cfg.kv_heads, cfg.head_dim)
+    if kv_dtype == "int8":
+        def one():
+            return (jnp.zeros(shape, jnp.int8),
+                    jnp.zeros(shape[:-1], jnp.float32))
+        return one(), one()
+    if kv_dtype != "native":
+        raise ValueError(f"kv_dtype={kv_dtype!r}; expected 'native' or 'int8'")
     return jnp.zeros(shape, cfg.dtype), jnp.zeros(shape, cfg.dtype)
+
+
+def _kv_quant(x):
+    """(..., d) -> (int8 data, f32 scale (...,)): symmetric amax."""
+    xf = x.astype(jnp.float32)
+    scale = jnp.maximum(jnp.max(jnp.abs(xf), axis=-1), 1e-8) / 127.0
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def _pool_write(pool, idx, x):
+    """Write new K/V rows at index tuple ``idx`` (e.g. ``(blk, off)`` or
+    ``(layer, blk, off)``); quantizing when the pool is an (int8,
+    scale) pair — the ONE quantize-on-write site every path shares."""
+    if isinstance(pool, tuple):
+        data, scale = pool
+        q, s = _kv_quant(x)
+        return data.at[idx].set(q), scale.at[idx].set(s)
+    return pool.at[idx].set(x)
+
+
+def _pool_gather(pool, idx, dtype):
+    """Gather pool blocks by table ``idx`` and return dense (..., d) in
+    ``dtype`` (dequantizing int8 pools)."""
+    if isinstance(pool, tuple):
+        data, scale = pool
+        return (data[idx].astype(jnp.float32)
+                * scale[idx][..., None]).astype(dtype)
+    return pool[idx]
 
 
 def _rope_at(x, pos, theta: float):
@@ -88,11 +134,13 @@ def _paged_attend(q, kpool_l, vpool_l, tables, lengths, block_size: int,
     key space (M*BS positions) and masks to [0, length).  Grouped heads
     as in generate._attend_cached."""
     S, _, h, dh = q.shape
-    kvh = kpool_l.shape[2]
+    kvh = (kpool_l[0] if isinstance(kpool_l, tuple) else kpool_l).shape[2]
     g = h // kvh
     M = tables.shape[1]
-    k = kpool_l[tables].reshape(S, M * block_size, kvh, dh)
-    v = vpool_l[tables].reshape(S, M * block_size, kvh, dh)
+    k = _pool_gather(kpool_l, tables, q.dtype).reshape(
+        S, M * block_size, kvh, dh)
+    v = _pool_gather(vpool_l, tables, q.dtype).reshape(
+        S, M * block_size, kvh, dh)
     q = q / np.sqrt(dh).astype(q.dtype)
     qg = q.reshape(S, 1, kvh, g, dh)
     s = jnp.einsum("bqcgd,bkcd->bcgqk", qg, k).astype(jnp.float32)
@@ -143,8 +191,8 @@ def paged_decode_step(params, tokens, kpool, vpool, tables, lengths,
         v = qmat(xn, layer["wv"]).reshape(S, 1, kvh, dh)
         q = _rope_at(q, pos, cfg.rope_theta)
         k = _rope_at(k, pos, cfg.rope_theta)
-        kpool_l = kpool_l.at[blk, off].set(k[:, 0])
-        vpool_l = vpool_l.at[blk, off].set(v[:, 0])
+        kpool_l = _pool_write(kpool_l, (blk, off), k[:, 0])
+        vpool_l = _pool_write(vpool_l, (blk, off), v[:, 0])
         if attn == "pallas":
             from tpulab.ops.pallas.paged import paged_attend_pallas
 
@@ -197,10 +245,12 @@ def paged_extend(params, tokens, kpool, vpool, table_row, start, n_valid,
         v = qmat(xn, layer["wv"]).reshape(1, bucket, kvh, dh)
         q = _rope(q, pos, cfg.rope_theta)
         k = _rope(k, pos, cfg.rope_theta)
-        kpool_l = kpool_l.at[blk, off].set(k[0])
-        vpool_l = vpool_l.at[blk, off].set(v[0])
-        kg = kpool_l[table_row].reshape(1, M * block_size, kvh, dh)
-        vg = vpool_l[table_row].reshape(1, M * block_size, kvh, dh)
+        kpool_l = _pool_write(kpool_l, (blk, off), k[0])
+        vpool_l = _pool_write(vpool_l, (blk, off), v[0])
+        kg = _pool_gather(kpool_l, table_row, cfg.dtype).reshape(
+            1, M * block_size, kvh, dh)
+        vg = _pool_gather(vpool_l, table_row, cfg.dtype).reshape(
+            1, M * block_size, kvh, dh)
         # generate._attend_cached IS the windowed causal attend over a
         # gathered key space (row r reads keys [0, start+r]) — one copy
         # of the numerics-sensitive recipe, shared with dense decode
@@ -233,8 +283,8 @@ def _scatter_prefill(kpool, vpool, k_seq, v_seq, table_row, start, p,
         # into its own pool slice
         kpool, vpool, i = carry
         k_l, v_l = seqs
-        kpool = kpool.at[i, blk, off].set(k_l)
-        vpool = vpool.at[i, blk, off].set(v_l)
+        kpool = _pool_write(kpool, (i, blk, off), k_l)
+        vpool = _pool_write(vpool, (i, blk, off), v_l)
         return (kpool, vpool, i + 1), None
 
     (kpool, vpool, _), _ = jax.lax.scan(
@@ -304,7 +354,7 @@ class PagedEngine:
     def __init__(self, params, cfg: LabformerConfig, *, slots: int = 4,
                  n_blocks: int = 64, block_size: int = 16,
                  max_seq: int = 256, prefill_chunk: int = 0, mesh=None,
-                 attn: str = "gather"):
+                 attn: str = "gather", kv_dtype: str = "native"):
         if max_seq % block_size:
             raise ValueError("max_seq must be a multiple of block_size")
         if prefill_chunk < 0:
@@ -318,10 +368,24 @@ class PagedEngine:
             )
         if attn not in ("gather", "pallas"):
             raise ValueError(f"attn={attn!r}; expected 'gather' or 'pallas'")
+        if kv_dtype not in ("native", "int8"):
+            # validate HERE, not just in init_pools: the mesh branch
+            # allocates pools itself and would silently serve native
+            # pools for a typoed kv_dtype
+            raise ValueError(
+                f"kv_dtype={kv_dtype!r}; expected 'native' or 'int8'")
         if attn == "pallas" and mesh is not None:
             # the kernel is single-device; under tp the gather path's
             # GSPMD partitioning is the supported route
             raise ValueError("attn='pallas' does not support mesh serving")
+        if kv_dtype == "int8":
+            if attn == "pallas":
+                raise ValueError(
+                    "kv_dtype='int8' is served by the gather path (the "
+                    "pallas kernel reads native-dtype pools)")
+            if mesh is not None:
+                raise ValueError("kv_dtype='int8' does not support mesh "
+                                 "serving (scale pools are unsharded)")
         self.params = params
         self.cfg = cfg
         self.slots = slots
@@ -329,7 +393,8 @@ class PagedEngine:
         self.block_size = block_size
         self.max_blocks = max_seq // block_size
         if mesh is None:
-            self.kpool, self.vpool = init_pools(cfg, n_blocks, block_size)
+            self.kpool, self.vpool = init_pools(cfg, n_blocks, block_size,
+                                                kv_dtype)
         else:
             # tensor-parallel serving: params take their tp shardings
             # and the pools shard on the kv-head axis — GSPMD partitions

@@ -233,6 +233,32 @@ def test_train_init_from_end_to_end(tmp_path):
         train(steps=1, init_from=pre, resume=True, ckpt_dir=pre)
 
 
+def test_generate_sidecar_autodiscovers_lora_and_tokenizer(tmp_path, capsys):
+    """`tpulab generate --ckpt-dir` ALONE serves a lora+BPE checkpoint:
+    the config sidecar reconstructs dims/vocab/adapters and the copied
+    tokenizer encodes/decodes — no flags to forget."""
+    from tpulab.io.bpe import train_bpe
+    from tpulab.models import generate as gen_cli
+    from tpulab.train import train
+
+    data = tmp_path / "data"
+    data.mkdir()
+    (data / "c.txt").write_bytes(b"the quick brown fox. " * 2000)
+    tok = train_bpe((data / "c.txt").read_bytes(), vocab=300)
+    tokp = str(tmp_path / "tok.json")
+    tok.save(tokp)
+
+    ck = str(tmp_path / "ck")
+    train(steps=4, batch=2, seq=32, data_dir=str(data), tokenizer=tokp,
+          lora_rank=2, ckpt_dir=ck, save_every=2, log=lambda *a: None)
+    rc = gen_cli.main(["--ckpt-dir", ck, "--steps", "4",
+                       "--temperature", "0", "--prompt", "the"])
+    out = capsys.readouterr().out
+    assert rc in (0, None)
+    assert "config sidecar" in out and "lora r2" in out
+    assert "merged LoRA adapters (rank 2)" in out
+
+
 def test_generate_cli_merges_lora_checkpoint(tmp_path, capsys):
     """train --lora-rank checkpoint -> generate --lora-rank: the CLI
     restores the adapter leaves and folds them before serving (without

@@ -415,15 +415,40 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     import dataclasses
+    import json
+    import os
 
-    cfg = demo_config()
+    # checkpoint config sidecar (written by tpulab.train): reconstructs
+    # the trained architecture — dims, vocab, lora, tokenizer — so
+    # `--ckpt-dir` alone serves any trainer output.  Explicit flags
+    # still override (and pre-sidecar checkpoints behave as before).
+    sidecar = None
+    tok_path = args.tokenizer
+    if args.ckpt_dir:
+        sc_path = os.path.join(args.ckpt_dir, "tpulab_config.json")
+        if os.path.exists(sc_path):
+            with open(sc_path) as f:
+                sidecar = json.load(f)
+    if sidecar is not None:
+        from tpulab.models.labformer import cfg_from_dict
+
+        cfg = cfg_from_dict(sidecar["config"])
+        print(f"[generate] config sidecar: d{cfg.d_model} L{cfg.n_layers} "
+              f"vocab {cfg.vocab}"
+              + (f" lora r{cfg.lora_rank}" if cfg.lora_rank else ""))
+        if tok_path is None and sidecar.get("tokenizer"):
+            tok_path = os.path.join(args.ckpt_dir, sidecar["tokenizer"])
+    else:
+        cfg = demo_config()
     tok = None
-    if args.tokenizer:
+    if tok_path:
         from tpulab.io.bpe import BPETokenizer
 
-        tok = BPETokenizer.load(args.tokenizer)
-        cfg = dataclasses.replace(cfg, vocab=tok.vocab)
-    if args.lora_rank:
+        tok = BPETokenizer.load(tok_path)
+        if tok.vocab != cfg.vocab:
+            cfg = dataclasses.replace(cfg, vocab=tok.vocab)
+    if args.lora_rank and (args.lora_rank != cfg.lora_rank
+                           or args.lora_alpha != cfg.lora_alpha):
         cfg = dataclasses.replace(cfg, lora_rank=args.lora_rank,
                                   lora_alpha=args.lora_alpha)
     try:
@@ -432,11 +457,12 @@ def main(argv=None) -> int:
         raise SystemExit(str(e))
     if step is not None:
         print(f"[generate] loaded checkpoint step {step}")
-    if args.lora_rank:
+    if cfg.lora_rank:
         from tpulab.models.labformer import merge_lora
 
+        rank = cfg.lora_rank
         params, cfg = merge_lora(params, cfg)
-        print(f"[generate] merged LoRA adapters (rank {args.lora_rank})")
+        print(f"[generate] merged LoRA adapters (rank {rank})")
 
     # a stop BYTE is a byte regardless of the token space: under BPE it
     # is detected in the DECODED byte stream (the byte may be merged

@@ -687,6 +687,29 @@ def _accum_value_and_grad(loss_of, wrt, tokens, accum, post_grads=None):
     )
 
 
+def cfg_to_dict(cfg: LabformerConfig) -> Dict[str, Any]:
+    """JSON-able config dict (dtype by name) — the checkpoint sidecar
+    payload, so serving surfaces can reconstruct the trained
+    architecture without the user re-passing every flag."""
+    d = dataclasses.asdict(cfg)
+    d["dtype"] = jnp.dtype(cfg.dtype).name
+    return d
+
+
+def cfg_from_dict(d: Dict[str, Any]) -> LabformerConfig:
+    """Inverse of :func:`cfg_to_dict`; unknown keys refuse loudly (a
+    sidecar from a newer version must not silently drop semantics)."""
+    known = {f.name for f in dataclasses.fields(LabformerConfig)}
+    extra = set(d) - known
+    if extra:
+        raise ValueError(f"unknown config keys {sorted(extra)} "
+                         f"(sidecar from a newer tpulab?)")
+    kw = dict(d)
+    if "dtype" in kw:
+        kw["dtype"] = jnp.dtype(kw["dtype"]).type
+    return LabformerConfig(**kw)
+
+
 def _split_lora(params):
     """(adapter_subtree, base_params) — split by the ``_lora_`` leaf names."""
     blocks = params["blocks"]

@@ -476,6 +476,28 @@ def train(
         manager = ocp.CheckpointManager(
             ckpt_path, options=ocp.CheckpointManagerOptions(max_to_keep=3)
         )
+        sc_path = os.path.join(ckpt_path, "tpulab_config.json")
+        if model == "labformer" and not (resume and os.path.exists(sc_path)):
+            # config sidecar: serving surfaces reconstruct the trained
+            # architecture (dims, vocab, lora, window) without the user
+            # re-passing every flag — `tpulab generate --ckpt-dir` just
+            # works.  The tokenizer is COPIED in, so the checkpoint
+            # stays self-contained if the original file moves.  On
+            # resume an existing sidecar is AUTHORITATIVE: rewriting it
+            # from this invocation's flags would clobber the trained
+            # architecture record with whatever the user forgot to
+            # re-pass.
+            from tpulab.models.labformer import cfg_to_dict
+
+            sidecar = {"model": "labformer", "config": cfg_to_dict(cfg)}
+            if tokenizer:
+                tok_dst = os.path.join(ckpt_path, "tokenizer.json")
+                if not (os.path.exists(tok_dst)
+                        and os.path.samefile(tokenizer, tok_dst)):
+                    shutil.copyfile(tokenizer, tok_dst)
+                sidecar["tokenizer"] = "tokenizer.json"
+            with open(sc_path, "w") as f:
+                json.dump(sidecar, f, indent=2)
         if resume and manager.latest_step() is not None:
             start_step = manager.latest_step()
             params, opt_state = _restore_latest(

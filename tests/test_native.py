@@ -339,6 +339,50 @@ class TestDaemonGenerate:
         status, out = _raw_request(daemon, b'{"lab": "generate"}', b"")
         assert status == 1 and "empty prompt" in out
 
+    def test_generate_sidecar_checkpoint_bpe_lora(self, daemon,
+                                                  tmp_path_factory):
+        """A lora+BPE trainer checkpoint served over the wire: the
+        daemon honors the config sidecar (dims/vocab), folds the
+        adapters, and transparently BPE-en/decodes the byte payload —
+        matching the local merge+tokenize path exactly."""
+        import json as _json
+
+        import numpy as np
+
+        from tpulab.io.bpe import BPETokenizer, train_bpe
+        from tpulab.models.generate import load_params
+        from tpulab.models.labformer import cfg_from_dict, merge_lora
+        from tpulab.models.paged import PagedEngine
+        from tpulab.train import train
+
+        work = tmp_path_factory.mktemp("sidecar")
+        data = work / "data"
+        data.mkdir()
+        (data / "c.txt").write_bytes(b"the quick brown fox. " * 2000)
+        tok = train_bpe((data / "c.txt").read_bytes(), vocab=300)
+        tokp = str(work / "tok.json")
+        tok.save(tokp)
+        ck = str(work / "ck")
+        train(steps=4, batch=2, seq=32, data_dir=str(data), tokenizer=tokp,
+              lora_rank=2, ckpt_dir=ck, save_every=2, log=lambda *a: None)
+
+        header = _json.dumps(
+            {"lab": "generate", "config": {"steps": 5, "ckpt_dir": ck}}
+        ).encode()
+        status, out = _raw_request_bytes(daemon, header, b"the quick")
+        assert status == 0, out
+
+        sc = _json.loads((pathlib.Path(ck) / "tpulab_config.json").read_text())
+        cfg = cfg_from_dict(sc["config"])
+        params, _ = load_params(cfg, ck)
+        params, cfg = merge_lora(params, cfg)
+        tok2 = BPETokenizer.load(str(pathlib.Path(ck) / "tokenizer.json"))
+        eng = PagedEngine(params, cfg, slots=4, n_blocks=128, block_size=16,
+                          max_seq=512)
+        rid = eng.submit(tok2.encode(b"the quick"), max_new=5)
+        want = tok2.decode([int(t) for t in eng.run()[rid]])
+        assert out == want
+
 
 class TestDaemonConcurrency:
     """Per-connection threads + the shared-engine stepper: concurrent

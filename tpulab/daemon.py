@@ -112,7 +112,7 @@ class _ByteBudget:
             self.cond.notify_all()
 
 
-_ENGINES: "dict" = {}  # realpath|None -> (loaded_step, engine); LRU, max 2
+_ENGINES: "dict" = {}  # realpath|None -> (loaded_step, engine, tok); LRU, max 2
 
 
 class _EngineState:
@@ -239,10 +239,17 @@ def _ckpt_stamp(ckpt_dir: str):
 
 
 def _engine_for(ckpt):
-    """Warm engine for the demo model (or a trainer snapshot), with the
-    cache problems a naive dict would have handled: keys are realpaths
-    (``ckpts`` and ``./ckpts`` alias), a newer checkpoint step evicts
-    the stale engine, and at most 2 engines stay resident (LRU).
+    """Warm (engine, tokenizer|None) for the demo model or a trainer
+    snapshot, with the cache problems a naive dict would have handled:
+    keys are realpaths (``ckpts`` and ``./ckpts`` alias), a newer
+    checkpoint step evicts the stale engine, and at most 2 engines stay
+    resident (LRU).
+
+    A checkpoint's config sidecar (tpulab_config.json, written by
+    tpulab.train) is honored: the trained dims/vocab replace the demo
+    config, LoRA adapters fold before serving, and the copied BPE
+    tokenizer is returned so the wire's byte payloads en/decode through
+    it transparently.
 
     Only the dict lookups hold the service lock — the multi-second cold
     build (checkpoint restore + pool allocation) runs OUTSIDE it so
@@ -257,21 +264,29 @@ def _engine_for(ckpt):
         hit = _ENGINES.get(key)
         if hit is not None and hit[0] == stamp:
             _ENGINES[key] = _ENGINES.pop(key)  # LRU freshen
-            return hit[1]
-    cfg = demo_config()
+            return hit[1], hit[2]
+    from tpulab.models.generate import load_sidecar
+
+    cfg, tok = load_sidecar(key)
+    if cfg is None:
+        cfg = demo_config()
     params, _ = load_params(cfg, key)
+    if cfg.lora_rank:
+        from tpulab.models.labformer import merge_lora
+
+        params, cfg = merge_lora(params, cfg)
     engine = PagedEngine(
         params, cfg, slots=4, n_blocks=128, block_size=16, max_seq=512
     )
     with _GEN_SERVICE.lock:
         hit = _ENGINES.get(key)
         if hit is not None and hit[0] == stamp:
-            return hit[1]  # concurrent build won; use theirs
+            return hit[1], hit[2]  # concurrent build won; use theirs
         _ENGINES.pop(key, None)
-        _ENGINES[key] = (stamp, engine)
+        _ENGINES[key] = (stamp, engine, tok)
         while len(_ENGINES) > 2:
             _ENGINES.pop(next(iter(_ENGINES)))
-    return engine
+    return engine, tok
 
 
 def _handle_generate(header: dict, payload: bytes) -> bytes:
@@ -295,16 +310,40 @@ def _handle_generate(header: dict, payload: bytes) -> bytes:
     if not payload:
         # reject before paying model/engine construction on a cold cache
         raise ValueError("empty prompt")
-    engine = _engine_for(config.get("ckpt_dir"))
-    prompt = np.frombuffer(payload, np.uint8).astype(np.int32)
+    stop_byte = int(config.get("stop_byte", -1))
+    if stop_byte > 255:
+        # a stop BYTE is a byte in any token space; reject BEFORE the
+        # engine build/generation is paid (the BPE decode path would
+        # otherwise crash at bytes([stop_byte]) after full compute)
+        raise ValueError(f"stop_byte must be in [-1, 255], got {stop_byte}")
+    engine, tok = _engine_for(config.get("ckpt_dir"))
+    if tok is None:
+        prompt = np.frombuffer(payload, np.uint8).astype(np.int32)
+        eng_stop = stop_byte
+    else:
+        # BPE checkpoint: the wire stays raw bytes; the daemon encodes
+        # and decodes through the checkpoint's own tokenizer.  ``steps``
+        # counts TOKENS (more text per step than the byte LM); the stop
+        # byte is found in the DECODED stream, since it may be merged
+        # inside a larger token
+        prompt = tok.encode(bytes(payload))
+        eng_stop = -1
     out = _GEN_SERVICE.generate(
         engine, prompt, steps,
         temperature=float(config.get("temperature", 0.0)),
         seed=int(config.get("seed", 0)),
         repetition_penalty=float(config.get("repetition_penalty", 1.0)),
-        stop_byte=int(config.get("stop_byte", -1)),
+        stop_byte=eng_stop,
     )
-    return bytes(int(t) & 0xFF for t in out)
+    if tok is None:
+        return bytes(int(t) & 0xFF for t in out)
+    data = tok.decode([int(t) for t in out])
+    if stop_byte >= 0:
+        cut = data.find(bytes([stop_byte]))
+        if cut >= 0:
+            data = data[: cut + 1]  # include the stop byte, like the
+            # byte-LM path (engine stops right AFTER emitting it)
+    return data
 
 
 def _handle_generate_stats(header: dict) -> bytes:

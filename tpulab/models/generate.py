@@ -327,6 +327,33 @@ def demo_config() -> LabformerConfig:
                            max_seq=1024)
 
 
+def load_sidecar(ckpt_dir: Optional[str]):
+    """(cfg|None, tokenizer|None) from a checkpoint's config sidecar
+    (``tpulab_config.json`` + copied ``tokenizer.json``, written by
+    tpulab.train) — THE one interpreter of the sidecar contract, shared
+    by the CLI and the daemon so the two serving surfaces cannot
+    diverge.  Returns (None, None) when no sidecar exists."""
+    import json
+    import os
+
+    if not ckpt_dir:
+        return None, None
+    sc_path = os.path.join(ckpt_dir, "tpulab_config.json")
+    if not os.path.exists(sc_path):
+        return None, None
+    from tpulab.models.labformer import cfg_from_dict
+
+    with open(sc_path) as f:
+        sidecar = json.load(f)
+    cfg = cfg_from_dict(sidecar["config"])
+    tok = None
+    if sidecar.get("tokenizer"):
+        from tpulab.io.bpe import BPETokenizer
+
+        tok = BPETokenizer.load(os.path.join(ckpt_dir, sidecar["tokenizer"]))
+    return cfg, tok
+
+
 def load_params(cfg: LabformerConfig, ckpt_dir: Optional[str] = None,
                 seed: int = 0):
     """Demo params: random init, or the latest trainer snapshot from
@@ -397,8 +424,10 @@ def main(argv=None) -> int:
                          "them (merge_lora) before serving.  Without "
                          "this, a partial restore against the base "
                          "template would silently drop the finetune.")
-    ap.add_argument("--lora-alpha", type=float, default=16.0,
-                    help="LoRA scale numerator used at finetune time")
+    ap.add_argument("--lora-alpha", type=float, default=None,
+                    help="LoRA scale numerator used at finetune time "
+                         "(default: the checkpoint sidecar's value, "
+                         "else 16.0)")
     ap.add_argument("--tokenizer", default=None, metavar="TOK_JSON",
                     help="BPE tokenizer the checkpoint was trained with "
                          "(tpulab train --tokenizer): sets the model "
@@ -415,42 +444,33 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     import dataclasses
-    import json
-    import os
 
     # checkpoint config sidecar (written by tpulab.train): reconstructs
     # the trained architecture — dims, vocab, lora, tokenizer — so
     # `--ckpt-dir` alone serves any trainer output.  Explicit flags
     # still override (and pre-sidecar checkpoints behave as before).
-    sidecar = None
-    tok_path = args.tokenizer
-    if args.ckpt_dir:
-        sc_path = os.path.join(args.ckpt_dir, "tpulab_config.json")
-        if os.path.exists(sc_path):
-            with open(sc_path) as f:
-                sidecar = json.load(f)
-    if sidecar is not None:
-        from tpulab.models.labformer import cfg_from_dict
-
-        cfg = cfg_from_dict(sidecar["config"])
+    sc_cfg, sc_tok = load_sidecar(args.ckpt_dir)
+    tok = sc_tok
+    if sc_cfg is not None:
+        cfg = sc_cfg
         print(f"[generate] config sidecar: d{cfg.d_model} L{cfg.n_layers} "
               f"vocab {cfg.vocab}"
               + (f" lora r{cfg.lora_rank}" if cfg.lora_rank else ""))
-        if tok_path is None and sidecar.get("tokenizer"):
-            tok_path = os.path.join(args.ckpt_dir, sidecar["tokenizer"])
     else:
         cfg = demo_config()
-    tok = None
-    if tok_path:
+    if args.tokenizer:  # explicit flag wins over the sidecar's copy
         from tpulab.io.bpe import BPETokenizer
 
-        tok = BPETokenizer.load(tok_path)
-        if tok.vocab != cfg.vocab:
-            cfg = dataclasses.replace(cfg, vocab=tok.vocab)
-    if args.lora_rank and (args.lora_rank != cfg.lora_rank
-                           or args.lora_alpha != cfg.lora_alpha):
-        cfg = dataclasses.replace(cfg, lora_rank=args.lora_rank,
-                                  lora_alpha=args.lora_alpha)
+        tok = BPETokenizer.load(args.tokenizer)
+    if tok is not None and tok.vocab != cfg.vocab:
+        cfg = dataclasses.replace(cfg, vocab=tok.vocab)
+    if args.lora_rank and args.lora_rank != cfg.lora_rank:
+        cfg = dataclasses.replace(cfg, lora_rank=args.lora_rank)
+    if args.lora_alpha is not None and args.lora_alpha != cfg.lora_alpha:
+        # None sentinel: a defaulted flag must not clobber the trained
+        # alpha (merge scale = alpha/rank — half-strength adapters
+        # would serve silently)
+        cfg = dataclasses.replace(cfg, lora_alpha=args.lora_alpha)
     try:
         params, step = load_params(cfg, args.ckpt_dir, seed=args.seed)
     except FileNotFoundError as e:

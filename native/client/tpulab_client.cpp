@@ -251,19 +251,37 @@ int main(int argc, char** argv) {
     uint64_t plen = payload.size();
     bool ok = send_exact(fd, &hlen, 4) && send_exact(fd, header.data(), hlen) &&
               send_exact(fd, &plen, 8) && send_exact(fd, payload.data(), plen);
-    uint8_t status = 2;
-    uint64_t rlen = 0;
-    if (ok && recv_exact(fd, &status, 1) && recv_exact(fd, &rlen, 8)) {
+    // Frame loop: status-2 CHUNK frames (streaming generate) print as
+    // they arrive; the terminal frame (0 ok / 1 error) ends the
+    // request.  After streamed chunks the terminal body is suppressed
+    // on stdout — it repeats the full output for non-streaming readers.
+    bool streamed = false;
+    while (ok) {
+      uint8_t status = 255;
+      uint64_t rlen = 0;
+      if (!recv_exact(fd, &status, 1) || !recv_exact(fd, &rlen, 8)) break;
       std::string out(rlen, '\0');
-      if (recv_exact(fd, out.data(), rlen)) {
-        close(fd);
-        if (status == 0) {
-          fwrite(out.data(), 1, out.size(), stdout);
-          return 0;
-        }
-        fwrite(out.data(), 1, out.size(), stderr);
-        return 1;
+      if (!recv_exact(fd, out.data(), rlen)) break;
+      if (status == 2) {
+        fwrite(out.data(), 1, out.size(), stdout);
+        fflush(stdout);
+        streamed = true;
+        continue;
       }
+      close(fd);
+      if (status == 0) {
+        if (!streamed) fwrite(out.data(), 1, out.size(), stdout);
+        return 0;
+      }
+      fwrite(out.data(), 1, out.size(), stderr);
+      return 1;
+    }
+    if (streamed) {
+      // partial output already reached stdout: a fallback rerun would
+      // duplicate it — report the broken stream instead
+      fprintf(stderr, "tpulab_client: stream broken mid-response\n");
+      close(fd);
+      return 1;
     }
     fprintf(stderr, "tpulab_client: daemon protocol error, falling back\n");
     close(fd);

@@ -339,6 +339,82 @@ class TestDaemonGenerate:
         status, out = _raw_request(daemon, b'{"lab": "generate"}', b"")
         assert status == 1 and "empty prompt" in out
 
+    def test_generate_streaming_chunks(self, daemon):
+        """{"stream": true}: status-2 chunk frames arrive before the
+        terminal frame; their concatenation equals the terminal frame's
+        full output, which equals the non-streamed response."""
+        h = b'{"lab": "generate", "config": {"steps": 6, "stream": true}}'
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.connect(daemon)
+        s.sendall(struct.pack("<I", len(h)) + h)
+        s.sendall(struct.pack("<Q", 5) + b"hello")
+
+        def _read_exact(n):
+            body = b""
+            while len(body) < n:
+                part = s.recv(n - len(body))
+                assert part, f"peer closed mid-frame ({len(body)}/{n})"
+                body += part
+            return body
+
+        chunks, final, status = [], None, None
+        while True:
+            st_b = _read_exact(1)[0]
+            (n,) = struct.unpack("<Q", _read_exact(8))
+            body = _read_exact(n)
+            if st_b == 2:
+                chunks.append(body)
+                continue
+            status, final = st_b, body
+            break
+        s.close()
+        assert status == 0
+        # >= 1, not a per-tick count: the waiter only sees increments
+        # when it wins the condition lock between ticks, so chunks may
+        # legally coalesce under scheduler pressure
+        assert len(chunks) >= 1, chunks
+        assert b"".join(chunks) == final
+        st2, plain = _raw_request_bytes(
+            daemon, b'{"lab": "generate", "config": {"steps": 6}}', b"hello")
+        assert st2 == 0 and plain == final
+
+    def test_aborted_stream_leaves_daemon_healthy(self, daemon):
+        """A streaming client that disconnects mid-generation must not
+        wedge or leak the daemon: the abandoned request is cancelled
+        (stepper discards its output) and the next request serves."""
+        h = (b'{"lab": "generate", "config": {"steps": 40, '
+             b'"stream": true}}')
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.connect(daemon)
+        s.sendall(struct.pack("<I", len(h)) + h)
+        s.sendall(struct.pack("<Q", 3) + b"abc")
+        s.recv(1)  # at least the first chunk frame has started
+        s.close()  # die mid-stream
+        # the daemon must still serve (and the stepper must drain the
+        # abandoned request without parking its output forever)
+        st2, out = _raw_request_bytes(
+            daemon, b'{"lab": "generate", "config": {"steps": 4}}', b"zz")
+        assert st2 == 0 and len(out) == 4
+
+    def test_native_client_streams(self, daemon, built_native, tmp_path):
+        """The C++ client prints chunk frames as they arrive and
+        suppresses the terminal body (no duplicated output)."""
+        client = ROOT / "native" / "bin" / "tpulab_client"
+        if not client.exists():
+            pytest.skip("native client not built")
+        env = dict(os.environ, TPULAB_DAEMON_SOCKET=daemon,
+                   JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
+                   PYTHONPATH=str(ROOT))
+        r = subprocess.run(
+            [str(client), "generate", "--steps", "6", "--stream", "true"],
+            input=b"hello", stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            env=env, timeout=120,
+        )
+        assert r.returncode == 0, r.stderr
+        st2, plain = _raw_request_bytes(
+            daemon, b'{"lab": "generate", "config": {"steps": 6}}', b"hello")
+        assert st2 == 0 and r.stdout == plain
+
     def test_generate_sidecar_checkpoint_bpe_lora(self, daemon,
                                                   tmp_path_factory):
         """A lora+BPE trainer checkpoint served over the wire: the

@@ -14,7 +14,12 @@ Wire protocol (all integers little-endian):
     request:  u32 header_len | header JSON | u64 payload_len | payload
               header = {"lab": str, "sweep": bool, "backend": str|null,
                         "config": {...}}       payload = stdin text bytes
-    response: u8 status (0 ok / 1 error) | u64 len | output bytes
+    response: zero or more CHUNK frames (u8 status=2 | u64 len | bytes;
+              only for generate with config {"stream": true} — each
+              carries the next incremental output bytes), then exactly
+              one terminal frame: u8 status (0 ok / 1 error) | u64 len |
+              output bytes (the FULL output, chunks included, so
+              non-streaming consumers read one frame as before)
 
 Run: ``python -m tpulab.daemon --socket /tmp/tpulab.sock``
 Stop: SIGTERM/SIGINT, or an empty header (client disconnect is fine too).
@@ -112,6 +117,12 @@ class _ByteBudget:
             self.cond.notify_all()
 
 
+class _StreamBroken(ConnectionError):
+    """A chunk-frame sendall failed (possibly mid-write): the wire can
+    no longer carry ANY further frame for this request — the connection
+    must close without a terminal frame."""
+
+
 _ENGINES: "dict" = {}  # realpath|None -> (loaded_step, engine, tok); LRU, max 2
 
 
@@ -119,11 +130,16 @@ class _EngineState:
     """Per-engine stepping state: its own condition + results map, so
     two warm engines' steppers (and their waiters) never serialize
     behind each other's device dispatch (round-2 advisor: one global
-    lock held across engine.step() stalled everything per tick)."""
+    lock held across engine.step() stalled everything per tick).
+
+    ``cancelled`` holds rids whose waiter gave up (streaming client
+    died): the stepper discards their finished output instead of
+    parking it in ``results`` forever."""
 
     def __init__(self):
         self.cond = threading.Condition()
         self.results: dict = {}
+        self.cancelled: set = set()
         self.stepper_alive = False
 
 
@@ -162,24 +178,61 @@ class _GenerateService:
 
     def generate(self, engine, prompt, steps: int, *,
                  temperature: float = 0.0, seed: int = 0,
-                 repetition_penalty: float = 1.0, stop_byte: int = -1):
+                 repetition_penalty: float = 1.0, stop_byte: int = -1,
+                 on_progress=None):
+        """Block until the request finishes; returns the full token
+        array.  ``on_progress(new_tokens)``, if given, is called with
+        each tick's incremental tokens — OUTSIDE the engine condition,
+        so a slow streaming consumer can never stall the stepper or
+        other waiters."""
         st = self._state_for(engine)
         with st.cond:
             rid = engine.submit(prompt, max_new=steps,
                                 temperature=temperature, seed=seed,
                                 repetition_penalty=repetition_penalty,
                                 stop_byte=stop_byte)
+            req = engine.pending[-1]  # just appended under this cond
             if not st.stepper_alive:
                 st.stepper_alive = True
                 threading.Thread(
                     target=self._step_loop, args=(engine, st), daemon=True
                 ).start()
-            while rid not in st.results:
-                st.cond.wait()
-            out = st.results.pop(rid)
-            if isinstance(out, Exception):
-                raise RuntimeError(f"engine step failed: {out!r}") from out
-            return out
+        sent = 0
+        try:
+            while True:
+                with st.cond:
+                    while rid not in st.results and len(req.out) <= sent:
+                        st.cond.wait()
+                    done = rid in st.results
+                    inc = list(req.out[sent:])
+                    sent = len(req.out)
+                    out = st.results.pop(rid) if done else None
+                if inc and on_progress is not None:
+                    on_progress(inc)
+                if done:
+                    if isinstance(out, Exception):
+                        raise RuntimeError(
+                            f"engine step failed: {out!r}") from out
+                    return out
+        except BaseException:
+            # the waiter is abandoning (typically: a streaming client
+            # died inside on_progress).  Without cleanup the request
+            # would finish anyway and its output would sit in
+            # st.results forever — a per-aborted-stream leak.
+            with st.cond:
+                if rid in st.results:
+                    st.results.pop(rid)
+                elif any(r.req_id == rid for r in engine.pending):
+                    # not yet admitted: no blocks held, just drop it
+                    engine.pending = [r for r in engine.pending
+                                      if r.req_id != rid]
+                else:
+                    # active: finish at the next tick (the normal path
+                    # recycles its blocks); the stepper discards the
+                    # output via the cancelled set
+                    req.max_new = max(len(req.out), 1)
+                    st.cancelled.add(rid)
+            raise
 
     def _step_loop(self, engine, st: _EngineState):
         try:
@@ -195,7 +248,11 @@ class _GenerateService:
                         st.stepper_alive = False
                         return
                     for rid in engine.step():
-                        st.results[rid] = engine._done.pop(rid)
+                        out = engine._done.pop(rid)
+                        if rid in st.cancelled:  # abandoned waiter
+                            st.cancelled.discard(rid)
+                            continue
+                        st.results[rid] = out
                     st.cond.notify_all()
         except Exception as e:  # fail every request; never hang waiters
             with st.cond:
@@ -289,7 +346,8 @@ def _engine_for(ckpt):
     return engine, tok
 
 
-def _handle_generate(header: dict, payload: bytes) -> bytes:
+def _handle_generate(header: dict, payload: bytes,
+                     send_chunk=None) -> bytes:
     """``generate`` pseudo-lab: payload = UTF-8 prompt bytes (the byte
     LM's tokens), response = generated continuation bytes.
 
@@ -328,12 +386,37 @@ def _handle_generate(header: dict, payload: bytes) -> bytes:
         # inside a larger token
         prompt = tok.encode(bytes(payload))
         eng_stop = -1
+
+    on_progress = None
+    if send_chunk is not None and bool(config.get("stream")):
+        # streaming: each tick's new tokens go out as a status-2 chunk
+        # frame (bytes; BPE-decoded per increment — token expansions
+        # are independent, so chunk boundaries are byte-exact).  After
+        # a stop byte the remaining generation is drained silently.
+        state = {"done": False}
+
+        def on_progress(new_tokens):
+            if state["done"]:
+                return
+            if tok is None:
+                chunk = bytes(int(t) & 0xFF for t in new_tokens)
+            else:
+                chunk = tok.decode([int(t) for t in new_tokens])
+            if tok is not None and stop_byte >= 0:
+                cut = chunk.find(bytes([stop_byte]))
+                if cut >= 0:
+                    chunk = chunk[: cut + 1]
+                    state["done"] = True
+            if chunk:
+                send_chunk(chunk)
+
     out = _GEN_SERVICE.generate(
         engine, prompt, steps,
         temperature=float(config.get("temperature", 0.0)),
         seed=int(config.get("seed", 0)),
         repetition_penalty=float(config.get("repetition_penalty", 1.0)),
         stop_byte=eng_stop,
+        on_progress=on_progress,
     )
     if tok is None:
         return bytes(int(t) & 0xFF for t in out)
@@ -368,9 +451,10 @@ def _handle_generate_stats(header: dict) -> bytes:
 _LAB_LOCK = threading.Lock()
 
 
-def handle_request(header: dict, payload: bytes) -> bytes:
+def handle_request(header: dict, payload: bytes,
+                   send_chunk=None) -> bytes:
     if header.get("lab") == "generate":
-        return _handle_generate(header, payload)
+        return _handle_generate(header, payload, send_chunk)
     if header.get("lab") == "generate_stats":
         return _handle_generate_stats(header)
     if header.get("lab") == "platform":
@@ -473,14 +557,33 @@ def serve(socket_path: str, *, max_requests: Optional[int] = None) -> None:
             # for time it spent waiting on US
             payload = _recv_exact(conn, plen,
                                   time.monotonic() + RECV_TIMEOUT_S)
-            # compute first, send ONCE: if the sendall itself fails
-            # (send timeout against a non-draining client is possible
-            # now that every socket op is bounded), no second frame may
-            # follow a partially-written one — the outer except closes
-            # the connection instead
+            # compute first, send the TERMINAL frame once: if a sendall
+            # fails (send timeout against a non-draining client is
+            # possible now that every socket op is bounded), no further
+            # frame may follow a partially-written one — the outer
+            # except closes the connection instead.  Streaming requests
+            # ({"stream": true} on generate) interleave status-2 chunk
+            # frames DURING compute; a chunk-send failure aborts the
+            # request the same way (broken stream, no terminal frame).
+            def send_chunk(data):
+                try:
+                    conn.settimeout(RECV_TIMEOUT_S)
+                    conn.sendall(
+                        struct.pack("<BQ", 2, len(data)) + bytes(data))
+                except OSError as e:
+                    # a failed sendall may have written PART of the
+                    # chunk frame: no further frame may follow it — a
+                    # terminal error frame would be parsed as chunk
+                    # body / garbage header.  _StreamBroken bypasses
+                    # the error-frame path; the outer except closes
+                    # the connection.
+                    raise _StreamBroken(str(e)) from e
+
             try:
-                out = handle_request(header, payload)
+                out = handle_request(header, payload, send_chunk)
                 frame = struct.pack("<BQ", 0, len(out)) + out
+            except _StreamBroken:
+                raise
             except Exception:
                 err = traceback.format_exc().encode("utf-8")
                 frame = struct.pack("<BQ", 1, len(err)) + err

@@ -1,0 +1,114 @@
+"""`tpulab eval` (standalone held-out evaluation) and the optimizer zoo.
+
+Claims under test:
+  * eval honors checkpoint sidecars (BPE vocab, LoRA fold) and reports
+    loss / perplexity / bits-per-byte with consistent accounting
+    (byte LM: bpb == loss/ln2);
+  * a trained checkpoint evaluates better than a random one on its own
+    corpus;
+  * BPE checkpoints refuse the synthetic stream (byte-space noise in a
+    subword vocab would be a meaningless number);
+  * every optimizer in the zoo trains (finite, decreasing-ish loss) and
+    unknown names refuse.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from tpulab.evaluate import evaluate
+from tpulab.train import build_optimizer, train
+
+
+def _corpus(tmp_path, text=b"the quick brown fox jumps over the lazy dog. ",
+            reps=2000):
+    data = tmp_path / "data"
+    data.mkdir(exist_ok=True)
+    (data / "c.txt").write_bytes(text * reps)
+    return str(data)
+
+
+def test_eval_byte_lm_accounting(tmp_path):
+    data = _corpus(tmp_path)
+    ck = str(tmp_path / "ck")
+    train(steps=6, batch=2, seq=32, data_dir=data, ckpt_dir=ck,
+          save_every=3, log=lambda *a: None)
+    rep = evaluate(ck, data, batches=2, batch=2, seq=32)
+    assert rep["step"] == 6
+    assert np.isfinite(rep["loss_nats_per_token"])
+    # byte LM: one token == one byte, so bpb is exactly loss/ln2
+    assert rep["bits_per_byte"] == pytest.approx(
+        rep["loss_nats_per_token"] / np.log(2), abs=1e-3)
+    assert rep["perplexity"] == pytest.approx(
+        np.exp(rep["loss_nats_per_token"]), rel=1e-3)
+
+
+def test_eval_trained_beats_random(tmp_path):
+    data = _corpus(tmp_path)
+    ck = str(tmp_path / "ck")
+    train(steps=30, batch=4, seq=32, data_dir=data, ckpt_dir=ck,
+          save_every=30, log=lambda *a: None)
+    trained = evaluate(ck, data, batches=2, batch=2, seq=32)
+    # random-weights baseline: same arch, no checkpoint found -> error,
+    # so compare against the model's ceiling ln(256) instead
+    assert trained["loss_nats_per_token"] < np.log(256) - 0.5
+
+
+def test_eval_bpe_sidecar_and_refusal(tmp_path):
+    data = _corpus(tmp_path)
+    tokp = str(tmp_path / "tok.json")
+    from tpulab.io.bpe import train_bpe
+
+    tok = train_bpe(open(tmp_path / "data" / "c.txt", "rb").read(), 300)
+    tok.save(tokp)
+    ck = str(tmp_path / "ck")
+    train(steps=6, batch=2, seq=32, data_dir=data, tokenizer=tokp,
+          lora_rank=2, ckpt_dir=ck, save_every=3, log=lambda *a: None)
+    rep = evaluate(ck, data, batches=2, batch=2, seq=32)
+    assert rep["tokenizer_vocab"] == tok.vocab
+    # BPE packs >1 byte per token, so bpb must be BELOW loss/ln2
+    assert rep["bits_per_byte"] < rep["loss_nats_per_token"] / np.log(2)
+    with pytest.raises(ValueError, match="data-dir"):
+        evaluate(ck, None, batches=1)
+
+
+def test_eval_cli(tmp_path, capsys):
+    from tpulab.evaluate import main as eval_main
+
+    data = _corpus(tmp_path)
+    ck = str(tmp_path / "ck")
+    train(steps=4, batch=2, seq=32, data_dir=data, ckpt_dir=ck,
+          save_every=2, log=lambda *a: None)
+    rc = eval_main(["--ckpt-dir", ck, "--data-dir", data,
+                    "--batches", "1", "--batch", "2", "--seq", "32"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0 and out["step"] == 4 and "bits_per_byte" in out
+
+
+@pytest.mark.parametrize("name", ["adamw", "lion", "adafactor", "sgd"])
+def test_optimizer_zoo_trains(name):
+    import jax.numpy as jnp
+    import optax  # noqa: F401  (import check)
+
+    from tpulab.models.labformer import LabformerConfig, init_train_state
+
+    cfg = LabformerConfig(d_model=32, n_heads=4, n_layers=2, d_ff=64,
+                          max_seq=64)
+    opt = build_optimizer(lr=1e-3 if name != "lion" else 3e-4, steps=20,
+                          optimizer=name)
+    params, opt_state, step = init_train_state(cfg, mesh=None, seed=0,
+                                               optimizer=opt)
+    cyc = np.tile(np.arange(33, dtype=np.int32) % 7, (4, 1))
+    losses = []
+    for _ in range(20):
+        params, opt_state, loss = step(params, opt_state, jnp.asarray(cyc))
+        losses.append(float(loss))
+    assert all(np.isfinite(l) for l in losses)
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]), (name, losses[:3],
+                                                        losses[-3:])
+
+
+def test_optimizer_unknown_refused():
+    with pytest.raises(ValueError, match="unknown optimizer"):
+        build_optimizer(lr=1e-3, steps=10, optimizer="adam2")

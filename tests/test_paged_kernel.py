@@ -166,6 +166,49 @@ def test_int8_kv_refusals():
         init_pools(cfg, 8, 8, "fp4")
 
 
+def test_cancel_releases_exactly_what_admission_allocated():
+    """Cancelling an active request must free the same blocks a
+    run-to-completion request frees (admission allocated for
+    prompt + max_new; a cancel that shrank max_new used to leak the
+    difference — ~30 aborted streams exhausted the daemon's pool)."""
+    cfg = LabformerConfig(d_model=32, n_heads=4, n_layers=2, d_ff=64,
+                          max_seq=64)
+    params = init_params(cfg, seed=0)
+    prompt = (np.arange(3) % 7).astype(np.int32)
+
+    def free_after(cancel_after_ticks):
+        eng = PagedEngine(params, cfg, slots=1, n_blocks=16, block_size=8,
+                          max_seq=64)
+        rid = eng.submit(prompt, max_new=40)
+        if cancel_after_ticks is None:
+            eng.run()
+        else:
+            for _ in range(cancel_after_ticks):
+                eng.step()
+            assert eng.cancel(rid) == "active"
+            fin = eng.step()
+            assert rid in fin
+            assert eng.cancel(rid) == "gone"
+        return len(eng.free)
+
+    assert free_after(1) == free_after(None)
+    assert free_after(3) == free_after(None)
+
+
+def test_cancel_pending_before_admission():
+    cfg = LabformerConfig(d_model=32, n_heads=4, n_layers=2, d_ff=64,
+                          max_seq=64)
+    params = init_params(cfg, seed=0)
+    eng = PagedEngine(params, cfg, slots=1, n_blocks=16, block_size=8,
+                      max_seq=64)
+    r1 = eng.submit((np.arange(3) % 7).astype(np.int32), max_new=8)
+    r2 = eng.submit((np.arange(4) % 7).astype(np.int32), max_new=8)
+    # slot count is 1: r2 queues un-admitted; cancelling it drops it
+    assert eng.cancel(r2) == "pending"
+    done = eng.run()
+    assert r1 in done and r2 not in done
+
+
 def test_engine_refuses_pallas_with_mesh():
     cfg = LabformerConfig(d_model=32, n_heads=4, n_layers=2, d_ff=64,
                           max_seq=64)

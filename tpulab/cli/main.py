@@ -31,6 +31,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     sub.add_parser("generate", help="sample from the flagship model (KV-cache decode)")
     sub.add_parser("daemon", help="start the warm-runtime daemon")
     sub.add_parser("tokenizer", help="train/inspect a BPE tokenizer")
+    sub.add_parser("eval", help="held-out loss/perplexity/bits-per-byte "
+                                "of a checkpoint")
 
     args, extra = parser.parse_known_args(argv)
 
@@ -71,6 +73,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         from tpulab.io.bpe import main as bpe_main
 
         return bpe_main(extra)
+
+    if args.command == "eval":
+        from tpulab.evaluate import main as eval_main
+
+        return eval_main(extra)
 
     parser.print_help()
     return 2

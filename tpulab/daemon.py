@@ -201,7 +201,11 @@ class _GenerateService:
         try:
             while True:
                 with st.cond:
-                    while rid not in st.results and len(req.out) <= sent:
+                    # non-streaming waiters sleep through tick wakeups
+                    # (no per-token copy/lock churn against the stepper)
+                    while rid not in st.results and (
+                        on_progress is None or len(req.out) <= sent
+                    ):
                         st.cond.wait()
                     done = rid in st.results
                     inc = list(req.out[sent:])
@@ -222,15 +226,12 @@ class _GenerateService:
             with st.cond:
                 if rid in st.results:
                     st.results.pop(rid)
-                elif any(r.req_id == rid for r in engine.pending):
-                    # not yet admitted: no blocks held, just drop it
-                    engine.pending = [r for r in engine.pending
-                                      if r.req_id != rid]
-                else:
-                    # active: finish at the next tick (the normal path
-                    # recycles its blocks); the stepper discards the
-                    # output via the cancelled set
-                    req.max_new = max(len(req.out), 1)
+                elif engine.cancel(rid) == "active":
+                    # finishes through the NORMAL path next tick (so
+                    # admission's block count releases exactly); the
+                    # stepper discards the output via the cancelled
+                    # set.  "pending"/"gone" need no discard — nothing
+                    # of theirs will ever reach st.results.
                     st.cancelled.add(rid)
             raise
 

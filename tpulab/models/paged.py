@@ -337,6 +337,7 @@ class _Request:
     repetition_penalty: float = 1.0  # HF convention; 1.0 = off
     stop_byte: int = -1         # finish early after emitting it; -1 = off
     out: List[int] = field(default_factory=list)
+    cancelled: bool = False     # finish at the next tick (client gone)
 
 
 class PagedEngine:
@@ -682,9 +683,12 @@ class PagedEngine:
             self.last_tok[s] = nxt[s]
             self.seen[s, int(nxt[s])] = True
             stopped = req.stop_byte >= 0 and int(nxt[s]) == req.stop_byte
-            if stopped or len(req.out) >= req.max_new:
+            if stopped or req.cancelled or len(req.out) >= req.max_new:
                 # deref what ADMISSION allocated (prompt + max_new),
-                # regardless of how early the request finished
+                # regardless of how early the request finished —
+                # req.max_new is immutable by contract (a cancel flags
+                # the request instead of shrinking it, or this count
+                # would leak blocks)
                 used = self._blocks_needed(len(req.prompt) + req.max_new)
                 for b in self.tables[s, :used]:
                     self._deref(int(b))
@@ -698,6 +702,25 @@ class PagedEngine:
                 self.counters["requests_done"] += 1
                 finished.append(req.req_id)
         return finished
+
+    def cancel(self, req_id: int) -> str:
+        """Abandon a request (its consumer died).  Returns where it was
+        found: "pending" (dropped outright — no blocks were allocated
+        yet), "active" (flagged; the next tick finishes it through the
+        NORMAL path, so admission's block count is released exactly),
+        or "gone" (already finished / unknown).
+
+        Callers synchronize exactly as for submit/step (the daemon's
+        per-engine condition): the engine itself is not thread-safe."""
+        before = len(self.pending)
+        self.pending = [r for r in self.pending if r.req_id != req_id]
+        if len(self.pending) != before:
+            return "pending"
+        for req in self.active:
+            if req is not None and req.req_id == req_id:
+                req.cancelled = True
+                return "active"
+        return "gone"
 
     def stats(self) -> Dict[str, int]:
         """Serving observability: counters plus live pool occupancy."""

@@ -49,16 +49,38 @@ def batches(vocab: int, batch: int, seq: int, seed: int):
     return batch_at
 
 
+def _optimizer_makers():
+    """Optimizer zoo: name -> constructor(schedule).  adamw is the
+    trainer default; lion wants ~3-10x lower LR at ~1/2 the optimizer
+    memory (one moment); adafactor drops the second moment to factored
+    row/col stats — the optimizer-memory floor for big models;
+    sgd+momentum is the classic CNN baseline."""
+    import optax
+
+    return {
+        "adamw": optax.adamw,
+        "lion": optax.lion,
+        "adafactor": lambda s: optax.adafactor(learning_rate=s),
+        "sgd": lambda s: optax.sgd(s, momentum=0.9),
+    }
+
+
+#: argparse choices — derived from the one constructor table so the
+#: help text and build_optimizer can never drift
+_OPTIMIZERS = ("adamw", "lion", "adafactor", "sgd")
+
+
 def build_optimizer(
     lr: float,
     steps: int,
     warmup_steps: int = 0,
     schedule: str = "const",
     clip_norm: float = 0.0,
+    optimizer: str = "adamw",
 ):
     """Standard LLM-trainer optimizer stack: optional global-norm
-    clipping → adamw on a constant or linear-warmup + cosine-decay
-    schedule."""
+    clipping → the chosen optimizer on a constant or linear-warmup +
+    cosine-decay schedule."""
     import optax
 
     if schedule == "cosine":
@@ -74,10 +96,15 @@ def build_optimizer(
         )
     else:
         raise ValueError(f"unknown schedule {schedule!r}")
+    makers = _optimizer_makers()
+    assert tuple(makers) == _OPTIMIZERS  # the choices tuple must track it
+    if optimizer not in makers:
+        raise ValueError(f"unknown optimizer {optimizer!r}; "
+                         f"expected one of {_OPTIMIZERS}")
     chain = []
     if clip_norm:
         chain.append(optax.clip_by_global_norm(clip_norm))
-    chain.append(optax.adamw(sched))
+    chain.append(makers[optimizer](sched))
     return optax.chain(*chain)
 
 
@@ -189,6 +216,7 @@ def train(
     lora_alpha: float = 16.0,
     init_from: Optional[str] = None,
     tokenizer: Optional[str] = None,
+    opt_name: str = "adamw",
 ):
     """Run the loop; returns (final_step, last_loss).
 
@@ -246,13 +274,15 @@ def train(
     # below so worker threads and fds never outlive the loop
     _box = {}
 
-    if optimizer is None and (lr or warmup_steps or schedule != "const" or clip_norm):
+    if optimizer is None and (lr or warmup_steps or schedule != "const"
+                              or clip_norm or opt_name != "adamw"):
         optimizer = build_optimizer(
             lr=lr or (1e-3 if model == "labvision" else 3e-4),
             steps=steps,
             warmup_steps=warmup_steps,
             schedule=schedule,
             clip_norm=clip_norm,
+            optimizer=opt_name,
         )
 
     if model == "labvision":
@@ -611,6 +641,10 @@ def main(argv=None) -> int:
     ap.add_argument("--eval-every", type=int, default=0,
                     help="held-out loss every N steps (0 = off)")
     ap.add_argument("--lr", type=float, default=0.0, help="peak learning rate")
+    ap.add_argument("--optimizer", default="adamw", choices=_OPTIMIZERS,
+                    help="adamw (default) | lion (1 moment, ~3-10x lower "
+                         "lr) | adafactor (factored stats — the "
+                         "optimizer-memory floor) | sgd (momentum 0.9)")
     ap.add_argument("--warmup-steps", type=int, default=0)
     ap.add_argument("--schedule", default="const", choices=("const", "cosine"))
     ap.add_argument("--clip-norm", type=float, default=0.0,
@@ -680,6 +714,7 @@ def main(argv=None) -> int:
         lora_alpha=args.lora_alpha,
         init_from=args.init_from,
         tokenizer=args.tokenizer,
+        opt_name=args.optimizer,
     )
     print(json.dumps({"final_step": step, "loss": loss}))
     return 0

@@ -73,6 +73,32 @@ def test_eval_bpe_sidecar_and_refusal(tmp_path):
         evaluate(ck, None, batches=1)
 
 
+def test_eval_synthetic_matches_trainers_stream(tmp_path):
+    """No --data-dir: eval must score the trainer's own structured
+    synthetic stream (at the disjoint eval seed), not uniform noise —
+    a synthetically-trained checkpoint must beat the ln(vocab) ceiling."""
+    ck = str(tmp_path / "ck")
+    train(steps=60, batch=4, seq=32, ckpt_dir=ck, save_every=60,
+          log=lambda *a: None)
+    rep = evaluate(ck, None, batches=2, batch=4, seq=32)
+    assert rep["data"] == "synthetic"
+    # 60 steps reach ~5.40 on the structured stream (uniform-noise eval
+    # pinned ~5.63, ABOVE the ln(256)=5.545 ceiling — the old bug)
+    assert rep["loss_nats_per_token"] < np.log(256) - 0.1, rep
+
+
+def test_eval_reports_corpus_truncation(tmp_path):
+    data = _corpus(tmp_path)
+    ck = str(tmp_path / "ck")
+    train(steps=4, batch=2, seq=32, data_dir=data, ckpt_dir=ck,
+          save_every=2, log=lambda *a: None)
+    rep = evaluate(ck, data, batches=1, batch=2, seq=32, limit_bytes=4096)
+    assert rep["corpus_bytes"] == 4096
+    assert rep["corpus_truncated_at_limit"] is True
+    rep2 = evaluate(ck, data, batches=1, batch=2, seq=32)
+    assert rep2["corpus_truncated_at_limit"] is False
+
+
 def test_eval_cli(tmp_path, capsys):
     from tpulab.evaluate import main as eval_main
 

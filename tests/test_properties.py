@@ -183,3 +183,35 @@ class TestPagedEngineInvariants:
         cached = sum(len(b) for b in eng.prefix_cache.values())
         assert len(eng.free) == eng.n_usable_blocks - cached
         assert int(eng.block_refs.sum()) == cached
+
+
+class TestBPERoundTrip:
+    """BPE is byte-faithful by construction (ids 0..255 stay raw
+    bytes): encode∘decode must be the identity for ANY corpus and ANY
+    input, trained-on or not."""
+
+    @settings(max_examples=MAX_EXAMPLES, deadline=None)
+    @given(
+        corpus=st.binary(min_size=4, max_size=2000),
+        data=st.binary(min_size=0, max_size=500),
+        vocab=st.integers(min_value=256, max_value=320),
+    )
+    def test_roundtrip_identity(self, corpus, data, vocab):
+        from tpulab.io.bpe import train_bpe
+
+        tok = train_bpe(corpus, vocab)
+        assert tok.decode(tok.encode(data)) == data
+        # and the corpus itself round-trips through its own table
+        assert tok.decode(tok.encode(corpus)) == corpus
+
+    @settings(max_examples=MAX_EXAMPLES, deadline=None)
+    @given(corpus=st.binary(min_size=16, max_size=2000))
+    def test_merges_are_well_formed(self, corpus):
+        """Every merged id expands to <= max_token_bytes bytes and
+        references only earlier ids (the table is a DAG by rank)."""
+        from tpulab.io.bpe import train_bpe
+
+        tok = train_bpe(corpus, 320, max_token_bytes=8)
+        for i, (a, b) in enumerate(tok.merges):
+            assert a < 256 + i and b < 256 + i
+            assert len(tok.decode([256 + i])) <= 8

@@ -26,7 +26,7 @@ import numpy as np
 
 def evaluate(ckpt_dir: str, data_dir: Optional[str] = None, *,
              batches: int = 8, batch: int = 8, seq: int = 128,
-             seed: int = 0) -> dict:
+             seed: int = 0, limit_bytes: int = 1 << 24) -> dict:
     import jax
 
     from tpulab.models.generate import demo_config, load_params, load_sidecar
@@ -39,17 +39,20 @@ def evaluate(ckpt_dir: str, data_dir: Optional[str] = None, *,
     if cfg.lora_rank:
         params, cfg = merge_lora(params, cfg)
 
+    corpus_bytes = truncated = None
     if data_dir:
         from tpulab.io.bpe import corpus_from_dir
 
-        corpus = corpus_from_dir(data_dir)
+        corpus = corpus_from_dir(data_dir, limit_bytes)
+        corpus_bytes, truncated = len(corpus), len(corpus) >= limit_bytes
         ids = (tok.encode(corpus) if tok is not None
                else np.frombuffer(corpus, np.uint8).astype(np.int32))
         if len(ids) < seq + 1:
             raise ValueError(
                 f"corpus encodes to {len(ids)} tokens; need >= {seq + 1}")
 
-        def window_at(rng):
+        def window_at(j):
+            rng = np.random.default_rng((seed << 24) ^ (7919 * (j + 1)))
             starts = rng.integers(0, len(ids) - seq, batch)
             return np.stack([ids[s:s + seq + 1] for s in starts])
     else:
@@ -57,18 +60,20 @@ def evaluate(ckpt_dir: str, data_dir: Optional[str] = None, *,
             raise ValueError(
                 "a BPE checkpoint needs --data-dir (the synthetic "
                 "stream is byte-space noise, meaningless in its vocab)")
+        # THE stream the trainer's --eval-every reports on: train's own
+        # structured synthetic generator at its disjoint eval seed —
+        # uniform random tokens would pin the loss at ~ln(vocab) no
+        # matter how well the model trained
+        from tpulab.train import batches as _mk_stream
 
-        def window_at(rng):
-            return rng.integers(0, cfg.vocab, (batch, seq + 1)).astype(
-                np.int32)
+        window_at = _mk_stream(cfg.vocab, batch, seq, seed + 104729)
 
     eval_fn = jax.jit(loss_fn, static_argnums=(2, 3))
     total_nats = 0.0
     total_tokens = 0
     total_bytes = 0
     for j in range(batches):
-        rng = np.random.default_rng((seed << 24) ^ (7919 * (j + 1)))
-        win = window_at(rng)
+        win = window_at(j)
         loss = float(eval_fn(params, win, cfg, None))  # nats per token
         n_pred = win.shape[0] * (win.shape[1] - 1)
         total_nats += loss * n_pred
@@ -84,7 +89,7 @@ def evaluate(ckpt_dir: str, data_dir: Optional[str] = None, *,
             )
 
     mean_loss = total_nats / total_tokens
-    return {
+    report = {
         "ckpt_dir": ckpt_dir,
         "step": step,
         "data": data_dir or "synthetic",
@@ -95,6 +100,11 @@ def evaluate(ckpt_dir: str, data_dir: Optional[str] = None, *,
         "perplexity": round(float(np.exp(mean_loss)), 3),
         "bits_per_byte": round(total_nats / np.log(2.0) / total_bytes, 4),
     }
+    if corpus_bytes is not None:
+        report["corpus_bytes"] = corpus_bytes
+        # honest accounting: a capped read must be visible in the report
+        report["corpus_truncated_at_limit"] = bool(truncated)
+    return report
 
 
 def main(argv=None) -> int:
@@ -107,11 +117,14 @@ def main(argv=None) -> int:
     ap.add_argument("--batch", type=int, default=8)
     ap.add_argument("--seq", type=int, default=128)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--limit-bytes", type=int, default=1 << 24,
+                    help="corpus read cap; the report flags truncation")
     args = ap.parse_args(argv)
     try:
         report = evaluate(args.ckpt_dir, args.data_dir,
                           batches=args.batches, batch=args.batch,
-                          seq=args.seq, seed=args.seed)
+                          seq=args.seq, seed=args.seed,
+                          limit_bytes=args.limit_bytes)
     except (FileNotFoundError, ValueError) as e:
         raise SystemExit(str(e))
     print(json.dumps(report))

@@ -49,25 +49,31 @@ def batches(vocab: int, batch: int, seq: int, seed: int):
     return batch_at
 
 
+#: optimizer zoo (argparse choices AND the constructor-table keys —
+#: _optimizer_makers enforces the match with a real error, not an
+#: assert, so drift surfaces even under ``python -O``).  adamw is the
+#: trainer default; lion wants ~3-10x lower LR at ~1/2 the optimizer
+#: memory (one moment); adafactor drops the second moment to factored
+#: row/col stats — the optimizer-memory floor for big models;
+#: sgd+momentum is the classic CNN baseline.
+_OPTIMIZERS = ("adamw", "lion", "adafactor", "sgd")
+
+
 def _optimizer_makers():
-    """Optimizer zoo: name -> constructor(schedule).  adamw is the
-    trainer default; lion wants ~3-10x lower LR at ~1/2 the optimizer
-    memory (one moment); adafactor drops the second moment to factored
-    row/col stats — the optimizer-memory floor for big models;
-    sgd+momentum is the classic CNN baseline."""
+    """name -> constructor(schedule); keys must equal _OPTIMIZERS."""
     import optax
 
-    return {
+    makers = {
         "adamw": optax.adamw,
         "lion": optax.lion,
         "adafactor": lambda s: optax.adafactor(learning_rate=s),
         "sgd": lambda s: optax.sgd(s, momentum=0.9),
     }
-
-
-#: argparse choices — derived from the one constructor table so the
-#: help text and build_optimizer can never drift
-_OPTIMIZERS = ("adamw", "lion", "adafactor", "sgd")
+    if tuple(makers) != _OPTIMIZERS:
+        raise RuntimeError(
+            f"optimizer tables drifted: makers={tuple(makers)} vs "
+            f"_OPTIMIZERS={_OPTIMIZERS} — update both together")
+    return makers
 
 
 def build_optimizer(
@@ -97,7 +103,6 @@ def build_optimizer(
     else:
         raise ValueError(f"unknown schedule {schedule!r}")
     makers = _optimizer_makers()
-    assert tuple(makers) == _OPTIMIZERS  # the choices tuple must track it
     if optimizer not in makers:
         raise ValueError(f"unknown optimizer {optimizer!r}; "
                          f"expected one of {_OPTIMIZERS}")

@@ -378,6 +378,30 @@ class TestDaemonGenerate:
             daemon, b'{"lab": "generate", "config": {"steps": 6}}', b"hello")
         assert st2 == 0 and plain == final
 
+    def test_engine_knobs_over_wire(self, daemon):
+        """{"attn": "pallas"} and {"kv_dtype": "int8"} build distinct
+        cached engines; pallas serves the gather path's exact bytes
+        (interpret mode on the CPU daemon) and typos refuse loudly."""
+        base = _raw_request_bytes(
+            daemon, b'{"lab": "generate", "config": {"steps": 5}}', b"knob")
+        pallas = _raw_request_bytes(
+            daemon,
+            b'{"lab": "generate", "config": {"steps": 5, "attn": "pallas"}}',
+            b"knob")
+        int8 = _raw_request_bytes(
+            daemon,
+            b'{"lab": "generate", "config": {"steps": 5, '
+            b'"kv_dtype": "int8"}}',
+            b"knob")
+        assert base[0] == 0 and pallas[0] == 0 and int8[0] == 0
+        assert pallas[1] == base[1]  # same math, kernel vs gather
+        assert len(int8[1]) == 5
+        status, err = _raw_request(
+            daemon,
+            b'{"lab": "generate", "config": {"steps": 2, "attn": "wat"}}',
+            b"x")
+        assert status == 1 and "attn=" in err
+
     def test_aborted_stream_leaves_daemon_healthy(self, daemon):
         """A streaming client that disconnects mid-generation must not
         wedge or leak the daemon: the abandoned request is cancelled

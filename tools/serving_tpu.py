@@ -147,16 +147,19 @@ def main(argv=None) -> int:
         "speedup_vs_cold": round(t_cold / t_warm, 3),
     })
 
-    # --- paged-attention kernel vs the XLA gather path, same 8-way
-    # batch at a long context (where the gather's materialized KV copy
-    # costs the most HBM traffic)
+    # --- paged-attention kernel vs the XLA gather path vs int8 KV,
+    # same 8-way batch at a long context (where the gather's
+    # materialized KV copy costs the most HBM traffic and the int8
+    # pools halve the read bytes)
     long_ctx = [(rng.integers(0, cfg.vocab, (256,)).astype(np.int32),
                  args.steps) for _ in range(8)]
-    for attn in ("gather", "pallas"):
-        t, toks, _ = _run_jobs(params, cfg, dict(eng_kw, attn=attn),
+    for tag, extra in (("gather", dict(attn="gather")),
+                       ("pallas", dict(attn="pallas")),
+                       ("int8kv", dict(kv_dtype="int8"))):
+        t, toks, _ = _run_jobs(params, cfg, dict(eng_kw, **extra),
                                long_ctx, reps=args.reps)
         scenarios.append({
-            "scenario": f"decode_batch8_ctx256_{attn}",
+            "scenario": f"decode_batch8_ctx256_{tag}",
             "tokens": toks, "wall_s": round(t, 4),
             "tokens_per_s": round(toks / t, 1),
         })

@@ -123,7 +123,8 @@ class _StreamBroken(ConnectionError):
     must close without a terminal frame."""
 
 
-_ENGINES: "dict" = {}  # realpath|None -> (loaded_step, engine, tok); LRU, max 2
+#: (realpath|None, attn, kv_dtype) -> (loaded_step, engine, tok); LRU, max 2
+_ENGINES: "dict" = {}
 
 
 class _EngineState:
@@ -296,12 +297,14 @@ def _ckpt_stamp(ckpt_dir: str):
     return max(steps) if steps else None
 
 
-def _engine_for(ckpt):
+def _engine_for(ckpt, attn: str = "gather", kv_dtype: str = "native"):
     """Warm (engine, tokenizer|None) for the demo model or a trainer
     snapshot, with the cache problems a naive dict would have handled:
-    keys are realpaths (``ckpts`` and ``./ckpts`` alias), a newer
-    checkpoint step evicts the stale engine, and at most 2 engines stay
-    resident (LRU).
+    keys are (realpath, attn, kv_dtype) — ``ckpts`` and ``./ckpts``
+    alias, and engines built with different serving knobs (paged
+    kernel, int8 KV) never collide — a newer checkpoint step evicts
+    the stale engine, and at most 4 engines stay resident (LRU; room
+    for one checkpoint's knob variants plus a second checkpoint).
 
     A checkpoint's config sidecar (tpulab_config.json, written by
     tpulab.train) is honored: the trained dims/vocab replace the demo
@@ -316,8 +319,9 @@ def _engine_for(ckpt):
     from tpulab.models.generate import demo_config, load_params
     from tpulab.models.paged import PagedEngine
 
-    key = os.path.realpath(ckpt) if ckpt else None
-    stamp = _ckpt_stamp(key) if key else None
+    path = os.path.realpath(ckpt) if ckpt else None
+    key = (path, attn, kv_dtype)
+    stamp = _ckpt_stamp(path) if path else None
     with _GEN_SERVICE.lock:
         hit = _ENGINES.get(key)
         if hit is not None and hit[0] == stamp:
@@ -325,16 +329,17 @@ def _engine_for(ckpt):
             return hit[1], hit[2]
     from tpulab.models.generate import load_sidecar
 
-    cfg, tok = load_sidecar(key)
+    cfg, tok = load_sidecar(path)
     if cfg is None:
         cfg = demo_config()
-    params, _ = load_params(cfg, key)
+    params, _ = load_params(cfg, path)
     if cfg.lora_rank:
         from tpulab.models.labformer import merge_lora
 
         params, cfg = merge_lora(params, cfg)
     engine = PagedEngine(
-        params, cfg, slots=4, n_blocks=128, block_size=16, max_seq=512
+        params, cfg, slots=4, n_blocks=128, block_size=16, max_seq=512,
+        attn=attn, kv_dtype=kv_dtype,
     )
     with _GEN_SERVICE.lock:
         hit = _ENGINES.get(key)
@@ -342,7 +347,10 @@ def _engine_for(ckpt):
             return hit[1], hit[2]  # concurrent build won; use theirs
         _ENGINES.pop(key, None)
         _ENGINES[key] = (stamp, engine, tok)
-        while len(_ENGINES) > 2:
+        # 4 residents: the key now includes serving knobs, so one
+        # checkpoint's (native, int8, pallas) variants plus a second
+        # checkpoint fit without cold-rebuild thrash
+        while len(_ENGINES) > 4:
             _ENGINES.pop(next(iter(_ENGINES)))
     return engine, tok
 
@@ -375,7 +383,16 @@ def _handle_generate(header: dict, payload: bytes,
         # engine build/generation is paid (the BPE decode path would
         # otherwise crash at bytes([stop_byte]) after full compute)
         raise ValueError(f"stop_byte must be in [-1, 255], got {stop_byte}")
-    engine, tok = _engine_for(config.get("ckpt_dir"))
+    # serving knobs (PagedEngine validates values; this surfaces typos
+    # before a cold engine build is paid)
+    attn = str(config.get("attn", "gather"))
+    kv_dtype = str(config.get("kv_dtype", "native"))
+    if attn not in ("gather", "pallas"):
+        raise ValueError(f"attn={attn!r}; expected 'gather' or 'pallas'")
+    if kv_dtype not in ("native", "int8"):
+        raise ValueError(
+            f"kv_dtype={kv_dtype!r}; expected 'native' or 'int8'")
+    engine, tok = _engine_for(config.get("ckpt_dir"), attn, kv_dtype)
     if tok is None:
         prompt = np.frombuffer(payload, np.uint8).astype(np.int32)
         eng_stop = stop_byte
@@ -434,8 +451,10 @@ def _handle_generate_stats(header: dict) -> bytes:
     """Engine observability over the wire: PagedEngine.stats() JSON for
     the requested ckpt_dir's engine (empty object if none is warm)."""
     config = header.get("config") or {}
-    key = config.get("ckpt_dir")
-    key = os.path.realpath(key) if key else None
+    path = config.get("ckpt_dir")
+    key = (os.path.realpath(path) if path else None,
+           str(config.get("attn", "gather")),
+           str(config.get("kv_dtype", "native")))
     with _GEN_SERVICE.lock:  # registry lookup only — short-held
         hit = _ENGINES.get(key)
     # stats() reads flat counters/lengths; calling it OUTSIDE any lock

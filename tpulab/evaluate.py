@@ -43,8 +43,12 @@ def evaluate(ckpt_dir: str, data_dir: Optional[str] = None, *,
     if data_dir:
         from tpulab.io.bpe import corpus_from_dir
 
-        corpus = corpus_from_dir(data_dir, limit_bytes)
-        corpus_bytes, truncated = len(corpus), len(corpus) >= limit_bytes
+        # read ONE extra byte so "exactly at the limit" is
+        # distinguishable from "capped" (no false truncation flag)
+        corpus = corpus_from_dir(data_dir, limit_bytes + 1)
+        truncated = len(corpus) > limit_bytes
+        corpus = corpus[:limit_bytes]
+        corpus_bytes = len(corpus)
         ids = (tok.encode(corpus) if tok is not None
                else np.frombuffer(corpus, np.uint8).astype(np.int32))
         if len(ids) < seq + 1:

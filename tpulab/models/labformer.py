@@ -85,6 +85,10 @@ class LabformerConfig:
     # (tpulab.parallel.moe) — requires a mesh with dp/sp axes
     moe_impl: str = "dense"
     moe_capacity_factor: float = 2.0
+    # experts per token: 1 = switch (raw argmax gate), 2+ = GShard-style
+    # (selected gates renormalize to a convex combination; dispatch
+    # capacity scales by k)
+    moe_top_k: int = 1
     # switch-transformer router load-balancing loss weight (Fedus et al.
     # 2021 eq. 4: E * sum_e fraction_e * mean_prob_e, averaged over
     # layers).  Without it top-1 routing collapses onto one expert under
@@ -120,6 +124,9 @@ class LabformerConfig:
             raise ValueError(f"attn_window must be >= 0, got {self.attn_window}")
         if self.lora_rank < 0:
             raise ValueError(f"lora_rank must be >= 0, got {self.lora_rank}")
+        if self.n_experts and not 1 <= self.moe_top_k <= self.n_experts:
+            raise ValueError(
+                f"moe_top_k={self.moe_top_k} outside [1, {self.n_experts}]")
 
     @property
     def head_dim(self) -> int:
@@ -474,12 +481,16 @@ def _mlp(x, layer, cfg: LabformerConfig, mesh: Optional[Mesh] = None):
         axes = tuple(a for a in ("dp", "sp") if a in mesh.axis_names)
         if not axes:
             raise ValueError("dispatch MoE needs dp and/or sp mesh axes")
+        from tpulab.parallel.moe import dispatch_capacity
+
         b, s, d = x.shape
         p = math.prod(mesh.shape[a] for a in axes)
         n_local = (b * s) // p
-        capacity = max(1, -(-int(cfg.moe_capacity_factor * n_local) // cfg.n_experts))
+        capacity = dispatch_capacity(cfg.moe_capacity_factor, cfg.moe_top_k,
+                                     n_local, cfg.n_experts)
         body = functools.partial(
-            _moe_body, axis=axes, n_experts=cfg.n_experts, capacity=capacity
+            _moe_body, axis=axes, n_experts=cfg.n_experts, capacity=capacity,
+            k=cfg.moe_top_k,
         )
         flat = x.reshape(b * s, d)
         y = jax.shard_map(
@@ -490,15 +501,25 @@ def _mlp(x, layer, cfg: LabformerConfig, mesh: Optional[Mesh] = None):
         )(flat, layer["router"], layer["w1"], layer["w2"])
         return y.reshape(b, s, d), aux
     if cfg.n_experts:
-        # exact top-1 switch: dense expert compute, one-hot gate select
-        # (gate/top reused from the aux computation above)
-        onehot = jax.nn.one_hot(top, cfg.n_experts, dtype=x.dtype)
-        weight = jnp.sum(gate.astype(x.dtype) * onehot, axis=-1)  # (b, s)
+        # exact top-k: dense expert compute, gate-weighted combine.
+        # _route (parallel/moe) is the ONE gating rule — k == 1 keeps
+        # switch semantics (raw argmax mass), k > 1 renormalizes the
+        # selected gates (GShard convex combination) — so the dense
+        # oracle and the dispatch path can never diverge on convention
+        from tpulab.parallel.moe import _route
+
+        kk = cfg.moe_top_k
+        b_, s_, _ = x.shape
+        eid, gval = _route(gate.reshape(b_ * s_, -1), kk, x.dtype)
+        weights = (
+            jnp.zeros((b_ * s_, cfg.n_experts), x.dtype)
+            .at[jnp.repeat(jnp.arange(b_ * s_), kk), eid].add(gval)
+            .reshape(b_, s_, cfg.n_experts)
+        )                                                # (b, s, E)
         hidden = jnp.einsum("bsd,edf->bsef", x, layer["w1"])
         hidden = jax.nn.gelu(hidden)
         out = jnp.einsum("bsef,efd->bsed", hidden, layer["w2"])
-        out = jnp.einsum("bsed,bse->bsd", out, onehot)
-        return out * weight[..., None], aux
+        return jnp.einsum("bsed,bse->bsd", out, weights), aux
     from tpulab.models.quant import qmat
 
     # qmat == plain matmul for arrays; int8 QTensor weights (decode

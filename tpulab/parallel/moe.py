@@ -41,12 +41,37 @@ from tpulab.runtime.device import commit
 AxisName = Union[str, Tuple[str, ...]]
 
 
+def dispatch_capacity(capacity_factor: float, k: int, n_local: int,
+                      n_experts: int) -> int:
+    """THE per-expert, per-source bucket rule shared by every dispatch
+    caller: ``ceil(cf * k * n_local / E)``, floor 1.  (Two sites once
+    rounded differently — int-truncate-then-ceil-div vs np.ceil — and
+    could disagree for the same inputs.)"""
+    return max(1, int(np.ceil(capacity_factor * k * n_local / n_experts)))
+
+
+def _route(gate, k: int, dtype):
+    """(eids (n*k,), scales (n*k,)) — flattened token-major routing.
+
+    ``k == 1`` keeps switch semantics (raw softmax mass of the argmax);
+    ``k > 1`` is GShard-style: the selected gates renormalize over the
+    chosen experts, so the k contributions form a convex combination.
+    """
+    top_vals, top_ids = jax.lax.top_k(gate, k)                    # (n, k)
+    if k > 1:
+        top_vals = top_vals / jnp.sum(top_vals, axis=-1, keepdims=True)
+    return (top_ids.reshape(-1).astype(jnp.int32),
+            top_vals.reshape(-1).astype(dtype))
+
+
 def _moe_body(x, router_w, w1_loc, w2_loc, *, axis: AxisName, n_experts: int,
-              capacity: int):
-    """Per-device switch-MoE over local tokens (runs in shard_map).
+              capacity: int, k: int = 1):
+    """Per-device top-k MoE over local tokens (runs in shard_map).
 
     x: (n, d) local tokens; router_w: (d, E) replicated;
     w1_loc/w2_loc: (E_loc, d, ff)/(E_loc, ff, d) this device's experts.
+    ``k > 1`` dispatches each token to its top-k experts (k rows in the
+    send buffer, same slot machinery) and sums the k returns.
     """
     n, d = x.shape
     p = jax.lax.axis_size(axis)
@@ -55,18 +80,19 @@ def _moe_body(x, router_w, w1_loc, w2_loc, *, axis: AxisName, n_experts: int,
 
     gate_logits = x @ router_w                                    # (n, E)
     gate = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
-    eid = jnp.argmax(gate, axis=-1).astype(jnp.int32)             # (n,)
-    gval = jnp.max(gate, axis=-1).astype(x.dtype)                 # (n,)
+    eid, gval = _route(gate, k, x.dtype)                          # (n*k,)
+    # token-major duplication matches _route's reshape(-1) ordering
+    xk = jnp.repeat(x, k, axis=0) if k > 1 else x                 # (n*k, d)
 
-    eoh = jax.nn.one_hot(eid, n_experts, dtype=jnp.int32)         # (n, E)
+    eoh = jax.nn.one_hot(eid, n_experts, dtype=jnp.int32)         # (n*k, E)
     # slot within the expert's bucket: running count of earlier tokens
     # routed to the same expert
-    pos = jnp.sum(jnp.cumsum(eoh, axis=0) * eoh, axis=-1) - 1     # (n,)
+    pos = jnp.sum(jnp.cumsum(eoh, axis=0) * eoh, axis=-1) - 1     # (n*k,)
     keep = pos < c
     slot = jnp.clip(pos, 0, c - 1)
 
     send = jnp.zeros((n_experts, c, d), x.dtype)
-    contrib = jnp.where(keep[:, None], x, jnp.zeros_like(x))
+    contrib = jnp.where(keep[:, None], xk, jnp.zeros_like(xk))
     send = send.at[eid, slot].add(contrib)                        # dropped -> +0
     send = send.reshape(p, e_loc, c, d)
     recv = jax.lax.all_to_all(send, axis, split_axis=0, concat_axis=0, tiled=True)
@@ -79,29 +105,33 @@ def _moe_body(x, router_w, w1_loc, w2_loc, *, axis: AxisName, n_experts: int,
     ret = jax.lax.all_to_all(back, axis, split_axis=0, concat_axis=0, tiled=True)
     ret = ret.reshape(n_experts, c, d)
 
-    y = ret[eid, slot]                                            # (n, d)
+    y = ret[eid, slot]                                            # (n*k, d)
     scale = jnp.where(keep, gval, jnp.zeros_like(gval))
-    return y * scale[:, None]
+    y = y * scale[:, None]
+    return y.reshape(n, k, d).sum(axis=1) if k > 1 else y
 
 
-def switch_moe_reference(x, router_w, w1, w2):
-    """Dense-gate oracle: compute every expert, one-hot select (the
-    labformer in-model formulation; exact, E-fold compute)."""
+def switch_moe_reference(x, router_w, w1, w2, k: int = 1):
+    """Dense-gate oracle: compute every expert, top-k weighted combine
+    (the labformer in-model formulation; exact, E-fold compute)."""
     gate = jax.nn.softmax((x @ router_w).astype(jnp.float32), axis=-1)
-    eid = jnp.argmax(gate, axis=-1)
-    onehot = jax.nn.one_hot(eid, w1.shape[0], dtype=x.dtype)
-    gval = jnp.max(gate, axis=-1).astype(x.dtype)
+    n_experts = w1.shape[0]
+    eid, gval = _route(gate, k, x.dtype)                          # (n*k,)
     hid = jax.nn.gelu(jnp.einsum("nd,edf->nef", x, w1))
-    out = jnp.einsum("nef,efd->ned", hid, w2)
-    return jnp.einsum("ned,ne->nd", out, onehot) * gval[:, None]
+    out = jnp.einsum("nef,efd->ned", hid, w2)                     # (n, E, d)
+    weights = (jnp.zeros((x.shape[0], n_experts), x.dtype)
+               .at[jnp.repeat(jnp.arange(x.shape[0]), k), eid]
+               .add(gval))
+    return jnp.einsum("ned,ne->nd", out, weights)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("mesh", "axis", "n_experts", "capacity")
+    jax.jit, static_argnames=("mesh", "axis", "n_experts", "capacity", "k")
 )
-def _switch_moe_sharded(x, router_w, w1, w2, *, mesh, axis, n_experts, capacity):
+def _switch_moe_sharded(x, router_w, w1, w2, *, mesh, axis, n_experts,
+                        capacity, k=1):
     body = functools.partial(
-        _moe_body, axis=axis, n_experts=n_experts, capacity=capacity
+        _moe_body, axis=axis, n_experts=n_experts, capacity=capacity, k=k
     )
     axes = axis if isinstance(axis, tuple) else (axis,)
     return jax.shard_map(
@@ -121,15 +151,21 @@ def switch_moe(
     mesh: Optional[Mesh] = None,
     axis: AxisName = "ep",
     capacity_factor: float = 1.25,
+    k: int = 1,
 ) -> jax.Array:
-    """Top-1 switch MoE with expert parallelism over ``mesh[axis]``.
+    """Top-k MoE with expert parallelism over ``mesh[axis]``.
 
     ``tokens``: (N, d) sharded over the (possibly fused) axis;
     ``w1``/(E, d, ff), ``w2``/(E, ff, d) sharded over experts;
     ``router_w``/(d, E) replicated.  N and E must divide the axis size.
     ``capacity_factor`` scales the per-expert, per-source bucket
-    (``C = ceil(cf * n_local / E)``); overflow tokens output zero.
+    (``C = ceil(cf * k * n_local / E)`` — top-k multiplies demand);
+    overflow tokens output zero.  ``k == 1`` is the switch formulation
+    (raw argmax gate); ``k > 1`` renormalizes the selected gates
+    (GShard-style convex combination).
     """
+    if not 1 <= k <= w1.shape[0]:
+        raise ValueError(f"k={k} outside [1, {w1.shape[0]} experts]")
     mesh = mesh or make_mesh(axes=(axis,) if isinstance(axis, str) else axis)
     axes = axis if isinstance(axis, tuple) else (axis,)
     p = int(np.prod([mesh.shape[a] for a in axes]))
@@ -139,7 +175,7 @@ def switch_moe(
     if tokens.shape[0] % p:
         raise ValueError(f"{tokens.shape[0]} tokens not divisible by axis size {p}")
     n_local = tokens.shape[0] // p
-    capacity = max(1, int(np.ceil(capacity_factor * n_local / n_experts)))
+    capacity = dispatch_capacity(capacity_factor, k, n_local, n_experts)
 
     anchor = mesh_anchor(mesh)
     x = jax.device_put(commit(tokens, anchor), NamedSharding(mesh, P(axes, None)))
@@ -147,5 +183,6 @@ def switch_moe(
     w1 = jax.device_put(commit(w1, anchor), NamedSharding(mesh, P(axes, None, None)))
     w2 = jax.device_put(commit(w2, anchor), NamedSharding(mesh, P(axes, None, None)))
     return _switch_moe_sharded(
-        x, rw, w1, w2, mesh=mesh, axis=axis, n_experts=n_experts, capacity=capacity
+        x, rw, w1, w2, mesh=mesh, axis=axis, n_experts=n_experts,
+        capacity=capacity, k=k,
     )

@@ -205,6 +205,7 @@ def train(
     experts: int = 0,
     moe_impl: str = "dense",
     moe_aux_weight: float = 0.01,
+    moe_top_k: int = 1,
     model: str = "labformer",
     eval_every: int = 0,
     eval_batches: int = 4,
@@ -365,6 +366,7 @@ def train(
             n_experts=experts,
             moe_impl=moe_impl,
             moe_aux_weight=moe_aux_weight,
+            moe_top_k=moe_top_k,
             lora_rank=lora_rank,
             lora_alpha=lora_alpha,
         )
@@ -640,6 +642,11 @@ def main(argv=None) -> int:
         help="switch-transformer router load-balancing loss weight",
     )
     ap.add_argument(
+        "--moe-top-k", type=int, default=1,
+        help="experts per token: 1 = switch, 2+ = GShard-style "
+             "renormalized combination (dispatch capacity scales by k)",
+    )
+    ap.add_argument(
         "--model", default="labformer", choices=("labformer", "labvision"),
         help="model family: byte LM or the lab3-task CNN",
     )
@@ -710,6 +717,7 @@ def main(argv=None) -> int:
         experts=args.experts,
         moe_impl=args.moe_impl,
         moe_aux_weight=args.moe_aux_weight,
+        moe_top_k=args.moe_top_k,
         zero1=args.zero1,
         zero2=args.zero2,
         data_dir=args.data_dir,

@@ -302,3 +302,43 @@ class TestElasticRecovery:
     def test_recover_requires_ckpt_dir(self):
         with pytest.raises(ValueError, match="recover"):
             train(steps=2, batch=2, seq=32, cfg=TINY, recover=1, log=_quiet)
+
+
+class TestRematPolicy:
+    def test_dots_policy_matches_full_remat_loss(self):
+        """remat_policy only changes WHAT the backward recomputes, never
+        the math: losses agree bitwise-ish across none/dots/no-remat."""
+        import dataclasses
+
+        import jax.numpy as jnp
+
+        from tpulab.models.labformer import LabformerConfig, init_train_state
+
+        base = LabformerConfig(d_model=32, n_heads=4, n_layers=2, d_ff=64,
+                               max_seq=64)
+        toks = np.tile(np.arange(33, dtype=np.int32) % 7, (2, 1))
+        losses = {}
+        for name, kw in (("plain", {}),
+                         ("remat", dict(remat=True)),
+                         ("dots", dict(remat=True, remat_policy="dots"))):
+            cfg = dataclasses.replace(base, **kw)
+            p, o, step = init_train_state(cfg, mesh=None, seed=0)
+            for _ in range(3):
+                p, o, loss = step(p, o, jnp.asarray(toks))
+            losses[name] = float(loss)
+        assert np.isclose(losses["plain"], losses["remat"], atol=1e-5)
+        assert np.isclose(losses["plain"], losses["dots"], atol=1e-5)
+
+    def test_policy_validated(self):
+        import pytest as _pytest
+
+        from tpulab.models.labformer import LabformerConfig
+
+        with _pytest.raises(ValueError, match="remat_policy"):
+            LabformerConfig(d_model=32, n_heads=4, n_layers=2, d_ff=64,
+                            max_seq=64, remat=True,
+                            remat_policy="everything")
+        # a policy without remat would silently do nothing: refused
+        with _pytest.raises(ValueError, match="requires remat"):
+            LabformerConfig(d_model=32, n_heads=4, n_layers=2, d_ff=64,
+                            max_seq=64, remat_policy="dots")

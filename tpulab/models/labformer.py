@@ -79,6 +79,11 @@ class LabformerConfig:
     # ~30% more FLOPs for activation memory that no longer scales with
     # n_layers — the HBM-vs-FLOPs lever for long-context training
     remat: bool = False
+    # what remat saves: "none" recomputes everything (max memory win);
+    # "dots" saves matmul outputs (jax dots_with_no_batch_dims_saveable
+    # — the usual TPU sweet spot: elementwise/norm/softmax recompute on
+    # the VPU while the expensive MXU results are kept)
+    remat_policy: str = "none"
     # MoE execution: "dense" computes every expert and one-hot selects
     # (exact, E-fold FLOPs); "dispatch" routes tokens to their expert's
     # owner with all_to_all over the fused (dp, sp) ep submesh
@@ -111,6 +116,7 @@ class LabformerConfig:
             "attn_impl": ("auto", "flash", "dense"),
             "sp_impl": ("ring", "ulysses", "zigzag"),
             "moe_impl": ("dense", "dispatch"),
+            "remat_policy": ("none", "dots"),
         }
         for field, allowed in checks.items():
             if getattr(self, field) not in allowed:
@@ -127,6 +133,11 @@ class LabformerConfig:
         if self.n_experts and not 1 <= self.moe_top_k <= self.n_experts:
             raise ValueError(
                 f"moe_top_k={self.moe_top_k} outside [1, {self.n_experts}]")
+        if self.remat_policy != "none" and not self.remat:
+            # a policy without remat would silently do nothing — the
+            # user asked for checkpointing semantics, so demand the flag
+            raise ValueError(
+                f"remat_policy={self.remat_policy!r} requires remat=True")
 
     @property
     def head_dim(self) -> int:
@@ -502,20 +513,16 @@ def _mlp(x, layer, cfg: LabformerConfig, mesh: Optional[Mesh] = None):
         return y.reshape(b, s, d), aux
     if cfg.n_experts:
         # exact top-k: dense expert compute, gate-weighted combine.
-        # _route (parallel/moe) is the ONE gating rule — k == 1 keeps
-        # switch semantics (raw argmax mass), k > 1 renormalizes the
-        # selected gates (GShard convex combination) — so the dense
-        # oracle and the dispatch path can never diverge on convention
-        from tpulab.parallel.moe import _route
+        # combine_weights/_route (parallel/moe) are the ONE gating rule
+        # — k == 1 keeps switch semantics (raw argmax mass), k > 1
+        # renormalizes the selected gates (GShard convex combination) —
+        # so the dense oracle and the dispatch path can never diverge
+        from tpulab.parallel.moe import combine_weights
 
-        kk = cfg.moe_top_k
         b_, s_, _ = x.shape
-        eid, gval = _route(gate.reshape(b_ * s_, -1), kk, x.dtype)
-        weights = (
-            jnp.zeros((b_ * s_, cfg.n_experts), x.dtype)
-            .at[jnp.repeat(jnp.arange(b_ * s_), kk), eid].add(gval)
-            .reshape(b_, s_, cfg.n_experts)
-        )                                                # (b, s, E)
+        weights = combine_weights(
+            gate.reshape(b_ * s_, -1), cfg.moe_top_k, cfg.n_experts, x.dtype
+        ).reshape(b_, s_, cfg.n_experts)                 # (b, s, E)
         hidden = jnp.einsum("bsd,edf->bsef", x, layer["w1"])
         hidden = jax.nn.gelu(hidden)
         out = jnp.einsum("bsef,efd->bsed", hidden, layer["w2"])
@@ -572,7 +579,13 @@ def _forward_scan(params, tokens, cfg: LabformerConfig, mesh: Optional[Mesh]):
         return x, aux_f
 
     if cfg.remat:
-        block = jax.checkpoint(block)
+        if cfg.remat_policy == "dots":
+            block = jax.checkpoint(
+                block,
+                policy=jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+            )
+        else:
+            block = jax.checkpoint(block)
     x, (aux_per_layer, load_per_layer) = jax.lax.scan(block, x, params["blocks"])
     x = _rmsnorm(x, params["final_norm"])
     logits = x @ params["embed"].T  # tied head

@@ -1,4 +1,4 @@
-"""Expert parallelism: top-1 switch MoE with all-to-all token dispatch.
+"""Expert parallelism: top-k MoE with all-to-all token dispatch.
 
 The labformer's in-model MoE (:func:`tpulab.models.labformer._mlp`)
 computes every expert densely and one-hot selects — exact, but E× the
@@ -8,11 +8,13 @@ rides the data axes), and each token travels to its expert's owner
 through one ``lax.all_to_all``, computes there in an expert-batched
 matmul, and returns through a second all-to-all.
 
-Routing is top-1 (switch) with per-expert, per-source capacity ``C``;
-tokens over capacity are dropped (their output is the zero vector, the
-standard switch-transformer behavior).  With ``C >= local tokens`` the
-result is EXACT and equals the dense-gate oracle — that equivalence is
-the correctness test.
+Routing is top-k with per-expert, per-source capacity ``C``: ``k=1`` is
+the switch formulation (raw argmax gate), ``k>1`` renormalizes the
+selected gates (GShard-style convex combination) and dispatches k
+token-major rows through the same machinery.  Tokens over capacity are
+dropped (their output is the zero vector, the standard switch behavior).
+With ``C >= k * local tokens`` the result is EXACT and equals the
+dense-gate oracle — that equivalence is the correctness test.
 
 Layout walk-through (per device, inside shard_map; ``P`` devices on the
 fused axis, ``E`` experts, ``E_loc = E/P`` local experts, ``n`` local
@@ -111,18 +113,23 @@ def _moe_body(x, router_w, w1_loc, w2_loc, *, axis: AxisName, n_experts: int,
     return y.reshape(n, k, d).sum(axis=1) if k > 1 else y
 
 
+def combine_weights(gate, k: int, n_experts: int, dtype):
+    """Dense (n, E) combine matrix from top-k routing — the one
+    scatter shared by the dense oracle and the in-model path."""
+    n = gate.shape[0]
+    eid, gval = _route(gate, k, dtype)                            # (n*k,)
+    return (jnp.zeros((n, n_experts), dtype)
+            .at[jnp.repeat(jnp.arange(n), k), eid].add(gval))
+
+
 def switch_moe_reference(x, router_w, w1, w2, k: int = 1):
     """Dense-gate oracle: compute every expert, top-k weighted combine
     (the labformer in-model formulation; exact, E-fold compute)."""
     gate = jax.nn.softmax((x @ router_w).astype(jnp.float32), axis=-1)
-    n_experts = w1.shape[0]
-    eid, gval = _route(gate, k, x.dtype)                          # (n*k,)
     hid = jax.nn.gelu(jnp.einsum("nd,edf->nef", x, w1))
     out = jnp.einsum("nef,efd->ned", hid, w2)                     # (n, E, d)
-    weights = (jnp.zeros((x.shape[0], n_experts), x.dtype)
-               .at[jnp.repeat(jnp.arange(x.shape[0]), k), eid]
-               .add(gval))
-    return jnp.einsum("ned,ne->nd", out, weights)
+    return jnp.einsum("ned,ne->nd", out,
+                      combine_weights(gate, k, w1.shape[0], x.dtype))
 
 
 @functools.partial(

@@ -202,6 +202,7 @@ def train(
     optimizer=None,
     accum: int = 1,
     remat: bool = False,
+    remat_policy: str = "none",
     experts: int = 0,
     moe_impl: str = "dense",
     moe_aux_weight: float = 0.01,
@@ -363,6 +364,7 @@ def train(
             d_ff=512,
             max_seq=seq,
             remat=remat,
+            remat_policy=remat_policy,
             n_experts=experts,
             moe_impl=moe_impl,
             moe_aux_weight=moe_aux_weight,
@@ -632,6 +634,10 @@ def main(argv=None) -> int:
     ap.add_argument("--trace-dir", default=None, help="JAX profiler output dir")
     ap.add_argument("--accum", type=int, default=1, help="gradient-accumulation microbatches")
     ap.add_argument("--remat", action="store_true", help="rematerialize blocks (jax.checkpoint)")
+    ap.add_argument("--remat-policy", default="none", choices=("none", "dots"),
+                    help="what remat saves: none = recompute everything; "
+                         "dots = keep MXU matmul outputs, recompute the "
+                         "cheap VPU ops (the usual TPU sweet spot)")
     ap.add_argument("--experts", type=int, default=0, help="MoE experts (0 = dense MLP)")
     ap.add_argument(
         "--moe-impl", default="dense", choices=("dense", "dispatch"),
@@ -714,6 +720,7 @@ def main(argv=None) -> int:
         trace_dir=args.trace_dir,
         accum=args.accum,
         remat=args.remat,
+        remat_policy=args.remat_policy,
         experts=args.experts,
         moe_impl=args.moe_impl,
         moe_aux_weight=args.moe_aux_weight,

@@ -11,28 +11,13 @@ from tpulab.runtime.timing import parse_timing_line
 
 
 def roberts_oracle_c(pixels: np.ndarray) -> np.ndarray:
-    """Independent NumPy float32 re-statement of the C reference semantics
-    (lab2/src/main.c:14-59): clamp addressing, f32 luminance, sqrt,
-    clamp+truncate. Pure numpy — no jax — for triangulation."""
-    h, w = pixels.shape[:2]
-    rgb = pixels[..., :3].astype(np.float32)
-    y = (
-        np.float32(0.299) * rgb[..., 0]
-        + np.float32(0.587) * rgb[..., 1]
-        + np.float32(0.114) * rgb[..., 2]
-    )
-    ypad = np.pad(y, ((0, 1), (0, 1)), mode="edge")
-    y00 = ypad[:h, :w]
-    y10 = ypad[:h, 1 : w + 1]
-    y01 = ypad[1 : h + 1, :w]
-    y11 = ypad[1 : h + 1, 1 : w + 1]
-    gx = y11 - y00
-    gy = y10 - y01
-    g = np.sqrt(gx * gx + gy * gy, dtype=np.float32)
-    g = np.clip(g, np.float32(0.0), np.float32(255.0))
-    g8 = g.astype(np.uint8)  # C truncation
-    out = np.stack([g8, g8, g8, pixels[..., 3]], axis=-1)
-    return out
+    """C-semantics Roberts oracle — ONE copy, shared with the selftest
+    command (tpulab/selftest.py).  Independence of this suite's golden
+    checks is anchored by the reference's committed golden files, not
+    by a duplicate oracle implementation."""
+    from tpulab.selftest import roberts_oracle_np
+
+    return roberts_oracle_np(pixels)
 
 
 class TestGolden:

@@ -1,0 +1,26 @@
+"""`tpulab selftest` — the one-minute user-facing sanity command."""
+
+from tpulab.selftest import main
+
+
+def test_selftest_passes(capsys):
+    # the heavy tiers (train, serving) have their own suites — skipping
+    # them keeps this a wiring/kernel check, not a duplicate
+    rc = main(["--skip", "train", "--skip", "serving"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert out.count("pass") == 4 and out.count("SKIP") == 2
+    assert "OK (4/4 run, 2 skipped)" in out
+
+
+def test_selftest_reports_failure(capsys, monkeypatch):
+    import tpulab.selftest as st
+
+    def boom():
+        raise AssertionError("synthetic")
+
+    monkeypatch.setattr(
+        st, "CHECKS", [("ok", lambda: None), ("bad", boom)])
+    rc = main([])
+    out = capsys.readouterr().out
+    assert rc == 1 and "FAIL  bad" in out and "FAILED (1/2 run)" in out

@@ -329,6 +329,32 @@ class TestRematPolicy:
         assert np.isclose(losses["plain"], losses["remat"], atol=1e-5)
         assert np.isclose(losses["plain"], losses["dots"], atol=1e-5)
 
+    def test_dots_policy_actually_applies(self, monkeypatch):
+        """The 'dots' knob must reach jax.checkpoint as the saveable
+        policy — losses are equal across policies by design, so only
+        the call itself can pin that the branch works."""
+        import jax
+
+        from tpulab.models.labformer import LabformerConfig, forward, init_params
+
+        seen = []
+        real = jax.checkpoint
+
+        def spy(fn, *a, **kw):
+            seen.append(kw.get("policy"))
+            return real(fn, *a, **kw)
+
+        monkeypatch.setattr(jax, "checkpoint", spy)
+        toks = np.zeros((1, 9), np.int32)
+        for kw, want in ((dict(remat=True), None),
+                         (dict(remat=True, remat_policy="dots"),
+                          jax.checkpoint_policies.dots_with_no_batch_dims_saveable)):
+            seen.clear()
+            cfg = LabformerConfig(d_model=32, n_heads=4, n_layers=2, d_ff=64,
+                                  max_seq=64, **kw)
+            forward(init_params(cfg, seed=0), toks, cfg)
+            assert seen and seen[0] is want, (kw, seen)
+
     def test_policy_validated(self):
         import pytest as _pytest
 

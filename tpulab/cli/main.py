@@ -33,6 +33,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     sub.add_parser("tokenizer", help="train/inspect a BPE tokenizer")
     sub.add_parser("eval", help="held-out loss/perplexity/bits-per-byte "
                                 "of a checkpoint")
+    sub.add_parser("selftest", help="one-minute end-to-end sanity check")
 
     args, extra = parser.parse_known_args(argv)
 
@@ -78,6 +79,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         from tpulab.evaluate import main as eval_main
 
         return eval_main(extra)
+
+    if args.command == "selftest":
+        from tpulab.selftest import main as selftest_main
+
+        return selftest_main(extra)
 
     parser.print_help()
     return 2

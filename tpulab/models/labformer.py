@@ -521,7 +521,7 @@ def _mlp(x, layer, cfg: LabformerConfig, mesh: Optional[Mesh] = None):
 
         b_, s_, _ = x.shape
         weights = combine_weights(
-            gate.reshape(b_ * s_, -1), cfg.moe_top_k, cfg.n_experts, x.dtype
+            gate.reshape(b_ * s_, -1), cfg.moe_top_k, x.dtype
         ).reshape(b_, s_, cfg.n_experts)                 # (b, s, E)
         hidden = jnp.einsum("bsd,edf->bsef", x, layer["w1"])
         hidden = jax.nn.gelu(hidden)

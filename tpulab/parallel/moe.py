@@ -113,10 +113,12 @@ def _moe_body(x, router_w, w1_loc, w2_loc, *, axis: AxisName, n_experts: int,
     return y.reshape(n, k, d).sum(axis=1) if k > 1 else y
 
 
-def combine_weights(gate, k: int, n_experts: int, dtype):
+def combine_weights(gate, k: int, dtype):
     """Dense (n, E) combine matrix from top-k routing — the one
-    scatter shared by the dense oracle and the in-model path."""
-    n = gate.shape[0]
+    scatter shared by the dense oracle and the in-model path.  E is
+    the gate's own trailing dim (a separate parameter could disagree
+    with it and mis-size the scatter)."""
+    n, n_experts = gate.shape
     eid, gval = _route(gate, k, dtype)                            # (n*k,)
     return (jnp.zeros((n, n_experts), dtype)
             .at[jnp.repeat(jnp.arange(n), k), eid].add(gval))
@@ -129,7 +131,7 @@ def switch_moe_reference(x, router_w, w1, w2, k: int = 1):
     hid = jax.nn.gelu(jnp.einsum("nd,edf->nef", x, w1))
     out = jnp.einsum("nef,efd->ned", hid, w2)                     # (n, E, d)
     return jnp.einsum("ned,ne->nd", out,
-                      combine_weights(gate, k, w1.shape[0], x.dtype))
+                      combine_weights(gate, k, x.dtype))
 
 
 @functools.partial(

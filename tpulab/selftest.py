@@ -1,0 +1,197 @@
+"""``tpulab selftest`` — one-minute end-to-end sanity check.
+
+Runs a compact slice of every tier against its oracle and prints one
+PASS/FAIL line each: the workload kernels (lab1/lab2/lab3 vs their
+NumPy/C-semantics oracles), flash attention vs dense, the paged serving
+engine vs solo decode, and a two-step train/resume.  On a TPU backend
+the kernels run compiled (Mosaic); elsewhere they run in interpret
+mode — the same split the test suite uses.
+
+This is the "did my install/device work" command for someone switching
+from the reference suite (whose nearest analog is running a lab binary
+against a golden by hand); the full evidence lives in ``tests/`` and
+``results/``.
+
+Usage: python -m tpulab selftest [--skip NAME ...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+import traceback
+from typing import Callable, List, Tuple
+
+import numpy as np
+
+
+def _check_lab1():
+    import jax.numpy as jnp
+
+    from tpulab.ops.elementwise import subtract, subtract_oracle
+
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal(4097).astype(np.float32)
+    b = rng.standard_normal(4097).astype(np.float32)
+    got = np.asarray(subtract(jnp.asarray(a), jnp.asarray(b)))
+    np.testing.assert_allclose(got, subtract_oracle(a, b), rtol=1e-6)
+
+
+def roberts_oracle_np(pixels: np.ndarray) -> np.ndarray:
+    """NumPy f32 restatement of the C reference semantics (reference
+    lab2/src/main.c:14-59): clamp addressing, f32 luminance, sqrt,
+    clamp-then-truncate.  THE one copy — the golden suite
+    (tests/test_lab2.py) imports it; independence from the jax kernels
+    is anchored by the reference's committed golden files, not by
+    duplicating this function."""
+    h, w = pixels.shape[:2]
+    rgb = pixels[..., :3].astype(np.float32)
+    y = (np.float32(0.299) * rgb[..., 0]
+         + np.float32(0.587) * rgb[..., 1]
+         + np.float32(0.114) * rgb[..., 2])
+    ypad = np.pad(y, ((0, 1), (0, 1)), mode="edge")
+    gx = ypad[1:h + 1, 1:w + 1] - ypad[:h, :w]
+    gy = ypad[:h, 1:w + 1] - ypad[1:h + 1, :w]
+    g = np.sqrt(gx * gx + gy * gy, dtype=np.float32)
+    g8 = np.clip(g, np.float32(0.0), np.float32(255.0)).astype(np.uint8)
+    return np.stack([g8, g8, g8, pixels[..., 3]], axis=-1)
+
+
+def classify_oracle_np(pixels: np.ndarray, mean, inv_cov) -> np.ndarray:
+    """Vectorized f64 restatement of the lab3 classify kernel
+    (reference lab3/src/main.cu:40-76): strict-< Mahalanobis argmin.
+    NaN distances (degenerate few-point classes) never win — the C
+    ``dist < best_d`` comparison rejects NaN, and np.argmin would
+    wrongly pick the first NaN."""
+    p = pixels[..., :3].astype(np.float64)
+    d = p[..., None, :] - np.asarray(mean)              # (h, w, nc, 3)
+    q = np.einsum("...cd,cde,...ce->...c", d, np.asarray(inv_cov), d)
+    q = np.where(np.isnan(q), np.inf, q)
+    return np.argmin(q, axis=-1).astype(np.uint8)
+
+
+def _check_lab2():
+    import jax.numpy as jnp
+
+    from tpulab.ops.roberts import roberts_edges
+
+    rng = np.random.default_rng(1)
+    img = rng.integers(0, 256, (33, 45, 4), np.uint8)
+    got = np.asarray(roberts_edges(jnp.asarray(img)))
+    want = roberts_oracle_np(img)
+    if not np.array_equal(got, want):
+        raise AssertionError(
+            f"{int((got != want).sum())} mismatched bytes vs the C-semantics "
+            f"oracle")
+
+
+def _check_lab3():
+    import jax.numpy as jnp
+
+    from tpulab.ops.mahalanobis import class_statistics, classify_labels
+
+    rng = np.random.default_rng(2)
+    img = rng.integers(0, 256, (17, 19, 4), np.uint8)
+    classes = [np.array([[1, 1], [2, 3], [4, 2]]), np.array([[5, 5], [6, 6]])]
+    stats = class_statistics(img, classes)
+    labels = np.asarray(classify_labels(
+        jnp.asarray(img), jnp.asarray(stats.mean), jnp.asarray(stats.inv_cov)
+    ))
+    want = classify_oracle_np(img, stats.mean, stats.inv_cov)
+    if not np.array_equal(labels.reshape(want.shape), want):
+        raise AssertionError(
+            f"{int((labels.reshape(want.shape) != want).sum())} mismatched "
+            f"labels vs the f64 oracle")
+
+
+def _check_flash():
+    import jax.numpy as jnp
+
+    from tpulab.ops.pallas.attention import flash_attention
+    from tpulab.parallel.ring import attention_reference
+
+    rng = np.random.default_rng(3)
+    q, k, v = (jnp.asarray(rng.standard_normal((1, 256, 2, 64)), jnp.float32)
+               for _ in range(3))
+    got = np.asarray(flash_attention(q, k, v, causal=True, block_q=128,
+                                     block_k=128))
+    want = np.asarray(attention_reference(q, k, v, causal=True))
+    np.testing.assert_allclose(got, want, atol=2e-5, rtol=2e-5)
+
+
+def _check_serving():
+    from tpulab.models.generate import generate
+    from tpulab.models.labformer import LabformerConfig, init_params
+    from tpulab.models.paged import PagedEngine
+
+    cfg = LabformerConfig(d_model=32, n_heads=4, n_layers=2, d_ff=64,
+                          max_seq=64)
+    params = init_params(cfg, seed=0)
+    prompt = (np.arange(5) % 7).astype(np.int32)
+    eng = PagedEngine(params, cfg, slots=2, n_blocks=16, block_size=8,
+                      max_seq=64)
+    rid = eng.submit(prompt, max_new=4)
+    got = eng.run()[rid]
+    want = generate(params, prompt[None, :], cfg, steps=4, temperature=0.0)[0]
+    assert np.array_equal(got, np.asarray(want)), (got, want)
+
+
+def _check_train():
+    import tempfile
+
+    from tpulab.train import train
+
+    with tempfile.TemporaryDirectory() as d:
+        step, loss = train(steps=2, batch=2, seq=32, ckpt_dir=d,
+                           save_every=2, log=lambda *a: None)
+        assert step == 2 and np.isfinite(loss)
+        step2, loss2 = train(steps=3, batch=2, seq=32, ckpt_dir=d,
+                             save_every=3, resume=True, log=lambda *a: None)
+        assert step2 == 3 and np.isfinite(loss2)
+
+
+CHECKS: List[Tuple[str, Callable[[], None]]] = [
+    ("lab1 elementwise vs oracle", _check_lab1),
+    ("lab2 roberts bit-exact vs C semantics", _check_lab2),
+    ("lab3 mahalanobis classify", _check_lab3),
+    ("flash attention vs dense", _check_flash),
+    ("paged serving == solo decode", _check_serving),
+    ("train step + checkpoint resume", _check_train),
+]
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--skip", action="append", default=[],
+                    metavar="SUBSTR", help="skip checks matching SUBSTR")
+    args = ap.parse_args(argv)
+
+    import jax
+
+    dev = jax.devices()[0]
+    print(f"[selftest] backend: {dev.platform} ({dev.device_kind})")
+    failed = skipped = 0
+    for name, fn in CHECKS:
+        if any(s in name for s in args.skip):
+            skipped += 1
+            print(f"[selftest] SKIP  {name}")
+            continue
+        t0 = time.perf_counter()
+        try:
+            fn()
+        except Exception:
+            failed += 1
+            print(f"[selftest] FAIL  {name}")
+            traceback.print_exc()
+            continue
+        print(f"[selftest] pass  {name} "
+              f"({(time.perf_counter() - t0) * 1e3:.0f} ms)")
+    ran = len(CHECKS) - skipped
+    print(f"[selftest] {'FAILED' if failed else 'OK'} "
+          f"({ran - failed}/{ran} run"
+          + (f", {skipped} skipped" if skipped else "") + ")")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -209,6 +209,62 @@ def test_cancel_pending_before_admission():
     assert r1 in done and r2 not in done
 
 
+def test_sliding_window_retires_blocks_mid_decode():
+    """attn_window serving holds O(window) KV per slot: blocks wholly
+    behind the window free DURING decode (not just at finish), tokens
+    stay equal to the solo windowed decode, and finish accounting still
+    balances."""
+    from tpulab.models.generate import generate
+
+    cfg = LabformerConfig(d_model=32, n_heads=4, n_layers=2, d_ff=64,
+                          max_seq=128, attn_window=6)
+    params = _trained_params(cfg, steps=20)
+    prompt = (np.arange(10) % 7).astype(np.int32)
+
+    eng = PagedEngine(params, cfg, slots=1, n_blocks=32, block_size=8,
+                      max_seq=128)
+    free0 = len(eng.free)
+    rid = eng.submit(prompt, max_new=40)
+    eng.step()
+    free_early = len(eng.free)
+    mid_frees = []
+    out = None
+    while out is None:
+        fin = eng.step()
+        mid_frees.append(len(eng.free))
+        if rid in fin:
+            out = eng._done.pop(rid)
+    # blocks were retired while decoding (free pool grew mid-flight)
+    assert max(mid_frees[:-1] or [free_early]) > free_early, mid_frees
+    assert eng.counters["blocks_retired"] > 0
+    # accounting balances at finish (minus any prefix-cached blocks)
+    cached = sum(len(b) for b in eng.prefix_cache.values())
+    assert len(eng.free) == free0 - cached
+    # and the tokens are the solo windowed decode's, exactly
+    want = generate(params, prompt[None, :], cfg, steps=40,
+                    temperature=0.0)[0]
+    assert np.array_equal(out, np.asarray(want))
+
+
+def test_window_retirement_keeps_shared_prefix_cached():
+    """Retiring a slot's reference must not free prefix-cache blocks:
+    a later request with the same prompt still hits the cache."""
+    cfg = LabformerConfig(d_model=32, n_heads=4, n_layers=2, d_ff=64,
+                          max_seq=128, attn_window=6)
+    params = _trained_params(cfg, steps=20)
+    shared = (np.arange(16) % 7).astype(np.int32)  # 2 full blocks
+
+    eng = PagedEngine(params, cfg, slots=1, n_blocks=32, block_size=8,
+                      max_seq=128)
+    r1 = eng.submit(shared, max_new=24)  # decode far past the window
+    out1 = eng.run()[r1]
+    assert eng.counters["blocks_retired"] > 0
+    r2 = eng.submit(shared, max_new=24)
+    out2 = eng.run()[r2]
+    assert eng.counters["prefix_hits"] >= 1
+    assert np.array_equal(out1, out2)
+
+
 def test_engine_refuses_pallas_with_mesh():
     cfg = LabformerConfig(d_model=32, n_heads=4, n_layers=2, d_ff=64,
                           max_seq=64)

@@ -458,7 +458,12 @@ class PagedEngine:
         self.counters = {
             "prefix_hits": 0, "prefix_misses": 0, "evictions": 0,
             "ticks": 0, "tokens_out": 0, "requests_done": 0,
+            "blocks_retired": 0,
         }
+        # per-slot cursor: first logical block not yet window-retired,
+        # so each tick checks only the 0-or-1 newly dead block instead
+        # of rescanning every already-TRASHed entry
+        self._retire_from = [0] * slots
 
     # ------------------------------------------------------------- admission
     def submit(self, prompt, max_new: int, *, temperature: float = 0.0,
@@ -688,20 +693,52 @@ class PagedEngine:
                 # regardless of how early the request finished —
                 # req.max_new is immutable by contract (a cancel flags
                 # the request instead of shrinking it, or this count
-                # would leak blocks)
+                # would leak blocks).  TRASH entries are blocks the
+                # sliding-window retirement already released mid-decode.
                 used = self._blocks_needed(len(req.prompt) + req.max_new)
                 for b in self.tables[s, :used]:
-                    self._deref(int(b))
+                    if int(b) != TRASH:
+                        self._deref(int(b))
                 self.tables[s] = TRASH
                 self.lengths[s] = 0
                 self.temps[s] = 0.0
                 self.penalties[s] = 1.0
                 self.seen[s] = False
+                self._retire_from[s] = 0
                 self.active[s] = None
                 self._done[req.req_id] = np.asarray(req.out, np.int32)
                 self.counters["requests_done"] += 1
                 finished.append(req.req_id)
+        if self.cfg.attn_window:
+            self._retire_windowed_blocks()
         return finished
+
+    def _retire_windowed_blocks(self):
+        """Free KV blocks that fell wholly behind the sliding window.
+
+        With ``attn_window = w``, every current AND future query at
+        position ``q >= length`` reaches keys ``>= q - w + 1 >=
+        length - w + 1`` only, so logical block ``j`` (positions
+        ``[j*BS, (j+1)*BS)``) is dead once ``length >= (j+1)*BS + w - 1``
+        — windowed serving then holds O(window) KV per slot instead of
+        O(seq).  Deref (not force-free): a prefix-cache entry holding
+        its own reference keeps the block alive for future hits; the
+        slot merely drops ITS reference and points the table at TRASH
+        (reads were already masked off, writes only ever land ahead).
+        """
+        w, bs = self.cfg.attn_window, self.block_size
+        for s, req in enumerate(self.active):
+            if req is None:
+                continue
+            n_dead = min(max(0, (int(self.lengths[s]) - w + 1) // bs),
+                         self.max_blocks)
+            for j in range(self._retire_from[s], n_dead):
+                b = int(self.tables[s, j])
+                if b != TRASH:
+                    self._deref(b)
+                    self.tables[s, j] = TRASH
+                    self.counters["blocks_retired"] += 1
+            self._retire_from[s] = max(self._retire_from[s], n_dead)
 
     def cancel(self, req_id: int) -> str:
         """Abandon a request (its consumer died).  Returns where it was

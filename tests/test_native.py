@@ -378,6 +378,25 @@ class TestDaemonGenerate:
             daemon, b'{"lab": "generate", "config": {"steps": 6}}', b"hello")
         assert st2 == 0 and plain == final
 
+    def test_speculative_over_wire_is_lossless(self, daemon):
+        """{"speculative": true}: byte-identical to plain greedy (the
+        losslessness contract), and sampling combos refuse."""
+        plain = _raw_request_bytes(
+            daemon, b'{"lab": "generate", "config": {"steps": 8}}', b"spec")
+        spec = _raw_request_bytes(
+            daemon,
+            b'{"lab": "generate", "config": {"steps": 8, '
+            b'"speculative": true, "draft_k": 3}}',
+            b"spec")
+        assert plain[0] == 0 and spec[0] == 0
+        assert spec[1] == plain[1]
+        status, err = _raw_request(
+            daemon,
+            b'{"lab": "generate", "config": {"steps": 2, '
+            b'"speculative": true, "temperature": 0.7}}',
+            b"x")
+        assert status == 1 and "greedy" in err
+
     def test_engine_knobs_over_wire(self, daemon):
         """{"attn": "pallas"} and {"kv_dtype": "int8"} build distinct
         cached engines; pallas serves the gather path's exact bytes

@@ -117,6 +117,35 @@ class _ByteBudget:
             self.cond.notify_all()
 
 
+#: serializes host-orchestrated speculative loops (each interleaves many
+#: small dispatches; running two at once thrashes the device queue)
+_SPEC_LOCK = threading.Lock()
+
+#: engine -> int8 draft params, built lazily on the first speculative
+#: request; weak keys die with the engine (same lifetime discipline as
+#: _GenerateService._states)
+import weakref
+
+_DRAFTS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+
+
+def _draft_for(engine):
+    """Lazily-built int8 draft for an engine's params (device-resident
+    quantization, no host round-trip).  Keyed per engine: the same
+    checkpoint served under different attn/kv_dtype knobs builds one
+    draft per variant — accepted duplication (knob variants of one
+    checkpoint are an edge case; path-keying would add staleness
+    bookkeeping the engine key gets for free).  Callers hold
+    _SPEC_LOCK, which also makes the build-once race-free."""
+    draft = _DRAFTS.get(engine)
+    if draft is None:
+        from tpulab.models.quant import quantize_decode_params
+
+        draft = quantize_decode_params(engine.params, engine.cfg)
+        _DRAFTS[engine] = draft
+    return draft
+
+
 class _StreamBroken(ConnectionError):
     """A chunk-frame sendall failed (possibly mid-write): the wire can
     no longer carry ANY further frame for this request — the connection
@@ -366,8 +395,11 @@ def _handle_generate(header: dict, payload: bytes,
     the first skips compilation entirely.  Config keys: ``steps``
     (default 64), ``ckpt_dir`` (trainer snapshot; default random demo
     weights), ``temperature`` + ``seed`` (default greedy),
-    ``repetition_penalty`` (HF convention; 1.0 = off) and ``stop_byte``
-    (finish right after emitting it; -1 = off)."""
+    ``repetition_penalty`` (HF convention; 1.0 = off), ``stop_byte``
+    (finish right after emitting it; -1 = off), ``stream`` (status-2
+    chunk frames), ``attn``/``kv_dtype`` (engine knobs), and
+    ``speculative`` + ``draft_k`` (lossless greedy speculative decode
+    with a lazily-built int8 draft — same bytes as plain greedy)."""
     import numpy as np
 
     config = header.get("config") or {}
@@ -392,6 +424,15 @@ def _handle_generate(header: dict, payload: bytes,
     if kv_dtype not in ("native", "int8"):
         raise ValueError(
             f"kv_dtype={kv_dtype!r}; expected 'native' or 'int8'")
+    if bool(config.get("speculative")) and (
+        float(config.get("temperature", 0.0)) != 0.0
+        or float(config.get("repetition_penalty", 1.0)) != 1.0
+        or bool(config.get("stream"))
+    ):
+        # config-only error: reject BEFORE a cold engine build is paid
+        raise ValueError(
+            "speculative decoding is greedy and unstreamed: drop "
+            "temperature/repetition_penalty/stream")
     engine, tok = _engine_for(config.get("ckpt_dir"), attn, kv_dtype)
     if tok is None:
         prompt = np.frombuffer(payload, np.uint8).astype(np.int32)
@@ -404,6 +445,49 @@ def _handle_generate(header: dict, payload: bytes,
         # inside a larger token
         prompt = tok.encode(bytes(payload))
         eng_stop = -1
+
+    if bool(config.get("speculative")):
+        # lossless greedy speculative decoding: the engine's (merged)
+        # params serve as target, an int8-quantized copy drafts.  Host-
+        # orchestrated (no continuous batching) — concurrent spec
+        # requests serialize on one lock instead of thrashing the
+        # device with interleaved host loops.  A stop_byte trims
+        # post-hoc (the full loop still runs — the standalone
+        # speculative path has no early-stop plumbing; known cost).
+        # The sampling-combo refusal already ran pre-engine-build.
+        if engine.cfg.n_experts:
+            raise ValueError(
+                "speculative decoding needs an int8 draft; MoE "
+                "checkpoints are not quantizable (models/quant.py)")
+        k = int(config.get("draft_k", 4))
+        cap = 512  # the daemon engine's max_seq — one serving policy
+        if len(prompt) + steps + k + 2 > cap:
+            # the plain path's PagedEngine.submit enforces this bound;
+            # the dense speculative caches must honor the same policy
+            # instead of allocating an unbounded cache under the lock
+            raise ValueError(
+                f"prompt + steps + draft_k + 2 = "
+                f"{len(prompt) + steps + k + 2} exceeds the daemon "
+                f"serving cap {cap}")
+        from tpulab.models.speculative import speculative_generate
+
+        with _SPEC_LOCK:
+            draft = _draft_for(engine)
+            out, acc = speculative_generate(
+                draft, engine.cfg, engine.params, engine.cfg,
+                prompt[None, :], steps=steps, k=k,
+            )
+        toks = [int(t) for t in np.asarray(out[0])]
+        if tok is None:
+            data = bytes(t & 0xFF for t in toks)
+        else:
+            data = tok.decode(toks)
+        if stop_byte >= 0:
+            cut = data.find(bytes([stop_byte]))
+            if cut >= 0:
+                data = data[: cut + 1]  # engine semantics: stop byte
+                # is the final emitted byte
+        return data
 
     on_progress = None
     if send_chunk is not None and bool(config.get("stream")):

@@ -397,6 +397,29 @@ class TestDaemonGenerate:
             b"x")
         assert status == 1 and "greedy" in err
 
+    def test_beam_search_over_wire(self, daemon):
+        """{"beams": 1} equals plain greedy (the beam contract); wider
+        beams serve deterministically; invalid combos refuse."""
+        plain = _raw_request_bytes(
+            daemon, b'{"lab": "generate", "config": {"steps": 6}}', b"beam")
+        b1 = _raw_request_bytes(
+            daemon, b'{"lab": "generate", "config": {"steps": 6, "beams": 1}}',
+            b"beam")
+        b4a = _raw_request_bytes(
+            daemon, b'{"lab": "generate", "config": {"steps": 6, "beams": 4}}',
+            b"beam")
+        b4b = _raw_request_bytes(
+            daemon, b'{"lab": "generate", "config": {"steps": 6, "beams": 4}}',
+            b"beam")
+        assert plain[0] == b1[0] == b4a[0] == 0
+        assert b1[1] == plain[1]
+        assert b4a[1] == b4b[1] and len(b4a[1]) == 6
+        status, err = _raw_request(
+            daemon,
+            b'{"lab": "generate", "config": {"steps": 2, "beams": 2, '
+            b'"temperature": 0.5}}', b"x")
+        assert status == 1 and "deterministic" in err
+
     def test_engine_knobs_over_wire(self, daemon):
         """{"attn": "pallas"} and {"kv_dtype": "int8"} build distinct
         cached engines; pallas serves the gather path's exact bytes

@@ -117,6 +117,10 @@ class _ByteBudget:
             self.cond.notify_all()
 
 
+#: one serving-length policy: the paged engines' max_seq AND the cap on
+#: the single-stream strategies' (beam / speculative) dense caches
+_SERVE_MAX_SEQ = 512
+
 #: serializes host-orchestrated speculative loops (each interleaves many
 #: small dispatches; running two at once thrashes the device queue)
 _SPEC_LOCK = threading.Lock()
@@ -152,7 +156,7 @@ class _StreamBroken(ConnectionError):
     must close without a terminal frame."""
 
 
-#: (realpath|None, attn, kv_dtype) -> (loaded_step, engine, tok); LRU, max 2
+#: (realpath|None, attn, kv_dtype) -> (loaded_step, engine, tok); LRU, max 4
 _ENGINES: "dict" = {}
 
 
@@ -367,8 +371,8 @@ def _engine_for(ckpt, attn: str = "gather", kv_dtype: str = "native"):
 
         params, cfg = merge_lora(params, cfg)
     engine = PagedEngine(
-        params, cfg, slots=4, n_blocks=128, block_size=16, max_seq=512,
-        attn=attn, kv_dtype=kv_dtype,
+        params, cfg, slots=4, n_blocks=128, block_size=16,
+        max_seq=_SERVE_MAX_SEQ, attn=attn, kv_dtype=kv_dtype,
     )
     with _GEN_SERVICE.lock:
         hit = _ENGINES.get(key)
@@ -399,7 +403,8 @@ def _handle_generate(header: dict, payload: bytes,
     (finish right after emitting it; -1 = off), ``stream`` (status-2
     chunk frames), ``attn``/``kv_dtype`` (engine knobs), and
     ``speculative`` + ``draft_k`` (lossless greedy speculative decode
-    with a lazily-built int8 draft — same bytes as plain greedy)."""
+    with a lazily-built int8 draft — same bytes as plain greedy), and
+    ``beams`` (beam search; beams=1 == greedy)."""
     import numpy as np
 
     config = header.get("config") or {}
@@ -424,15 +429,24 @@ def _handle_generate(header: dict, payload: bytes,
     if kv_dtype not in ("native", "int8"):
         raise ValueError(
             f"kv_dtype={kv_dtype!r}; expected 'native' or 'int8'")
-    if bool(config.get("speculative")) and (
+    beams = int(config.get("beams", 0))
+    deterministic_combo = (
         float(config.get("temperature", 0.0)) != 0.0
         or float(config.get("repetition_penalty", 1.0)) != 1.0
         or bool(config.get("stream"))
-    ):
-        # config-only error: reject BEFORE a cold engine build is paid
+    )
+    # config-only errors: reject BEFORE a cold engine build is paid
+    if bool(config.get("speculative")) and deterministic_combo:
         raise ValueError(
             "speculative decoding is greedy and unstreamed: drop "
             "temperature/repetition_penalty/stream")
+    if beams and (deterministic_combo or bool(config.get("speculative"))
+                  or stop_byte >= 0):
+        raise ValueError(
+            "beam search is deterministic and unstreamed: drop "
+            "temperature/repetition_penalty/stream/speculative/stop_byte")
+    if beams < 0:
+        raise ValueError(f"beams must be >= 0, got {beams}")
     engine, tok = _engine_for(config.get("ckpt_dir"), attn, kv_dtype)
     if tok is None:
         prompt = np.frombuffer(payload, np.uint8).astype(np.int32)
@@ -445,6 +459,28 @@ def _handle_generate(header: dict, payload: bytes,
         # inside a larger token
         prompt = tok.encode(bytes(payload))
         eng_stop = -1
+
+    if beams:
+        # beam search: host backtrack over a cache-reordering scan —
+        # like speculative, a single-stream strategy served outside the
+        # batching engine, serialized on the same lock.  beams=1 is
+        # exactly greedy (models/beam contract).
+        if beams > engine.cfg.vocab:
+            raise ValueError(
+                f"beams={beams} > vocab {engine.cfg.vocab}")
+        if len(prompt) + steps > _SERVE_MAX_SEQ:
+            raise ValueError(
+                f"prompt + steps = {len(prompt) + steps} exceeds the "
+                f"daemon serving cap {_SERVE_MAX_SEQ}")
+        from tpulab.models.beam import beam_search
+
+        with _SPEC_LOCK:
+            seq, score = beam_search(engine.params, prompt, engine.cfg,
+                                     steps=steps, beams=beams)
+        toks = [int(t) for t in np.asarray(seq)]
+        if tok is None:
+            return bytes(t & 0xFF for t in toks)
+        return tok.decode(toks)
 
     if bool(config.get("speculative")):
         # lossless greedy speculative decoding: the engine's (merged)
@@ -460,7 +496,12 @@ def _handle_generate(header: dict, payload: bytes,
                 "speculative decoding needs an int8 draft; MoE "
                 "checkpoints are not quantizable (models/quant.py)")
         k = int(config.get("draft_k", 4))
-        cap = 512  # the daemon engine's max_seq — one serving policy
+        if k < 1:
+            # a negative k would shrink the dense cache BELOW the
+            # prompt length — JAX clamps the out-of-bounds scatters
+            # silently and the daemon would return garbage with rc 0
+            raise ValueError(f"draft_k must be >= 1, got {k}")
+        cap = _SERVE_MAX_SEQ
         if len(prompt) + steps + k + 2 > cap:
             # the plain path's PagedEngine.submit enforces this bound;
             # the dense speculative caches must honor the same policy

@@ -10,6 +10,7 @@ the input space, shrinking any counterexample it finds.
 import os
 
 import numpy as np
+import pytest
 from hypothesis import given, settings, strategies as st
 
 import jax.numpy as jnp
@@ -142,7 +143,14 @@ class TestPagedEngineInvariants:
     # the full 25-example sweep passes (verified 2026-07-31, 79.5 s on
     # the 8-device CPU mesh) — raise via TPULAB_PAGED_EXAMPLES to re-run
     # the wide sweep.
-    @settings(max_examples=int(os.environ.get("TPULAB_PAGED_EXAMPLES", "4")),
+    # (window, attn) are pytest params, NOT hypothesis draws: under a
+    # small example budget a random draw could leave a combination
+    # (e.g. pallas+windowed) entirely unexercised — parametrize
+    # guarantees all four combos run every time, hypothesis varies the
+    # workload WITHIN each.
+    @pytest.mark.parametrize("window,attn", [
+        (0, "gather"), (0, "pallas"), (5, "gather"), (5, "pallas")])
+    @settings(max_examples=int(os.environ.get("TPULAB_PAGED_EXAMPLES", "2")),
               deadline=None)
     @given(
         data=st.data(),
@@ -152,13 +160,19 @@ class TestPagedEngineInvariants:
         seed=st.integers(0, 2**31),
     )
     def test_random_workload_matches_solo_decode(
-        self, trained_small, trained_small_cfg, data, slots, n_reqs,
-        chunk, seed,
+        self, trained_small, trained_small_cfg, window, attn, data, slots,
+        n_reqs, chunk, seed,
     ):
+        import dataclasses
+
         from tpulab.models.generate import generate
         from tpulab.models.paged import PagedEngine
 
-        cfg = trained_small_cfg
+        # window and attention impl are pure function/engine knobs over
+        # the SAME weights: every combination must match its own solo
+        # windowed decode (and windowed runs exercise mid-decode block
+        # retirement under the same accounting assertions)
+        cfg = dataclasses.replace(trained_small_cfg, attn_window=window)
         rng = np.random.default_rng(seed)
         shared = (np.arange(17) % 7).astype(np.int32)
         jobs = []
@@ -172,7 +186,8 @@ class TestPagedEngineInvariants:
             jobs.append((prompt, int(rng.integers(1, 8))))
 
         eng = PagedEngine(trained_small, cfg, slots=slots, n_blocks=32,
-                          block_size=8, max_seq=64, prefill_chunk=chunk)
+                          block_size=8, max_seq=64, prefill_chunk=chunk,
+                          attn=attn)
         rids = [eng.submit(p, max_new=n) for p, n in jobs]
         out = eng.run()
         for rid, (prompt, n) in zip(rids, jobs):

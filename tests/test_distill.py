@@ -84,3 +84,40 @@ def test_pure_kl_and_pure_ce_both_train(teacher):
             batch_at=_cycle_batch, alpha=alpha, log=lambda *a: None,
         )
         assert np.isfinite(loss)
+
+
+def test_distill_cli_produces_servable_student(tmp_path, capsys):
+    """`tpulab distill` end to end: a BPE+sidecar teacher distills into
+    a SMALLER student whose checkpoint serves through the standard
+    surfaces (sidecar reconstruction, tokenizer copied, eval loads)."""
+    import json
+
+    from tpulab.evaluate import evaluate
+    from tpulab.io.bpe import train_bpe
+    from tpulab.models.distill import main as distill_main
+    from tpulab.models.generate import load_sidecar
+    from tpulab.train import train
+
+    data = tmp_path / "data"
+    data.mkdir()
+    (data / "c.txt").write_bytes(b"pack my box with five dozen jugs. " * 2000)
+    tok = train_bpe((data / "c.txt").read_bytes(), vocab=300)
+    tokp = str(tmp_path / "tok.json")
+    tok.save(tokp)
+    teacher_dir = str(tmp_path / "teacher")
+    train(steps=6, batch=2, seq=32, data_dir=str(data), tokenizer=tokp,
+          ckpt_dir=teacher_dir, save_every=3, log=lambda *a: None)
+
+    out = str(tmp_path / "student")
+    rc = distill_main(["--teacher", teacher_dir, "--out", out,
+                       "--steps", "6", "--batch", "2", "--seq", "32",
+                       "--data-dir", str(data)])
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0 and np.isfinite(report["final_loss"])
+    assert report["student_layers"] == 2  # half the trainer default L4
+
+    s_cfg, s_tok = load_sidecar(out)
+    assert s_cfg.n_layers == 2 and s_cfg.vocab == tok.vocab
+    assert s_tok is not None and s_tok.vocab == tok.vocab
+    rep = evaluate(out, str(data), batches=1, batch=2, seq=32)
+    assert np.isfinite(rep["loss_nats_per_token"])

@@ -135,11 +135,11 @@ class TestPagedEngineInvariants:
     must account for every block afterward."""
 
     # Each example draws a full engine workload + per-request solo decode
-    # oracle (~7s on the one-core box).  Default 4 halves the round-2
-    # cost for the every-commit loop; TPULAB_PAGED_EXAMPLES=8 (or more)
-    # restores the wider draw for thorough runs — the strategy space is
-    # identical either way, only the per-run sample count changes.
-    # Default 4 examples is a wall-time choice, not a coverage ceiling:
+    # oracle (~7s on the one-core box), and the 4-way (window, attn)
+    # parametrize multiplies every max_examples value by 4: the default
+    # TPULAB_PAGED_EXAMPLES=2 runs 8 property executions per suite;
+    # =25 runs 100 (~4x the time documented below).
+    # The default is a wall-time choice, not a coverage ceiling:
     # the full 25-example sweep passes (verified 2026-07-31, 79.5 s on
     # the 8-device CPU mesh) — raise via TPULAB_PAGED_EXAMPLES to re-run
     # the wide sweep.

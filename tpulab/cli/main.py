@@ -34,6 +34,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     sub.add_parser("eval", help="held-out loss/perplexity/bits-per-byte "
                                 "of a checkpoint")
     sub.add_parser("selftest", help="one-minute end-to-end sanity check")
+    sub.add_parser("distill", help="compress a checkpoint into a smaller "
+                                   "servable student (soft-target KL)")
 
     args, extra = parser.parse_known_args(argv)
 
@@ -84,6 +86,11 @@ def main(argv: Optional[List[str]] = None) -> int:
         from tpulab.selftest import main as selftest_main
 
         return selftest_main(extra)
+
+    if args.command == "distill":
+        from tpulab.models.distill import main as distill_main
+
+        return distill_main(extra)
 
     parser.print_help()
     return 2

@@ -127,3 +127,115 @@ def distill(
         if i % 50 == 0:
             log(f"[distill] step {i} loss {loss:.4f}")
     return jax.device_get(student), loss
+
+
+def main(argv=None) -> int:
+    """``tpulab distill``: compress a trained checkpoint into a smaller
+    student via soft-target KL, writing a SERVABLE student checkpoint
+    (trainer snapshot layout + config sidecar + copied tokenizer) — so
+    ``tpulab generate/eval --ckpt-dir <out>`` work unchanged, and the
+    student drops straight into speculative decoding as a draft."""
+    import argparse
+    import dataclasses
+    import json
+    import os
+    import shutil
+
+    ap = argparse.ArgumentParser(description=main.__doc__)
+    ap.add_argument("--teacher", required=True, metavar="CKPT_DIR")
+    ap.add_argument("--out", required=True, metavar="CKPT_DIR")
+    ap.add_argument("--student-layers", type=int, default=0,
+                    help="default: half the teacher's layers (min 1)")
+    ap.add_argument("--student-d-model", type=int, default=0,
+                    help="default: the teacher's d_model")
+    ap.add_argument("--steps", type=int, default=200)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=64)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--temperature", type=float, default=2.0)
+    ap.add_argument("--alpha", type=float, default=0.5,
+                    help="KL weight (1-alpha on data CE)")
+    ap.add_argument("--data-dir", default=None,
+                    help="distill on this corpus (teacher's tokenizer "
+                         "applies automatically); default: the "
+                         "synthetic stream")
+    args = ap.parse_args(argv)
+
+    from tpulab.models.generate import load_params, load_sidecar
+    from tpulab.models.labformer import cfg_to_dict, merge_lora
+
+    out = os.path.abspath(args.out)
+    teacher_dir = os.path.abspath(args.teacher)
+    if os.path.exists(out):
+        # refuse rather than rmtree a directory we did not create — the
+        # worst case (--out pointing at the teacher, or any typo'd
+        # existing path) would destroy data after a full training run
+        raise SystemExit(f"--out {out} already exists; move it or pick "
+                         f"a fresh directory")
+
+    t_cfg, tok = load_sidecar(args.teacher)
+    if t_cfg is None:
+        from tpulab.models.generate import demo_config
+
+        t_cfg = demo_config()
+    try:
+        teacher, step = load_params(t_cfg, args.teacher)
+    except FileNotFoundError as e:
+        raise SystemExit(str(e))
+    if t_cfg.lora_rank:
+        teacher, t_cfg = merge_lora(teacher, t_cfg)
+    print(f"[distill] teacher: step {step}, d{t_cfg.d_model} "
+          f"L{t_cfg.n_layers} vocab {t_cfg.vocab}")
+
+    s_cfg = dataclasses.replace(
+        t_cfg,
+        n_layers=args.student_layers or max(1, t_cfg.n_layers // 2),
+        d_model=args.student_d_model or t_cfg.d_model,
+        lora_rank=0,
+    )
+    print(f"[distill] student: d{s_cfg.d_model} L{s_cfg.n_layers}")
+
+    batch_at = None
+    if args.data_dir:
+        from tpulab.io.bpe import corpus_from_dir
+
+        corpus = corpus_from_dir(args.data_dir)
+        ids = (tok.encode(corpus) if tok is not None
+               else np.frombuffer(corpus, np.uint8).astype(np.int32))
+        if len(ids) < args.seq + 1:
+            raise SystemExit(f"corpus encodes to {len(ids)} tokens; "
+                             f"need >= {args.seq + 1}")
+
+        from tpulab.train import corpus_windows
+
+        batch_at = corpus_windows(ids, args.batch, args.seq, args.seed)
+
+    student, loss = distill(
+        teacher, t_cfg, s_cfg, steps=args.steps, batch=args.batch,
+        seq=args.seq, seed=args.seed, temperature=args.temperature,
+        alpha=args.alpha, batch_at=batch_at,
+    )
+
+    # servable student checkpoint: trainer snapshot layout + sidecar
+    import orbax.checkpoint as ocp
+
+    mgr = ocp.CheckpointManager(out)
+    mgr.save(args.steps, args=ocp.args.Composite(
+        state=ocp.args.StandardSave({"params": student})))
+    mgr.wait_until_finished()
+    sidecar = {"model": "labformer", "config": cfg_to_dict(s_cfg)}
+    if tok is not None:
+        # the teacher sidecar records the tokenizer FILENAME (the
+        # sidecar contract allows any name); copy that file, not a
+        # hardcoded guess
+        with open(os.path.join(teacher_dir, "tpulab_config.json")) as f:
+            tok_name = json.load(f).get("tokenizer", "tokenizer.json")
+        shutil.copyfile(os.path.join(teacher_dir, tok_name),
+                        os.path.join(out, "tokenizer.json"))
+        sidecar["tokenizer"] = "tokenizer.json"
+    with open(os.path.join(out, "tpulab_config.json"), "w") as f:
+        json.dump(sidecar, f, indent=2)
+    print(json.dumps({"out": out, "final_loss": round(loss, 4),
+                      "student_layers": s_cfg.n_layers,
+                      "student_d_model": s_cfg.d_model}))
+    return 0

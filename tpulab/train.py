@@ -36,6 +36,18 @@ from typing import Optional
 import numpy as np
 
 
+def corpus_windows(src: np.ndarray, batch: int, seq: int, seed: int):
+    """Deterministic random-window sampler over a token array — THE one
+    implementation shared by the trainer's encoded-corpus stream, its
+    held-out eval, and `tpulab distill --data-dir` (copies drifted)."""
+    def batch_at(step: int) -> np.ndarray:
+        rng = np.random.default_rng((seed << 20) ^ step)
+        starts = rng.integers(0, len(src) - seq, batch)
+        return np.stack([src[s:s + seq + 1] for s in starts])
+
+    return batch_at
+
+
 def batches(vocab: int, batch: int, seq: int, seed: int):
     """Deterministic infinite batch stream, indexable by step."""
     def batch_at(step: int) -> np.ndarray:
@@ -421,13 +433,7 @@ def train(
                 )
             train_ids, val_ids = ids[:-hold], ids[-hold:]
 
-            def _windows(src: np.ndarray, rng, rows: int) -> np.ndarray:
-                starts = rng.integers(0, len(src) - seq, rows)
-                return np.stack([src[s:s + seq + 1] for s in starts])
-
-            def batch_at(step: int) -> np.ndarray:
-                rng = np.random.default_rng((seed << 20) ^ step)
-                return _windows(train_ids, rng, batch)
+            batch_at = corpus_windows(train_ids, batch, seq, seed)
         elif data_dir:
             from tpulab.io.loader import TokenLoader
 
@@ -453,16 +459,16 @@ def train(
             # validation windows come from the held-out corpus TAIL (the
             # training sampler never sees it), keyed by the train step
             # so resumed runs replay identical validation windows
+            val_at = corpus_windows(val_ids, batch, seq, seed + 104729)
+
             def eval_loss(params, step: int = 0):
                 n_eval = step // eval_every if eval_every else 0
-                tot = 0.0
-                for j in range(eval_batches):
-                    rng = np.random.default_rng(
-                        ((seed + 104729) << 20) ^ (n_eval * eval_batches + j)
-                    )
-                    tot += float(_eval_fn(params, _windows(val_ids, rng, batch),
-                                          cfg, mesh))
-                return tot / eval_batches
+                return sum(
+                    float(_eval_fn(params,
+                                   val_at(n_eval * eval_batches + j),
+                                   cfg, mesh))
+                    for j in range(eval_batches)
+                ) / eval_batches
         elif data_dir:
             # validation from the SAME corpus, different sampling seed:
             # fresh random windows the training stream almost surely

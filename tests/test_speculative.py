@@ -126,3 +126,54 @@ class TestSpeculative:
                 init_params(a), a, init_params(CFG), CFG,
                 np.zeros((1, 3), np.int32), steps=4,
             )
+
+
+class TestPromptLookup:
+    """Draft-free speculation: n-gram proposals from the committed
+    sequence, verified through the same windowed target pass."""
+
+    def test_lossless_vs_plain_greedy(self, trained_small,
+                                      trained_small_cfg):
+        from tpulab.models.generate import generate
+        from tpulab.models.speculative import prompt_lookup_generate
+
+        # period-7 cycle — the exact pattern trained_small was trained
+        # on, so the continuation repeats it and lookups extend right
+        prompt = np.tile(np.arange(7, dtype=np.int32), 3)[None, :]
+        want = generate(trained_small, prompt, trained_small_cfg,
+                        steps=24, temperature=0.0)
+        got, acc = prompt_lookup_generate(trained_small, trained_small_cfg,
+                                          prompt, steps=24, k=4)
+        assert np.array_equal(got, np.asarray(want))
+        assert acc > 1.0, acc
+
+    def test_lossless_on_nonrepetitive_prompt(self, trained_small,
+                                              trained_small_cfg):
+        from tpulab.models.generate import generate
+        from tpulab.models.speculative import prompt_lookup_generate
+
+        rng = np.random.default_rng(3)
+        prompt = rng.integers(0, 7, (1, 11)).astype(np.int32)
+        want = generate(trained_small, prompt, trained_small_cfg,
+                        steps=12, temperature=0.0)
+        got, acc = prompt_lookup_generate(trained_small, trained_small_cfg,
+                                          prompt, steps=12, k=3, ngram=4)
+        assert np.array_equal(got, np.asarray(want))  # acc may be ~0
+
+    def test_ngram_validation(self, trained_small, trained_small_cfg):
+        from tpulab.models.speculative import prompt_lookup_generate
+
+        with pytest.raises(ValueError, match="ngram"):
+            prompt_lookup_generate(trained_small, trained_small_cfg,
+                                   np.zeros((1, 4), np.int32), ngram=0)
+
+    def test_lookup_propose_semantics(self):
+        from tpulab.models.speculative import _lookup_propose
+
+        hist = np.array([1, 2, 3, 9, 9, 1, 2, 3], np.int32)
+        # last 3 = [1,2,3]; earlier match at 0 -> continuation [9, 9, 1]
+        got = _lookup_propose(hist, k=3, ngram=3)
+        assert got.tolist() == [9, 9, 1]
+        # no match -> repeat last token
+        got = _lookup_propose(np.array([1, 2, 3, 4], np.int32), 2, 3)
+        assert got.tolist() == [4, 4]

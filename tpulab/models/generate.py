@@ -438,6 +438,11 @@ def main(argv=None) -> int:
                          "tokens as plain greedy)")
     ap.add_argument("--draft-k", type=int, default=4,
                     help="draft tokens proposed per verify round")
+    ap.add_argument("--prompt-lookup", action="store_true",
+                    help="draft-FREE greedy speculative decoding: "
+                         "n-gram proposals from the committed sequence "
+                         "(lossless; shines on self-repeating text)")
+    ap.add_argument("--lookup-ngram", type=int, default=3)
     ap.add_argument("--beams", type=int, default=0,
                     help="beam search width (0 = off; deterministic, "
                          "exclusive with sampling and --speculative)")
@@ -497,13 +502,14 @@ def main(argv=None) -> int:
     prompt = (tok.encode(raw)[None, :] if tok is not None
               else np.frombuffer(raw, np.uint8)[None, :]).astype(np.int32)
     if args.beams:
-        if args.speculative or args.temperature not in (0.0, 1.0) \
+        if args.speculative or args.prompt_lookup \
+                or args.temperature not in (0.0, 1.0) \
                 or args.top_k or args.top_p != 1.0 \
                 or args.repetition_penalty != 1.0 or args.stop_byte >= 0:
             raise SystemExit(
                 "--beams is deterministic; drop --speculative/"
-                "--temperature/--top-k/--top-p/--repetition-penalty/"
-                "--stop-byte"
+                "--prompt-lookup/--temperature/--top-k/--top-p/"
+                "--repetition-penalty/--stop-byte"
             )
         if not 1 <= args.beams <= cfg.vocab:
             raise SystemExit(
@@ -517,6 +523,24 @@ def main(argv=None) -> int:
         print(f"[beam] width {args.beams}, total log-prob {score:.3f}",
               file=sys.stderr)
         out = seq[None, :]
+    elif args.prompt_lookup:
+        if args.speculative or args.temperature not in (0.0, 1.0) \
+                or args.top_k or args.top_p != 1.0 \
+                or args.repetition_penalty != 1.0 or args.stop_byte >= 0:
+            raise SystemExit(
+                "--prompt-lookup decodes greedily (lossless); drop "
+                "--speculative/--temperature/--top-k/--top-p/"
+                "--repetition-penalty/--stop-byte")
+        if args.lookup_ngram < 1:
+            raise SystemExit(
+                f"--lookup-ngram must be >= 1, got {args.lookup_ngram}")
+        from tpulab.models.speculative import prompt_lookup_generate
+
+        out, acc = prompt_lookup_generate(
+            params, cfg, prompt, steps=args.steps, k=args.draft_k,
+            ngram=args.lookup_ngram)
+        print(f"[prompt-lookup] mean accepted {acc:.2f}/{args.draft_k} "
+              f"per round", file=sys.stderr)
     elif args.speculative:
         # greedy-only: refuse explicitly-requested sampling rather than
         # silently dropping it (temperature 0 IS greedy — honor it)

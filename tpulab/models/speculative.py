@@ -83,6 +83,53 @@ def _target_verify(params, window, k_caches, v_caches, pos, cfg):
     return jnp.argmax(logits, axis=-1).astype(jnp.int32), k_caches, v_caches
 
 
+
+def _spec_loop(params, cfg, t_kc, t_vc, committed, start_pos: int,
+               steps: int, k: int, propose, on_commit=None):
+    """THE verify/accept/commit loop shared by model-drafted and
+    prompt-lookup speculation (copies drifted on the coalesced-fetch
+    optimization).  ``propose(committed, pos) -> (b, k)`` drafts —
+    device OR host array (``jax.device_get`` passes numpy through, so
+    the one-coalesced-fetch discipline holds either way);
+    ``on_commit(emitted)`` observes each round's committed tokens (the
+    lookup proposer grows its history with them)."""
+    out = [np.asarray(committed)[:, None]]
+    n_out = 1
+    pos = start_pos
+    accepted_counts = []
+    while n_out < steps:
+        drafts = propose(committed, pos)
+        window = jnp.concatenate(
+            [committed[:, None], jnp.asarray(drafts)], axis=1)
+        choices, t_kc, t_vc = _target_verify(params, window, t_kc, t_vc,
+                                             pos, cfg)
+        # ONE coalesced fetch: on the tunneled TPU each blocking
+        # transfer pays the full host round-trip, and the per-round
+        # fetch is the loop's latency floor
+        drafts_np, choices_np = jax.device_get((drafts, choices))
+        # batch-wide acceptance: the window is shared across the batch,
+        # so commit the longest prefix accepted by EVERY row (per-row
+        # divergence would need per-row positions; batch=1 serving gets
+        # the full per-stream rate)
+        agree = drafts_np == choices_np[:, :k]
+        m = 0
+        while m < k and bool(agree[:, m].all()):
+            m += 1
+        accepted_counts.append(m)
+        # commit d_1..d_m plus the target's token after that prefix
+        emitted = np.concatenate(
+            [drafts_np[:, :m], choices_np[:, m][:, None]], axis=1)
+        out.append(emitted)
+        if on_commit is not None:
+            on_commit(emitted)
+        n_out += m + 1
+        pos += m + 1
+        committed = jnp.asarray(emitted[:, -1])
+    tokens = np.concatenate(out, axis=1)[:, :steps]
+    mean_acc = float(np.mean(accepted_counts)) if accepted_counts else 0.0
+    return tokens, mean_acc
+
+
 def speculative_generate(
     draft_params,
     draft_cfg: LabformerConfig,
@@ -121,39 +168,91 @@ def speculative_generate(
     _, d_kc, d_vc = _prefill_jit(draft_params, prompt_j, draft_cfg, cache_len)
     committed = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)  # (b,)
 
-    out = [np.asarray(committed)[:, None]]
-    n_out = 1
-    pos = p  # position of `committed` in the sequence
-    accepted_counts = []
-    while n_out < steps:
-        drafts, d_kc, d_vc = _draft_propose(
-            draft_params, committed, d_kc, d_vc, pos, draft_cfg, k
+    state = {"kc": d_kc, "vc": d_vc}
+
+    def propose(committed, pos):
+        drafts, state["kc"], state["vc"] = _draft_propose(
+            draft_params, committed, state["kc"], state["vc"], pos,
+            draft_cfg, k
         )
-        window = jnp.concatenate([committed[:, None], drafts], axis=1)
-        choices, t_kc, t_vc = _target_verify(
-            target_params, window, t_kc, t_vc, pos, target_cfg
+        return drafts
+
+    return _spec_loop(target_params, target_cfg, t_kc, t_vc, committed,
+                      p, steps, k, propose)
+
+
+def _lookup_propose(history: np.ndarray, k: int, ngram: int) -> np.ndarray:
+    """Draft-free proposal (prompt-lookup decoding): find the most
+    recent earlier occurrence of the last ``ngram`` committed tokens
+    and propose the ``k`` tokens that followed it.  No match (or a
+    short continuation) pads by repeating the last token — bad
+    proposals cost nothing but their rejected verify slots."""
+    n = len(history)
+    fill = np.full(k, history[-1], np.int32)
+    if n <= ngram:
+        return fill
+    key = history[n - ngram:]
+    # vectorized match (one C pass; a Python scan is O(n*ngram) per
+    # round and grows quadratic over a long generation), excluding the
+    # trailing self-match
+    windows = np.lib.stride_tricks.sliding_window_view(
+        history[:-1], ngram)
+    hits = np.nonzero((windows == key).all(axis=1))[0]
+    # window starts run 0..n-1-ngram: the trailing self-match is already
+    # excluded, and OVERLAPPING earlier matches stay eligible (they are
+    # exactly what fires on short-period text)
+    if hits.size == 0:
+        return fill
+    i = int(hits[-1])  # most recent earlier occurrence
+    cont = history[i + ngram: i + ngram + k]
+    if len(cont) < k:
+        cont = np.concatenate([cont, fill[: k - len(cont)]])
+    return cont.astype(np.int32)
+
+
+def prompt_lookup_generate(
+    params,
+    cfg: LabformerConfig,
+    prompt: np.ndarray,
+    steps: int = 64,
+    k: int = 4,
+    ngram: int = 3,
+) -> Tuple[np.ndarray, float]:
+    """Draft-FREE greedy speculative decoding (prompt lookup): proposals
+    come from n-gram matches against the already-committed sequence —
+    no second model, no draft cache — verified through the same
+    windowed target pass as :func:`speculative_generate`, so the output
+    is bit-identical to plain greedy decoding.
+
+    Pays off on text that repeats its own spans (code, templated logs,
+    chat with quoting): every n-gram hit that extends correctly commits
+    k+1 tokens for one target pass.  Returns ``(tokens (b, steps),
+    mean_accepted)``.
+    """
+    if cfg.lora_rank:
+        raise ValueError(
+            "prompt_lookup_generate with lora_rank > 0: fold the "
+            "adapters first (labformer.merge_lora(params, cfg))"
         )
-        # ONE coalesced fetch: on the tunneled TPU each blocking
-        # transfer pays the full host round-trip, and the per-round
-        # fetch is the loop's latency floor
-        drafts_np, choices_np = jax.device_get((drafts, choices))
-        # batch-wide acceptance: the window is shared across the batch,
-        # so commit the longest prefix accepted by EVERY row (per-row
-        # divergence would need per-row positions; batch=1 serving gets
-        # the full per-stream rate)
-        agree = drafts_np == choices_np[:, :k]
-        m = 0
-        while m < k and bool(agree[:, m].all()):
-            m += 1
-        accepted_counts.append(m)
-        # commit d_1..d_m plus the target's token after that prefix
-        emitted = np.concatenate(
-            [drafts_np[:, :m], choices_np[:, m][:, None]], axis=1
-        )
-        out.append(emitted)
-        n_out += m + 1
-        pos += m + 1
-        committed = jnp.asarray(emitted[:, -1])
-    tokens = np.concatenate(out, axis=1)[:, :steps]
-    mean_acc = float(np.mean(accepted_counts)) if accepted_counts else 0.0
-    return tokens, mean_acc
+    if ngram < 1:
+        raise ValueError(f"ngram must be >= 1, got {ngram}")
+    prompt = np.asarray(prompt, np.int32)
+    b, p = prompt.shape
+    cache_len = p + steps + k + 2
+    t_logits, t_kc, t_vc = _prefill_jit(params, jnp.asarray(prompt), cfg,
+                                        cache_len)
+    committed = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)  # (b,)
+
+    history = [np.concatenate([prompt[r], np.asarray(committed)[r:r + 1]])
+               for r in range(b)]
+
+    def propose(committed_, pos_):
+        return np.stack([_lookup_propose(history[r], k, ngram)
+                         for r in range(b)])
+
+    def on_commit(emitted):
+        for r in range(b):
+            history[r] = np.concatenate([history[r], emitted[r]])
+
+    return _spec_loop(params, cfg, t_kc, t_vc, committed, p, steps, k,
+                      propose, on_commit)

@@ -600,3 +600,21 @@ class TestDaemonSamplingControls:
                                      "repetition_penalty": -1.0}}).encode()
         status, out = _raw_request(daemon, hdr, b"hi")
         assert status == 1 and "repetition_penalty" in out
+
+
+class TestDaemonPromptLookup:
+    def test_prompt_lookup_over_wire_is_lossless(self, daemon):
+        plain = _raw_request_bytes(
+            daemon, b'{"lab": "generate", "config": {"steps": 8}}', b"lkp")
+        lkp = _raw_request_bytes(
+            daemon,
+            b'{"lab": "generate", "config": {"steps": 8, '
+            b'"prompt_lookup": true}}',
+            b"lkp")
+        assert plain[0] == 0 and lkp[0] == 0
+        assert lkp[1] == plain[1]
+        status, err = _raw_request(
+            daemon,
+            b'{"lab": "generate", "config": {"steps": 2, '
+            b'"prompt_lookup": true, "speculative": true}}', b"x")
+        assert status == 1 and "greedy" in err

@@ -403,7 +403,9 @@ def _handle_generate(header: dict, payload: bytes,
     (finish right after emitting it; -1 = off), ``stream`` (status-2
     chunk frames), ``attn``/``kv_dtype`` (engine knobs), and
     ``speculative`` + ``draft_k`` (lossless greedy speculative decode
-    with a lazily-built int8 draft — same bytes as plain greedy), and
+    with a lazily-built int8 draft — same bytes as plain greedy),
+    ``prompt_lookup`` + ``lookup_ngram`` (draft-FREE lossless
+    speculation: n-gram proposals from the committed sequence), and
     ``beams`` (beam search; beams=1 == greedy)."""
     import numpy as np
 
@@ -440,11 +442,18 @@ def _handle_generate(header: dict, payload: bytes,
         raise ValueError(
             "speculative decoding is greedy and unstreamed: drop "
             "temperature/repetition_penalty/stream")
+    if bool(config.get("prompt_lookup")) and (
+        deterministic_combo or bool(config.get("speculative"))
+    ):
+        raise ValueError(
+            "prompt_lookup decoding is greedy and unstreamed: drop "
+            "temperature/repetition_penalty/stream/speculative")
     if beams and (deterministic_combo or bool(config.get("speculative"))
-                  or stop_byte >= 0):
+                  or bool(config.get("prompt_lookup")) or stop_byte >= 0):
         raise ValueError(
             "beam search is deterministic and unstreamed: drop "
-            "temperature/repetition_penalty/stream/speculative/stop_byte")
+            "temperature/repetition_penalty/stream/speculative/"
+            "prompt_lookup/stop_byte")
     if beams < 0:
         raise ValueError(f"beams must be >= 0, got {beams}")
     engine, tok = _engine_for(config.get("ckpt_dir"), attn, kv_dtype)
@@ -459,6 +468,29 @@ def _handle_generate(header: dict, payload: bytes,
         # inside a larger token
         prompt = tok.encode(bytes(payload))
         eng_stop = -1
+
+    def _single_stream(k, fn):
+        """Common scaffold for the host-orchestrated strategies:
+        validate k + the serving-length policy, serialize on the spec
+        lock, run, decode bytes, trim at the stop byte (the engine
+        semantics: the stop byte is the final emitted byte)."""
+        if k < 1:
+            raise ValueError(f"draft_k must be >= 1, got {k}")
+        if len(prompt) + steps + k + 2 > _SERVE_MAX_SEQ:
+            raise ValueError(
+                f"prompt + steps + draft_k + 2 = "
+                f"{len(prompt) + steps + k + 2} exceeds the daemon "
+                f"serving cap {_SERVE_MAX_SEQ}")
+        with _SPEC_LOCK:
+            out, _acc = fn(k)
+        toks = [int(t) for t in np.asarray(out[0])]
+        data = (bytes(t & 0xFF for t in toks) if tok is None
+                else tok.decode(toks))
+        if stop_byte >= 0:
+            cut = data.find(bytes([stop_byte]))
+            if cut >= 0:
+                data = data[: cut + 1]
+        return data
 
     if beams:
         # beam search: host backtrack over a cache-reordering scan —
@@ -482,53 +514,41 @@ def _handle_generate(header: dict, payload: bytes,
             return bytes(t & 0xFF for t in toks)
         return tok.decode(toks)
 
+    if bool(config.get("prompt_lookup")):
+        # draft-free speculation: n-gram proposals from the committed
+        # sequence, verified by the target — lossless vs plain greedy,
+        # no draft build at all.
+        ngram = int(config.get("lookup_ngram", 3))
+        if ngram < 1:
+            raise ValueError(f"lookup_ngram must be >= 1, got {ngram}")
+        from tpulab.models.speculative import prompt_lookup_generate
+
+        return _single_stream(
+            int(config.get("draft_k", 4)),
+            lambda k: prompt_lookup_generate(
+                engine.params, engine.cfg, prompt[None, :], steps=steps,
+                k=k, ngram=ngram))
+
     if bool(config.get("speculative")):
         # lossless greedy speculative decoding: the engine's (merged)
         # params serve as target, an int8-quantized copy drafts.  Host-
-        # orchestrated (no continuous batching) — concurrent spec
+        # orchestrated (no continuous batching) — concurrent strategy
         # requests serialize on one lock instead of thrashing the
-        # device with interleaved host loops.  A stop_byte trims
-        # post-hoc (the full loop still runs — the standalone
-        # speculative path has no early-stop plumbing; known cost).
-        # The sampling-combo refusal already ran pre-engine-build.
+        # device with interleaved host loops.  The sampling-combo
+        # refusal already ran pre-engine-build.
         if engine.cfg.n_experts:
             raise ValueError(
                 "speculative decoding needs an int8 draft; MoE "
                 "checkpoints are not quantizable (models/quant.py)")
-        k = int(config.get("draft_k", 4))
-        if k < 1:
-            # a negative k would shrink the dense cache BELOW the
-            # prompt length — JAX clamps the out-of-bounds scatters
-            # silently and the daemon would return garbage with rc 0
-            raise ValueError(f"draft_k must be >= 1, got {k}")
-        cap = _SERVE_MAX_SEQ
-        if len(prompt) + steps + k + 2 > cap:
-            # the plain path's PagedEngine.submit enforces this bound;
-            # the dense speculative caches must honor the same policy
-            # instead of allocating an unbounded cache under the lock
-            raise ValueError(
-                f"prompt + steps + draft_k + 2 = "
-                f"{len(prompt) + steps + k + 2} exceeds the daemon "
-                f"serving cap {cap}")
         from tpulab.models.speculative import speculative_generate
 
-        with _SPEC_LOCK:
+        def run(k):
             draft = _draft_for(engine)
-            out, acc = speculative_generate(
+            return speculative_generate(
                 draft, engine.cfg, engine.params, engine.cfg,
-                prompt[None, :], steps=steps, k=k,
-            )
-        toks = [int(t) for t in np.asarray(out[0])]
-        if tok is None:
-            data = bytes(t & 0xFF for t in toks)
-        else:
-            data = tok.decode(toks)
-        if stop_byte >= 0:
-            cut = data.find(bytes([stop_byte]))
-            if cut >= 0:
-                data = data[: cut + 1]  # engine semantics: stop byte
-                # is the final emitted byte
-        return data
+                prompt[None, :], steps=steps, k=k)
+
+        return _single_stream(int(config.get("draft_k", 4)), run)
 
     on_progress = None
     if send_chunk is not None and bool(config.get("stream")):

@@ -442,7 +442,9 @@ def main(argv=None) -> int:
                     help="draft-FREE greedy speculative decoding: "
                          "n-gram proposals from the committed sequence "
                          "(lossless; shines on self-repeating text)")
-    ap.add_argument("--lookup-ngram", type=int, default=3)
+    ap.add_argument("--lookup-ngram", type=int, default=3,
+                    help="n-gram length the lookup proposer matches "
+                         "against the committed sequence")
     ap.add_argument("--beams", type=int, default=0,
                     help="beam search width (0 = off; deterministic, "
                          "exclusive with sampling and --speculative)")
@@ -498,19 +500,23 @@ def main(argv=None) -> int:
             f"--stop-byte must be a byte in [0, {stop_limit - 1}] (or -1 "
             f"= off); got {args.stop_byte}"
         )
+    def _refuse_sampling_flags(what: str, *extra: str):
+        """One exclusivity rule for every deterministic strategy: a
+        sampling flag must refuse loudly, never be silently dropped."""
+        if (args.temperature not in (0.0, 1.0) or args.top_k
+                or args.top_p != 1.0 or args.repetition_penalty != 1.0
+                or args.stop_byte >= 0
+                or any(getattr(args, e.replace("-", "_")) for e in extra)):
+            raise SystemExit(
+                f"{what} is deterministic; drop --temperature/--top-k/"
+                f"--top-p/--repetition-penalty/--stop-byte"
+                + "".join(f"/--{e}" for e in extra))
+
     raw = args.prompt.encode("utf-8")
     prompt = (tok.encode(raw)[None, :] if tok is not None
               else np.frombuffer(raw, np.uint8)[None, :]).astype(np.int32)
     if args.beams:
-        if args.speculative or args.prompt_lookup \
-                or args.temperature not in (0.0, 1.0) \
-                or args.top_k or args.top_p != 1.0 \
-                or args.repetition_penalty != 1.0 or args.stop_byte >= 0:
-            raise SystemExit(
-                "--beams is deterministic; drop --speculative/"
-                "--prompt-lookup/--temperature/--top-k/--top-p/"
-                "--repetition-penalty/--stop-byte"
-            )
+        _refuse_sampling_flags("--beams", "speculative", "prompt-lookup")
         if not 1 <= args.beams <= cfg.vocab:
             raise SystemExit(
                 f"--beams must be in [1, {cfg.vocab}] (vocab size), "
@@ -524,13 +530,9 @@ def main(argv=None) -> int:
               file=sys.stderr)
         out = seq[None, :]
     elif args.prompt_lookup:
-        if args.speculative or args.temperature not in (0.0, 1.0) \
-                or args.top_k or args.top_p != 1.0 \
-                or args.repetition_penalty != 1.0 or args.stop_byte >= 0:
-            raise SystemExit(
-                "--prompt-lookup decodes greedily (lossless); drop "
-                "--speculative/--temperature/--top-k/--top-p/"
-                "--repetition-penalty/--stop-byte")
+        _refuse_sampling_flags("--prompt-lookup", "speculative")
+        if args.draft_k < 1:
+            raise SystemExit(f"--draft-k must be >= 1, got {args.draft_k}")
         if args.lookup_ngram < 1:
             raise SystemExit(
                 f"--lookup-ngram must be >= 1, got {args.lookup_ngram}")
@@ -544,14 +546,9 @@ def main(argv=None) -> int:
     elif args.speculative:
         # greedy-only: refuse explicitly-requested sampling rather than
         # silently dropping it (temperature 0 IS greedy — honor it)
-        if args.temperature not in (0.0, 1.0) or args.top_k \
-                or args.top_p != 1.0 or args.repetition_penalty != 1.0 \
-                or args.stop_byte >= 0:
-            raise SystemExit(
-                "--speculative decodes greedily (lossless vs the target's "
-                "greedy stream); drop --temperature/--top-k/--top-p/"
-                "--repetition-penalty/--stop-byte"
-            )
+        _refuse_sampling_flags("--speculative")
+        if args.draft_k < 1:
+            raise SystemExit(f"--draft-k must be >= 1, got {args.draft_k}")
         from tpulab.models.quant import quantize_decode_params
         from tpulab.models.speculative import speculative_generate
 

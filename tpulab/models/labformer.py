@@ -18,9 +18,12 @@ capability its multi-device trajectory points at, built TPU-native:
   stage boundaries as GSPMD collective-permutes.
 * **ep** — expert parallelism: MoE expert weights shard over the fused
   ``(dp, sp)`` submesh (DeepSpeed-MoE style — experts ride the data
-  axes, no dedicated mesh dimension).  Routing is exact top-1 (switch);
-  every expert computes densely and a one-hot gate selects — no token
-  dropping, bit-stable under resharding.
+  axes, no dedicated mesh dimension).  Routing is exact top-k
+  (``moe_top_k``: switch semantics at k=1, GShard-renormalized
+  combination at k>1); the dense path computes every expert and
+  gate-combines — no token dropping, bit-stable under resharding —
+  while ``moe_impl="dispatch"`` routes through all_to_all with
+  capacity (tpulab.parallel.moe).
 
 Parameters are a plain pytree (stacked ``(L, ...)`` leaves); shardings
 are :class:`jax.sharding.NamedSharding` rules applied by tree-matching

@@ -55,7 +55,7 @@ class LabformerConfig:
     n_heads: int = 8
     n_layers: int = 4
     d_ff: int = 512
-    n_experts: int = 0        # 0 => dense MLP; >0 => top-1 switch MoE
+    n_experts: int = 0        # 0 => dense MLP; >0 => top-k MoE (moe_top_k)
     max_seq: int = 1024
     # grouped-query attention: 0 => n_heads (MHA); else the number of
     # shared K/V heads — wk/wv params and the decode KV cache shrink by

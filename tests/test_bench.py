@@ -185,3 +185,17 @@ def test_stream_registry_suppresses_headline_row(capsys):
     out = [_json.loads(ln) for ln in capsys.readouterr().out.splitlines()]
     assert {"metric": "other", "value": 2} in out
     assert not any(r.get("metric", "").startswith("lab2_roberts") for r in out)
+
+
+def test_bench_cli_streams_rows(monkeypatch, capsys):
+    """`tpulab bench --only X` coerces kwargs and streams JSON rows."""
+    import tpulab.bench as tb
+    from tpulab.cli.bench import run_bench_cli
+
+    monkeypatch.setattr(tb, "bench_sort",
+                        lambda reps=0, **kw: {"metric": "s", "value": reps})
+    rc = run_bench_cli(["--only", "hw2_sort", "--reps", "3"])
+    import json as _json
+
+    rows = [_json.loads(l) for l in capsys.readouterr().out.splitlines()]
+    assert rc == 0 and rows == [{"metric": "s", "value": 3}]

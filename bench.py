@@ -387,7 +387,8 @@ def main(argv=None) -> int:
             "unit": row["unit"],
             "vs_baseline": row["vs_baseline"],
         }
-        for k in ("min_ms", "p25_ms", "p75_ms", "iqr_ms", "n_trials"):
+        for k in ("min_ms", "p25_ms", "p75_ms", "iqr_ms", "n_trials",
+                  "resolution_ms", "device"):
             if k in row:
                 headline[k] = row[k]
     print(json.dumps(headline), flush=True)

@@ -34,6 +34,26 @@ def test_training_deterministic_and_compresses():
     assert n < len(corpus) / 2, (n, len(corpus))
 
 
+def test_encode_prefilter_matches_naive_pass_per_merge():
+    """The membership pre-filter (skip merges whose ids are absent) must
+    be a pure optimization: output identical to one _apply_merge pass
+    per learned merge in rank order, on bytes the tokenizer never saw."""
+    from tpulab.io.bpe import _apply_merge
+
+    tok = train_bpe(b"the quick brown fox. " * 300 + b"abcabc" * 100,
+                    vocab=360)
+    rng = np.random.default_rng(3)
+    for data in (b"the fox abc", rng.integers(0, 256, 500,
+                                              dtype=np.uint8).tobytes(),
+                 b"", b"q", b"the quick brown fox. " * 7):
+        naive = np.frombuffer(data, np.uint8).astype(np.int32)
+        for rank, (a, b) in enumerate(tok.merges):
+            if len(naive) < 2:
+                break
+            naive = _apply_merge(naive, a, b, 256 + rank)
+        np.testing.assert_array_equal(tok.encode(data), naive)
+
+
 def test_merge_priority_order():
     # 'ab' dominates, then 'abab' (as merged-id pairs): encode must
     # apply the earlier merge everywhere before later ones
@@ -183,9 +203,13 @@ def test_stop_byte_found_inside_merged_tokens(tmp_path, capsys, monkeypatch):
     rc = gen_cli.main(["--tokenizer", tokp, "--steps", "4",
                        "--temperature", "0", "--prompt", "Q",
                        "--stop-byte", "10"])
-    out = capsys.readouterr().out.splitlines()[-1]
+    # the stop byte is KEPT (engine contract: it is the final token), so
+    # the output line ends exactly at the newline hidden inside nl_tok —
+    # take the line that carries the prompt, not the empty tail line
+    out = [l for l in capsys.readouterr().out.splitlines()
+           if l.startswith("Q")][-1]
     assert rc in (0, None)
-    # output = "Q" + "x" + (pre-newline part of nl_tok); 'y'/'z' trimmed
+    # output = "Q" + "x" + (thru-newline part of nl_tok); 'y'/'z' trimmed
     assert out.startswith("Qx") and "y" not in out and "z" not in out
 
 

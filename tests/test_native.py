@@ -526,6 +526,70 @@ class TestDaemonGenerate:
         assert out == want
 
 
+    def test_generate_tp_mesh_over_wire(self, daemon, tmp_path_factory):
+        """Daemon-on-mesh: ``{"tp": 2}`` builds the checkpoint's engine
+        GSPMD-partitioned over a 2-device mesh; two CONCURRENT clients
+        read bytes identical to the single-device engine (round-4
+        verdict, stretch #9 — the serving WIRE on a mesh, not just the
+        engine)."""
+        import concurrent.futures as cf
+        import json as _json
+
+        from tpulab.models.generate import load_params
+        from tpulab.models.labformer import LabformerConfig, cfg_from_dict
+        from tpulab.models.paged import PagedEngine
+        from tpulab.train import train
+
+        work = tmp_path_factory.mktemp("tpwire")
+        ck = str(work / "ck")
+        # trained weights: untrained argmax ties would flip under GSPMD
+        # partial-sum reordering and void the tp-vs-single comparison
+        cfg = LabformerConfig(d_model=32, n_heads=4, n_kv_heads=2,
+                              n_layers=2, d_ff=64, max_seq=32)
+        train(steps=30, batch=4, seq=16, cfg=cfg, ckpt_dir=ck,
+              save_every=30, log=lambda *a: None)
+
+        header = _json.dumps({
+            "lab": "generate",
+            "config": {"steps": 6, "ckpt_dir": ck, "tp": 2},
+        }).encode()
+
+        with cf.ThreadPoolExecutor(2) as ex:
+            futs = [ex.submit(_raw_request_bytes, daemon, header, b"ab")
+                    for _ in range(2)]
+            results = [f.result(timeout=300) for f in futs]
+
+        sc = _json.loads((pathlib.Path(ck) / "tpulab_config.json").read_text())
+        oc = cfg_from_dict(sc["config"])
+        params, _ = load_params(oc, ck)
+        eng = PagedEngine(params, oc, slots=4, n_blocks=128, block_size=16,
+                          max_seq=512)
+        rid = eng.submit(np.frombuffer(b"ab", np.uint8).astype(np.int32),
+                         max_new=6)
+        want = bytes(int(t) & 0xFF for t in eng.run()[rid])
+        for status, out in results:
+            assert status == 0 and out == want, (status, out, want)
+
+    def test_generate_tp_rejected_cleanly(self, daemon):
+        """tp config errors come back as error frames BEFORE any engine
+        build: tp < 1, tp > device count, and mesh-incompatible knobs."""
+        for cfg_d, msg in (
+            ({"tp": 0}, b"tp must be >= 1"),
+            ({"tp": 4096}, b"devices"),
+            ({"tp": 2, "attn": "pallas"}, b"mesh serving"),
+            ({"tp": 2, "kv_dtype": "int8"}, b"mesh serving"),
+            ({"tp": 2, "beams": 2}, b"engine decode path"),
+            ({"tp": 2, "speculative": True}, b"engine decode path"),
+            ({"tp": 2, "prompt_lookup": True}, b"engine decode path"),
+        ):
+            import json as _json
+
+            h = _json.dumps({"lab": "generate",
+                             "config": {"steps": 2, **cfg_d}}).encode()
+            status, out = _raw_request_bytes(daemon, h, b"x")
+            assert status == 1 and msg in out, (cfg_d, status, out)
+
+
 class TestDaemonConcurrency:
     """Per-connection threads + the shared-engine stepper: concurrent
     generate clients batch through ONE decode loop."""

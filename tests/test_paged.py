@@ -429,6 +429,31 @@ def test_stop_byte_finishes_early_and_frees_slot(trained):
                  temperature=0.0)[0])
 
 
+def test_service_on_progress_early_cancel_frees_slot(trained):
+    """A streaming consumer that reports 'done' (on_progress returns
+    truthy — e.g. the BPE-decoded stop byte already went out) cancels
+    the request: the call returns the tokens so far instead of decoding
+    the full budget, and the slot + blocks recycle (round-4 advisor)."""
+    from tpulab.daemon import _GenerateService
+
+    svc = _GenerateService()
+    eng = PagedEngine(trained, CFG, slots=1, n_blocks=16, block_size=8,
+                      max_seq=64)
+    free0 = len(eng.free)
+    ticks = []
+
+    def on_progress(inc):
+        ticks.append(list(inc))
+        return len(ticks) >= 2  # consumer satisfied after 2 ticks
+
+    out = svc.generate(eng, _cycle_prompt(4), 48, on_progress=on_progress)
+    assert 2 <= len(out) < 48, len(out)  # cancelled well short of budget
+    # the request finished through the NORMAL path, so by the time
+    # generate() returned the stepper had already freed slot + blocks
+    assert all(r is None for r in eng.active)
+    assert len(eng.free) == free0, "blocks not fully recycled"
+
+
 def test_engine_rejects_bad_penalty_and_stop(trained):
     eng = PagedEngine(trained, CFG, slots=1, n_blocks=16, block_size=8,
                       max_seq=64)

@@ -33,7 +33,12 @@ def _case(S=3, M=4, BS=16, d=64, P=32, h=8, kvh=8, seed=0, dtype=jnp.float32):
 
 
 @pytest.mark.parametrize("h,kvh,window", [(8, 8, 0), (8, 2, 0), (8, 2, 5),
-                                          (4, 4, 0), (16, 4, 7)])
+                                          (4, 4, 0), (16, 4, 7),
+                                          # g=12: above the sublane floor
+                                          # but not a multiple of 8 — the
+                                          # pad must round UP to G=16, not
+                                          # floor at max(g, 8)=12
+                                          (24, 2, 0), (24, 2, 9)])
 def test_kernel_matches_gather(h, kvh, window):
     q, kp, vp, tables = _case(h=h, kvh=kvh)
     lengths = jnp.asarray([1, 30, 64], jnp.int32)

@@ -45,6 +45,22 @@ def test_regression_fails_both_directions(tmp_path):
     assert proc.stdout.count("[regressed]") == 2
 
 
+def test_committed_gate_catches_20pct_tokens_regression(tmp_path):
+    """Round-4 verdict weak #2 / next #3: with the COMMITTED baseline
+    table, a synthetic -20% injection on every tokens/s metric must
+    fail the gate — the old tol_rel=0.3 let a 22% real regression pass."""
+    table = json.loads((ROOT / "results" / "baselines.json").read_text())
+    tps = {m: spec for m, spec in table["baselines"].items()
+           if m.endswith("_tokens_per_s")}
+    assert tps, "no tokens/s metrics under the gate?"
+    assert all(spec["tol_rel"] <= 0.15 for spec in tps.values()), tps
+    rows = [{"metric": m, "value": spec["value"] * 0.8}
+            for m, spec in tps.items()]
+    proc, _ = _run(tmp_path, rows, table["baselines"])
+    assert proc.returncode == 1
+    assert proc.stdout.count("[regressed]") == len(rows), proc.stdout
+
+
 def test_update_ratchets_only_improvements(tmp_path):
     proc, bfile = _run(tmp_path, [
         {"metric": "m_ms", "value": 0.5},     # 2x faster: improved

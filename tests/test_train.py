@@ -43,6 +43,25 @@ class TestCheckpointResume:
         _, straight = train(steps=20, batch=4, seq=32, cfg=TINY, log=_quiet)
         assert abs(resumed - straight) < 1e-5, (resumed, straight)
 
+    def test_resume_refuses_changed_config(self, tmp_path):
+        """Resuming with a flag that differs from the sidecar must fail
+        loudly: the trainer would otherwise use the new value while
+        serving reads the stale sidecar — silent train/serve divergence
+        (round-4 advisor)."""
+        d = str(tmp_path / "ck")
+        train(steps=4, batch=2, seq=32, cfg=TINY, ckpt_dir=d, save_every=4,
+              log=_quiet)
+        # attn_window changes behavior but not param shapes — exactly
+        # the divergence class the check exists for
+        changed = LabformerConfig(d_model=32, n_heads=4, n_layers=2,
+                                  d_ff=64, max_seq=32, attn_window=8)
+        with pytest.raises(ValueError, match="resume config mismatch"):
+            train(steps=8, batch=2, seq=32, cfg=changed, ckpt_dir=d,
+                  save_every=4, resume=True, log=_quiet)
+        # matching flags still resume fine
+        train(steps=8, batch=2, seq=32, cfg=TINY, ckpt_dir=d, save_every=4,
+              resume=True, log=_quiet)
+
     def test_fresh_run_clears_stale_dir(self, tmp_path):
         d = str(tmp_path / "ck")
         train(steps=5, batch=2, seq=32, cfg=TINY, ckpt_dir=d, save_every=5, log=_quiet)

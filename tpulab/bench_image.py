@@ -39,10 +39,11 @@ def bench_lab2(size: int = 1024, reps: int = 30, use_pallas=None) -> Dict[str, A
     else:
         fn = roberts_edges
     samples: list = []
+    meta: dict = {}
     # headline is a ~24us kernel: 11 outer trials + IQR tame the ±30%
     # run-to-run tails (round-2 verdict, weak #4)
     ms, _ = measure_kernel_ms(fn, (x,), iters=max(reps, 500), outer=11,
-                              collect=samples)
+                              collect=samples, meta=meta)
     base = CUDA_BASELINES_MS["lab2_roberts_1024"]
     return {
         "metric": f"lab2_roberts_{size}x{size}_median_ms",
@@ -50,7 +51,7 @@ def bench_lab2(size: int = 1024, reps: int = 30, use_pallas=None) -> Dict[str, A
         "unit": "ms",
         "vs_baseline": round(base / ms, 3),
         "device": device.platform,
-        **variance_fields(samples),
+        **variance_fields(samples, meta),
     }
 
 
@@ -72,13 +73,14 @@ def bench_lab3(size: int = 1024, nc: int = 8, reps: int = 30, use_pallas=None) -
     device = default_device()
     fn, args = classify_staged(img, stats, use_pallas=use_pallas)
     samples: list = []
+    meta: dict = {}
     ms, _ = measure_kernel_ms(fn, args, iters=max(reps, 500), outer=11,
-                              collect=samples)
+                              collect=samples, meta=meta)
     return {
         "metric": f"lab3_classify_{size}x{size}_nc{nc}_median_ms",
         "value": round(ms, 6),
         "unit": "ms",
         "vs_baseline": None,  # no published lab3 baseline (BASELINE.md)
         "device": device.platform,
-        **variance_fields(samples),
+        **variance_fields(samples, meta),
     }

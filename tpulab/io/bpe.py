@@ -123,10 +123,23 @@ class BPETokenizer:
         later, so applicable ranks increase monotonically.)
         """
         ids = np.frombuffer(bytes(data), np.uint8).astype(np.int32)
+        # Membership pre-filter (round-4 advisor): a full _apply_merge
+        # pass per learned merge is O(merges × n) even when the pair's
+        # ids never occur — at the 65536-vocab ceiling that is ~65k
+        # scans of the input.  A merge (a, b) can only fire if BOTH ids
+        # are currently present, so keep a set of present ids and skip
+        # absent pairs in O(1); the set is rebuilt only when a pass
+        # actually merged something (output length changed).
+        present = set(ids.tolist())
         for rank, (a, b) in enumerate(self.merges):
             if len(ids) < 2:
                 break
-            ids = _apply_merge(ids, a, b, 256 + rank)
+            if a not in present or b not in present:
+                continue
+            merged = _apply_merge(ids, a, b, 256 + rank)
+            if merged.shape != ids.shape:
+                ids = merged
+                present = set(ids.tolist())
         return ids
 
     def decode(self, ids: Iterable[int]) -> bytes:

@@ -568,16 +568,20 @@ def main(argv=None) -> int:
                        # bytes (freezing on the raw id is still a valid
                        # shortcut when the byte survives as a token)
                        stop_token=args.stop_byte)
+    # Trim convention (shared with the daemon, daemon.py): the engine
+    # contract says the stop byte IS the final token, so it is KEPT in
+    # the emitted text — both serving surfaces must agree or the same
+    # checkpoint produces different output over the socket vs the CLI.
     toks = [int(t) for t in out[0]]
     if tok is None:
         if args.stop_byte >= 0 and args.stop_byte in toks:
-            toks = toks[: toks.index(args.stop_byte)]
+            toks = toks[: toks.index(args.stop_byte) + 1]
         data = bytes(t & 0xFF for t in toks)
     else:
         data = tok.decode(toks)
         if args.stop_byte >= 0:
             cut = data.find(bytes([args.stop_byte]))
             if cut >= 0:
-                data = data[:cut]
+                data = data[: cut + 1]
     print(args.prompt + data.decode("utf-8", errors="replace"))
     return 0

@@ -109,7 +109,12 @@ def paged_attend_pallas(q, kpool_l, vpool_l, tables, lengths,
         raise ValueError(f"pool block size {BS} != engine block size "
                          f"{block_size}")
     g = h // kvh
-    G = max(g, 8)  # sublane floor for the (G, BS) / (G, d) dots
+    # Sublane alignment for the (G, BS) / (G, d) dots: round UP to the
+    # next multiple of 8, not just floor at 8 — a GQA group size above 8
+    # that isn't itself a multiple (e.g. h=24, kvh=2 -> g=12) would
+    # otherwise hand Mosaic an illegal tile shape on real TPU while
+    # interpret-mode tests stay green.  (round-4 advisor finding)
+    G = max(8, -(-g // 8) * 8)
     M = tables.shape[1]
 
     qs = (q / np.sqrt(d).astype(q.dtype)).reshape(S, kvh, g, d)

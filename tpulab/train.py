@@ -543,6 +543,35 @@ def train(
                 sidecar["tokenizer"] = "tokenizer.json"
             with open(sc_path, "w") as f:
                 json.dump(sidecar, f, indent=2)
+        elif model == "labformer" and resume and os.path.exists(sc_path):
+            # The sidecar is authoritative for serving, but the trainer
+            # builds cfg from THIS invocation's flags — a resumed run
+            # with a changed flag that doesn't alter param shapes (e.g.
+            # --lora-alpha, --attn-window, --moe-top-k) would train with
+            # the new value while serving later reads the stale sidecar:
+            # a silent train/serve divergence.  Refuse on mismatch; the
+            # user either re-passes the original flags or starts a fresh
+            # checkpoint dir.  (round-4 advisor finding)
+            from tpulab.models.labformer import cfg_to_dict
+
+            with open(sc_path) as f:
+                recorded = json.load(f).get("config", {})
+            current = cfg_to_dict(cfg)
+            diff = {
+                k: (recorded.get(k), current.get(k))
+                for k in sorted(set(recorded) | set(current))
+                if recorded.get(k) != current.get(k)
+            }
+            if diff:
+                detail = ", ".join(
+                    f"{k}: sidecar={a!r} flags={b!r}" for k, (a, b) in diff.items()
+                )
+                raise ValueError(
+                    "resume config mismatch — the checkpoint sidecar "
+                    f"({sc_path}) records a different architecture than "
+                    f"this invocation's flags ({detail}); re-pass the "
+                    "original flags or use a fresh --ckpt-dir"
+                )
         if resume and manager.latest_step() is not None:
             start_step = manager.latest_step()
             params, opt_state = _restore_latest(

@@ -91,11 +91,32 @@ class TestSlidingWindowModel:
         # window < seq is a different function
         assert not np.allclose(got, np.asarray(forward(params, tok, CFG)))
 
-    def test_window_rejected_on_ring_sp_mesh(self, rng):
+    def test_ring_sp_windows_match_single_device(self, rng):
+        """Windowed ring sp: the windowed ring body (dense and flash
+        local) must equal the single-device windowed forward — the
+        refusal this replaced existed exactly because silently dropping
+        the window would change the model function between topologies."""
         import dataclasses
 
         mesh = cpu_test_mesh({"sp": 2})
-        wcfg = dataclasses.replace(CFG, attn_window=5)
+        tok = jnp.asarray(rng.integers(0, 256, (2, 16)).astype(np.int32))
+        for impl in ("dense", "flash"):
+            wcfg = dataclasses.replace(CFG, attn_window=5, sp_impl="ring",
+                                       attn_impl=impl)
+            params = init_params(wcfg, seed=0)
+            got = np.asarray(forward(params, tok, wcfg, mesh=mesh))
+            want = np.asarray(forward(params, tok, dataclasses.replace(
+                wcfg, attn_impl="dense")))
+            np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5,
+                                       err_msg=impl)
+
+    def test_window_rejected_on_zigzag_sp_mesh(self, rng):
+        """zigzag keeps refusing a window: its balance rationale is void
+        there and ring is the windowed path."""
+        import dataclasses
+
+        mesh = cpu_test_mesh({"sp": 2})
+        wcfg = dataclasses.replace(CFG, attn_window=5, sp_impl="zigzag")
         params = init_params(wcfg, seed=0)
         tok = jnp.asarray(rng.integers(0, 256, (2, 16)).astype(np.int32))
         with pytest.raises(NotImplementedError, match="attn_window"):

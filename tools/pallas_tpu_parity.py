@@ -164,6 +164,51 @@ def run_checks() -> list:
         "within_tol": bool(gerr < 5e-3),
     })
 
+    # windowed ring building block: flash with a static q_offset (query
+    # row i at global position offset+i) + window — the masks ride
+    # iota/compare/select paths that only real Mosaic exercises, and
+    # offset rows with no visible key must return o=0 / lse=-inf
+    from tpulab.ops.pallas.attention import flash_attention_with_lse
+
+    b_, s, nh, d = 1, 512, 2, 64
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((b_, s, nh, d)).astype(np.float32) * 0.5)
+        for _ in range(3)
+    )
+    w, off = 200, 512  # offset = one shard; window spans a partial block
+    got_o, got_lse = flash_attention_with_lse(
+        q, k, v, causal=True, window=w, q_offset=off,
+        block_q=128, block_k=128, interpret=False)
+    qp = off + np.arange(s)[:, None]
+    kp_pos = np.arange(s)[None, :]
+    keep = (kp_pos <= qp) & (kp_pos > qp - w)
+    sc = np.einsum("bqhd,bkhd->bhqk",
+                   np.asarray(q) / np.sqrt(d), np.asarray(k))
+    sc = np.where(keep[None, None], sc, -np.inf)
+    with np.errstate(over="ignore", invalid="ignore"):
+        m = sc.max(-1, keepdims=True)
+        p = np.where(np.isfinite(sc), np.exp(sc - np.where(np.isfinite(m), m, 0)), 0.0)
+        l = p.sum(-1, keepdims=True)
+        want_o = np.einsum("bhqk,bkhd->bqhd", p / np.where(l > 0, l, 1.0),
+                           np.asarray(v))
+    alive = keep.any(-1)
+    oerr = float(np.max(np.abs(np.asarray(got_o) - want_o)))
+    dead_ok = bool(
+        (np.asarray(got_o)[:, ~alive] == 0).all()
+        and np.all(np.asarray(got_lse)[:, ~alive] == -np.inf)
+    ) if (~alive).any() else True
+    checks.append({
+        "kernel": "pallas_flash_attention_q_offset",
+        "shape": [b_, s, nh, d],
+        "dtype": "float32",
+        "window": w,
+        "q_offset": off,
+        "max_abs_err": oerr,
+        "dead_rows_clean": dead_ok,
+        "tol": 1e-4,
+        "within_tol": bool(oerr < 1e-4 and dead_ok),
+    })
+
     # paged-attention decode kernel (scalar-prefetch block tables) vs
     # the XLA gather path — GQA grouping + ragged lengths + window
     from tpulab.models.paged import _paged_attend

@@ -44,7 +44,6 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpulab.parallel.mesh import make_mesh, mesh_anchor
-from tpulab.parallel.ring import _ring_body
 from tpulab.runtime.device import commit
 
 
@@ -71,8 +70,9 @@ class LabformerConfig:
     # each query sees its attn_window most recent tokens, itself
     # included.  The flash kernel skips K blocks wholly outside the
     # window, so long-context compute drops to O(seq * window).  On
-    # sp > 1 meshes only sp_impl="ulysses" supports it (each head group
-    # windows the gathered sequence); ring/zigzag raise.
+    # sp > 1 meshes sp_impl="ulysses" windows the gathered sequence and
+    # sp_impl="ring" runs the windowed ring body (O(window) rotations);
+    # zigzag raises (its balance argument is void under a window).
     attn_window: int = 0
     # sequence-parallel strategy when the mesh has sp > 1: "ring"
     # (ppermute K/V rotation, O(seq/p) peak memory) or "ulysses"
@@ -394,14 +394,18 @@ def _attention(x, layer, cfg: LabformerConfig, mesh: Optional[Mesh], positions):
     # ulysses paths run unchanged
     k, v = repeat_kv(k, v, h)
     if mesh is not None and "sp" in mesh.axis_names and mesh.shape["sp"] > 1:
-        if cfg.attn_window and cfg.sp_impl != "ulysses":
-            # the ring/zigzag bodies run full causal reach; silently
-            # dropping the window would change the model function
-            # between topologies.  Ulysses windows fine: each head group
-            # sees the WHOLE gathered sequence locally.
+        if cfg.attn_window and cfg.sp_impl == "zigzag":
+            # silently dropping the window would change the model
+            # function between topologies.  Ulysses windows fine (each
+            # head group sees the whole gathered sequence) and ring has
+            # a dedicated windowed body; zigzag stays refused — its
+            # load-balance rationale is void under a window (every
+            # query attends ~window keys regardless of rank), so ring
+            # IS the windowed ring path.
             raise NotImplementedError(
-                "attn_window over sp > 1 requires sp_impl='ulysses' "
-                "(ring/zigzag bodies do not window)"
+                "attn_window over sp > 1 requires sp_impl='ulysses' or "
+                "'ring' (zigzag's balance argument is void under a "
+                "window — use ring)"
             )
         spec = _restrict(P("dp", "sp", "tp", None), mesh)
         if cfg.sp_impl == "zigzag":
@@ -434,12 +438,15 @@ def _attention(x, layer, cfg: LabformerConfig, mesh: Optional[Mesh], positions):
                 local_impl=cfg.attn_impl, window=cfg.attn_window,
             )
         else:
-            from tpulab.parallel.ring import _ring_body_flash, use_flash
+            from tpulab.parallel.ring import _ring_local_body
 
-            ring_fn = (_ring_body_flash
-                       if use_flash(cfg.attn_impl, s // mesh.shape["sp"])
-                       else _ring_body)
-            body = functools.partial(ring_fn, axis="sp", causal=True)
+            # shared dispatch with the standalone ring_attention —
+            # windowed flash unrolls O(window) rotations (see
+            # parallel/ring._ring_body_flash_windowed)
+            body = _ring_local_body(
+                "sp", cfg.attn_impl, s // mesh.shape["sp"],
+                causal=True, window=cfg.attn_window,
+            )
         # check_vma=False: the ulysses body may lower a pallas_call
         # (flash local attention), which carries no vma metadata
         o = jax.shard_map(

@@ -58,7 +58,7 @@ def _dot_f32(a, b, dims):
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
                   block_q: int, block_k: int, n_k: int, causal: bool,
-                  scale: float, window: int = 0):
+                  scale: float, window: int = 0, q_offset: int = 0):
     kb = pl.program_id(2)
     qb = pl.program_id(1)
 
@@ -85,8 +85,14 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
     # blocks skip the iotas + compares + selects — VPU passes over
     # (bq, bk) that, with d=64 halving the MXU, otherwise rival the
     # matmul time
+    # q_offset (static) shifts every query's GLOBAL position: row i of
+    # this call sits at sequence position q_offset + i while keys stay
+    # at 0..s-1.  Ring attention uses it to fold a visiting K/V block
+    # that lives t shards earlier in the sequence (offset = t * shard)
+    # — the causal/window masks and the block-skip predicates all see
+    # the true global geometry, so wholly-dead blocks cost nothing.
     active, diag = (
-        _block_edges(qb, kb, block_q, block_k, window) if causal
+        _block_edges(qb, kb, block_q, block_k, window, q_offset) if causal
         else (None, None)
     )
 
@@ -107,7 +113,8 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
             s = s * np.float32(scale)
 
         if masked:
-            q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            q_pos = (q_offset + qb * block_q
+                     + jax.lax.broadcasted_iota(jnp.int32, s.shape, 0))
             k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
             keep = k_pos <= q_pos
             if window:
@@ -120,6 +127,17 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
         m_new = jnp.maximum(m_prev, m_cur)
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)                             # (bq, bk)
+        if masked and window and q_offset:
+            # a row that has seen NO visible key anywhere still has
+            # m_new == NEG_INF (finite), so exp(s - m_new) over its
+            # all-masked scores would be exp(0) = 1 — force p = 0 so
+            # such rows keep l == 0 and _finish emits the o = 0 /
+            # lse = -inf zero-weight-partial contract.  Statically
+            # gated: q_offset+window is the ONLY geometry that can
+            # produce dead rows, so every other caller keeps the
+            # select-free hot loop.
+            p = jnp.where(m_new > np.float32(NEG_INF / 2), p,
+                          np.float32(0.0))
         l_new = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
         # p rides in v's dtype (bf16 when the model is bf16): exp outputs
         # lie in [0, 1] where bf16's 8 mantissa bits keep the p@v dot
@@ -142,7 +160,13 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
 
     @pl.when(kb == n_k - 1)
     def _finish():
-        o_ref[0] = (acc_ref[:] / l_ref[:]).astype(o_ref.dtype)
+        # rows with NO visible key (possible under q_offset + window:
+        # a query whose window lies entirely before this K/V block)
+        # have l == 0 — emit o = 0 and lse = -inf so an lse-merge
+        # treats them as a zero-weight partial instead of NaN-poisoning
+        # the combine (0/0 then 0 * NaN)
+        l = l_ref[:]
+        o_ref[0] = (acc_ref[:] / jnp.where(l > 0, l, 1.0)).astype(o_ref.dtype)
         # logsumexp per q row — the backward pass's softmax residual
         # (p = exp(s - lse) reconstructs exact probabilities blockwise).
         # lse rides a trailing-singleton lane dim: a (1, block_q) block
@@ -150,7 +174,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref, *,
         # (tiling needs sublane % 8 == 0 or == array dim); (block_q, 1)
         # over (bh, s, 1) satisfies both rules and matches the (bq, 1)
         # scratch layout with no relayout.
-        lse_ref[0] = m_ref[:] + jnp.log(l_ref[:])
+        lse_ref[0] = m_ref[:] + jnp.log(l)
 
 
 def _check_blocks(s: int, block_q: int, block_k: int) -> None:
@@ -163,7 +187,7 @@ def _check_blocks(s: int, block_q: int, block_k: int) -> None:
 
 
 def _flash_fwd_call(q, k, v, block_q: int, block_k: int, causal: bool,
-                    interpret: bool, window: int = 0):
+                    interpret: bool, window: int = 0, q_offset: int = 0):
     """(bh, s, d) fused attention; returns (o, lse) with lse (bh, s) f32."""
     bh, s, d = q.shape
     _check_blocks(s, block_q, block_k)
@@ -186,7 +210,7 @@ def _flash_fwd_call(q, k, v, block_q: int, block_k: int, causal: bool,
     )
     kernel = functools.partial(
         _flash_kernel, block_q=block_q, block_k=block_k, n_k=n_k,
-        causal=causal, scale=scale, window=window,
+        causal=causal, scale=scale, window=window, q_offset=q_offset,
     )
     o, lse = pl.pallas_call(
         kernel,
@@ -207,13 +231,18 @@ def _flash_fwd_call(q, k, v, block_q: int, block_k: int, causal: bool,
     return o, lse[..., 0]
 
 
-def _causal_p_mask(p, qb, kb, block_q: int, block_k: int, window: int = 0):
+def _causal_p_mask(p, qb, kb, block_q: int, block_k: int, window: int = 0,
+                   q_offset: int = 0):
     """Zero the strictly-upper (future) positions of a p block, and —
     for sliding-window attention — positions past the window's reach.
 
     The backward reconstructs p = exp(s - lse) WITHOUT the forward's
-    -inf pre-masking, so masked positions must be zeroed explicitly."""
-    q_pos = qb * block_q + jax.lax.broadcasted_iota(jnp.int32, p.shape, 0)
+    -inf pre-masking, so masked positions must be zeroed explicitly.
+    (Rows with no visible key carry lse = -inf, so the unmasked p is
+    +inf there — every such position is masked, and the where() selects
+    the 0 branch, never propagating the inf.)"""
+    q_pos = (q_offset + qb * block_q
+             + jax.lax.broadcasted_iota(jnp.int32, p.shape, 0))
     k_pos = kb * block_k + jax.lax.broadcasted_iota(jnp.int32, p.shape, 1)
     keep = k_pos <= q_pos
     if window:
@@ -221,16 +250,18 @@ def _causal_p_mask(p, qb, kb, block_q: int, block_k: int, window: int = 0):
     return jnp.where(keep, p, np.float32(0.0))
 
 
-def _block_edges(qb, kb, block_q: int, block_k: int, window: int):
+def _block_edges(qb, kb, block_q: int, block_k: int, window: int,
+                 q_offset: int = 0):
     """(active, edge) predicates for a causal[, windowed] (qb, kb) block.
 
     ``active``: the block intersects some row's visible range.  ``edge``:
     the block crosses the diagonal or the window's lower edge and needs
     the positional mask; active blocks with ``not edge`` are fully
     visible.  Shared by the forward and both backward kernels so the
-    three grids agree exactly on which blocks exist."""
-    q_lo = qb * block_q
-    q_hi = qb * block_q + block_q - 1
+    three grids agree exactly on which blocks exist.  ``q_offset``
+    (static) shifts query positions globally — see _flash_kernel."""
+    q_lo = q_offset + qb * block_q
+    q_hi = q_lo + block_q - 1
     k_lo = kb * block_k
     k_hi = kb * block_k + block_k - 1
     active = k_lo <= q_hi
@@ -244,7 +275,7 @@ def _block_edges(qb, kb, block_q: int, block_k: int, window: int):
 def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          dq_ref, dq_acc, *, block_q: int, block_k: int,
                          n_k: int, causal: bool, scale: float,
-                         window: int = 0):
+                         window: int = 0, q_offset: int = 0):
     kb = pl.program_id(2)
     qb = pl.program_id(1)
 
@@ -266,7 +297,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = s * np.float32(scale)
         p = jnp.exp(s - lse)
         if masked:
-            p = _causal_p_mask(p, qb, kb, block_q, block_k, window)
+            p = _causal_p_mask(p, qb, kb, block_q, block_k, window,
+                               q_offset)
         dp = _dot_f32(do, v, ((1,), (1,)))  # (bq, bk)
         ds = p * (dp - delta)
         # with the wrapper's prescaled q, d(q')/dq folds the 1/sqrt(d)
@@ -279,7 +311,8 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     if causal:
         # diagonal/window split as in the forward: only blocks crossing
         # an edge pay the positional mask's VPU passes
-        active, diag = _block_edges(qb, kb, block_q, block_k, window)
+        active, diag = _block_edges(qb, kb, block_q, block_k, window,
+                                    q_offset)
         pl.when(jnp.logical_and(active, diag))(
             functools.partial(_compute, masked=True)
         )
@@ -297,7 +330,7 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                           dk_ref, dv_ref, dk_acc, dv_acc, *, block_q: int,
                           block_k: int, n_q: int, causal: bool, scale: float,
-                          window: int = 0):
+                          window: int = 0, q_offset: int = 0):
     qb = pl.program_id(2)
     kb = pl.program_id(1)
 
@@ -319,7 +352,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = s * np.float32(scale)
         p = jnp.exp(s - lse)
         if masked:
-            p = _causal_p_mask(p, qb, kb, block_q, block_k, window)
+            p = _causal_p_mask(p, qb, kb, block_q, block_k, window,
+                               q_offset)
         dv_acc[:] += _dot_f32(p.astype(do.dtype), do, ((0,), (0,)))
         dp = _dot_f32(do, v, ((1,), (1,)))  # (bq, bk)
         ds = p * (dp - delta)
@@ -334,7 +368,8 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         # a K block only sees gradient from Q blocks reaching it (and,
         # windowed, from Q blocks whose window still covers it); only
         # edge-crossing blocks pay the positional mask
-        active, diag = _block_edges(qb, kb, block_q, block_k, window)
+        active, diag = _block_edges(qb, kb, block_q, block_k, window,
+                                    q_offset)
         pl.when(jnp.logical_and(active, diag))(
             functools.partial(_compute, masked=True)
         )
@@ -362,7 +397,7 @@ def _bwd_block(block: int, cap: int = 512) -> int:
 
 def _flash_bwd_call(q, k, v, o, lse, do, block_q: int, block_k: int,
                     causal: bool, interpret: bool, dlse=None,
-                    window: int = 0):
+                    window: int = 0, q_offset: int = 0):
     # blocks arrive FINAL (the vjp wrapper applies the inherit-time
     # _bwd_block VMEM halving; explicit tuner overrides pass through)
     bh, s, d = q.shape
@@ -400,7 +435,7 @@ def _flash_bwd_call(q, k, v, o, lse, do, block_q: int, block_k: int,
     dq = pl.pallas_call(
         functools.partial(
             _flash_bwd_dq_kernel, block_q=bq, block_k=bk, n_k=n_k,
-            causal=causal, scale=scale, window=window,
+            causal=causal, scale=scale, window=window, q_offset=q_offset,
         ),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         grid=(bh, n_q, n_k),
@@ -423,7 +458,7 @@ def _flash_bwd_call(q, k, v, o, lse, do, block_q: int, block_k: int,
     dk, dv = pl.pallas_call(
         functools.partial(
             _flash_bwd_dkv_kernel, block_q=bq, block_k=bk, n_q=n_q,
-            causal=causal, scale=scale, window=window,
+            causal=causal, scale=scale, window=window, q_offset=q_offset,
         ),
         out_shape=(
             jax.ShapeDtypeStruct(k.shape, k.dtype),
@@ -441,10 +476,11 @@ def _flash_bwd_call(q, k, v, o, lse, do, block_q: int, block_k: int,
     return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
 def _flash_bhsd_lse(q, k, v, block_q: int, block_k: int, causal: bool,
                     interpret: bool, bwd_block_q: int = 0,
-                    bwd_block_k: int = 0, window: int = 0):
+                    bwd_block_k: int = 0, window: int = 0,
+                    q_offset: int = 0):
     """(bh, s, d) attention returning ``(o, lse)``; both differentiable
     (the lse cotangent folds into the delta term of the backward).
 
@@ -453,18 +489,18 @@ def _flash_bhsd_lse(q, k, v, block_q: int, block_k: int, causal: bool,
     have different reuse patterns than the forward, so their optimum
     need not match — tools/tune_flash.py sweeps them separately."""
     return _flash_fwd_call(q, k, v, block_q, block_k, causal, interpret,
-                           window)
+                           window, q_offset)
 
 
 def _flash_bhsd_lse_fwd(q, k, v, block_q, block_k, causal, interpret,
-                        bwd_block_q, bwd_block_k, window):
+                        bwd_block_q, bwd_block_k, window, q_offset):
     o, lse = _flash_fwd_call(q, k, v, block_q, block_k, causal, interpret,
-                             window)
+                             window, q_offset)
     return (o, lse), (q, k, v, o, lse)
 
 
 def _flash_bhsd_lse_bwd(block_q, block_k, causal, interpret,
-                        bwd_block_q, bwd_block_k, window, res, ct):
+                        bwd_block_q, bwd_block_k, window, q_offset, res, ct):
     do, dlse = ct
     q, k, v, o, lse = res
     # explicit bwd blocks are used AS GIVEN (the tuner sweeps true tile
@@ -473,25 +509,26 @@ def _flash_bhsd_lse_bwd(block_q, block_k, causal, interpret,
     bk = bwd_block_k or _bwd_block(block_k)
     _check_blocks(q.shape[1], bq, bk)
     return _flash_bwd_call(q, k, v, o, lse, do, bq, bk, causal,
-                           interpret, dlse=dlse, window=window)
+                           interpret, dlse=dlse, window=window,
+                           q_offset=q_offset)
 
 
 _flash_bhsd_lse.defvjp(_flash_bhsd_lse_fwd, _flash_bhsd_lse_bwd)
 
 
-@functools.partial(jax.jit, static_argnums=(3, 4, 5, 6, 7, 8, 9))
+@functools.partial(jax.jit, static_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
 def _flash_bhsd(q, k, v, block_q: int, block_k: int, causal: bool,
                 interpret: bool, bwd_block_q: int = 0, bwd_block_k: int = 0,
-                window: int = 0):
+                window: int = 0, q_offset: int = 0):
     # dropping lse makes its cotangent a zeros array — delta' == delta
     return _flash_bhsd_lse(q, k, v, block_q, block_k, causal, interpret,
-                           bwd_block_q, bwd_block_k, window)[0]
+                           bwd_block_q, bwd_block_k, window, q_offset)[0]
 
 
 def _flash_bshd(q, k, v, causal: bool, block_q: int, block_k: int,
                 interpret: Optional[bool], with_lse: bool,
                 bwd_block_q: int = 0, bwd_block_k: int = 0,
-                window: int = 0):
+                window: int = 0, q_offset: int = 0):
     """Shared (batch, seq, heads, d) wrapper: padding + layout + kernel."""
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
@@ -499,6 +536,12 @@ def _flash_bshd(q, k, v, causal: bool, block_q: int, block_k: int,
         raise NotImplementedError("sliding window requires causal=True")
     if window < 0:
         raise ValueError(f"window must be >= 0, got {window}")
+    if q_offset and not causal:
+        # non-causal attention ignores positions entirely — accepting an
+        # offset there would silently compute the same thing
+        raise ValueError("q_offset requires causal=True")
+    if q_offset < 0:
+        raise ValueError(f"q_offset must be >= 0, got {q_offset}")
     b, s, h, d = q.shape
     # fold the softmax scale into q ONCE here (f32 math, back to q's
     # dtype) instead of a per-K-step pass over every (bq, bk) score
@@ -522,6 +565,14 @@ def _flash_bshd(q, k, v, causal: bool, block_q: int, block_k: int,
         block_q = block_k = min(block_q, block_k)
         pad_unit = block_q
     pad = (-s) % pad_unit
+    if pad and q_offset:
+        # padded K rows sit at positions [s, s+pad); offset queries are
+        # causally LATER than them, so the zero-extension would attract
+        # real softmax weight — callers must pick blocks dividing seq
+        raise NotImplementedError(
+            "q_offset requires seq % block == 0 (zero-padded keys would "
+            "receive weight); pick block_q/block_k dividing seq"
+        )
     if pad:
         # pad queries arbitrarily (cropped) and keys at -inf reach: the
         # causal mask plus k_pos>=s padding must not attract weight, so
@@ -545,12 +596,12 @@ def _flash_bshd(q, k, v, causal: bool, block_q: int, block_k: int,
     if with_lse:
         ob, lseb = _flash_bhsd_lse(qb, kb, vb, block_q, block_k, causal,
                                    interpret, bwd_block_q, bwd_block_k,
-                                   window)
+                                   window, q_offset)
         o = jnp.moveaxis(ob.reshape(b, h, sp, d), 1, 2)[:, :s]
         lse = jnp.moveaxis(lseb.reshape(b, h, sp), 1, 2)[:, :s]  # (b, s, h)
         return o, lse
     ob = _flash_bhsd(qb, kb, vb, block_q, block_k, causal, interpret,
-                     bwd_block_q, bwd_block_k, window)
+                     bwd_block_q, bwd_block_k, window, q_offset)
     return jnp.moveaxis(ob.reshape(b, h, sp, d), 1, 2)[:, :s]
 
 
@@ -566,6 +617,7 @@ def flash_attention(
     bwd_block_q: int = 0,
     bwd_block_k: int = 0,
     window: int = 0,
+    q_offset: int = 0,
 ) -> jax.Array:
     """Exact attention over (batch, seq, heads, head_dim), O(seq) memory.
 
@@ -577,10 +629,19 @@ def flash_attention(
     ``window`` > 0 (causal only) restricts each query to its ``window``
     most recent keys, itself included — Mistral-style sliding-window
     attention.  K blocks wholly outside the window are skipped, so
-    compute AND gradient cost drop to O(seq * window)."""
+    compute AND gradient cost drop to O(seq * window).
+
+    ``q_offset`` > 0 (causal only, static) places query row ``i`` at
+    global sequence position ``q_offset + i`` while keys stay at
+    ``0..seq-1`` — the partial-attention building block for ring
+    attention, where a visiting K/V block lives whole shards earlier
+    than the local queries.  Rows whose (windowed) visible range misses
+    every key return o = 0 with lse = -inf: a zero-weight partial under
+    the lse merge.  Requires seq divisible by the blocks (no padding)."""
     return _flash_bshd(q, k, v, causal, block_q, block_k, interpret,
                        with_lse=False, bwd_block_q=bwd_block_q,
-                       bwd_block_k=bwd_block_k, window=window)
+                       bwd_block_k=bwd_block_k, window=window,
+                       q_offset=q_offset)
 
 
 def flash_attention_with_lse(
@@ -595,13 +656,15 @@ def flash_attention_with_lse(
     bwd_block_q: int = 0,
     bwd_block_k: int = 0,
     window: int = 0,
+    q_offset: int = 0,
 ):
     """Like :func:`flash_attention` but also returns the per-row
     logsumexp, shape (batch, seq, heads) f32 — the merge state for
     combining partial attentions over key shards (ring attention):
     ``o = sum_i o_i * exp(lse_i - logaddexp_i lse_i)``.  Both outputs
     are differentiable (the lse cotangent folds into the backward's
-    delta term)."""
+    delta term).  ``q_offset`` as in :func:`flash_attention`."""
     return _flash_bshd(q, k, v, causal, block_q, block_k, interpret,
                        with_lse=True, bwd_block_q=bwd_block_q,
-                       bwd_block_k=bwd_block_k, window=window)
+                       bwd_block_k=bwd_block_k, window=window,
+                       q_offset=q_offset)

@@ -89,12 +89,15 @@ def attention_reference(q, k, v, causal: bool = True, window: int = 0):
     return o.astype(q.dtype)
 
 
-def _ring_body(q, k, v, *, axis: str, causal: bool):
+def _ring_body(q, k, v, *, axis: str, causal: bool, window: int = 0):
     """Per-device ring attention over sequence shards (runs in shard_map).
 
     ``q, k, v``: (..., seq/p, heads, d).  K/V rotate p-1 times; each step
     folds the visiting block into the online-softmax accumulator with the
-    correct global causal offsets.
+    correct global causal offsets.  ``window`` > 0 (causal only) adds the
+    sliding-window cut to the same global-position bias; blocks wholly
+    outside the window fold as all-masked no-ops (p == 0 — every row's
+    running max is already finite after the t=0 self block).
     """
     p = jax.lax.axis_size(axis)
     idx = jax.lax.axis_index(axis)
@@ -118,7 +121,15 @@ def _ring_body(q, k, v, *, axis: str, causal: bool):
         m, l, o, kt, vt = carry
         # the K/V block visiting at step t originated at rank (idx - t) mod p
         src = (idx - t) % p
-        bias = _causal_bias(idx * seq_local + local_pos, src * seq_local + local_pos) if causal else 0.0
+        if causal:
+            q_glob = idx * seq_local + local_pos
+            k_glob = src * seq_local + local_pos
+            bias = _causal_bias(q_glob, k_glob)
+            if window:
+                reach = q_glob[:, None] - k_glob[None, :]
+                bias = jnp.where(reach >= window, NEG_INF, bias)
+        else:
+            bias = 0.0
         s = _block_attend(qs, kt, bias)
         m, l, o = _online_softmax_step((m, l, o), s, vt)
         # rotate for the next step (the final rotation is harmless and
@@ -198,16 +209,83 @@ def _ring_body_flash(q, k, v, *, axis: str, causal: bool):
     return o.astype(q.dtype)
 
 
+def _ring_body_flash_windowed(q, k, v, *, axis: str, window: int):
+    """Sliding-window ring attention with the Pallas flash kernel as the
+    per-step local attention (runs in shard_map; causal only).
+
+    The window makes most ring steps DEAD by construction: the visiting
+    block at step ``t`` sits ``t`` shards earlier, so its nearest
+    (query, key) pair is ``(t-1)*shard + 1`` positions apart — beyond
+    ``window - 1`` the whole block is invisible.  The loop is unrolled
+    in Python (``t`` static) and stops after the last live step:
+    ``ceil((window-1)/shard)`` rotations instead of ``p - 1``, so
+    communication AND compute are O(window), not O(seq).  Each live
+    step is one flash call with ``q_offset = t*shard`` — the kernel's
+    global-position masks (and block skips) do the banding; rows whose
+    window misses the visiting block return lse = -inf partials that
+    merge as zero weight.  Trainable end to end (custom_vjp).
+
+    Note the contiguous layout needs no zigzag here: with a window,
+    every query attends exactly min(window, pos+1) keys regardless of
+    rank, so the causal load imbalance zigzag exists to fix is absent.
+    """
+    from tpulab.ops.pallas.attention import flash_attention_with_lse
+
+    p = jax.lax.axis_size(axis)
+    idx = jax.lax.axis_index(axis)
+    sl = q.shape[1]
+    blk = _pick_flash_block(sl)
+    attend = functools.partial(
+        flash_attention_with_lse, block_q=blk, block_k=blk
+    )
+
+    o, lse = attend(q, k, v, causal=True, window=window)
+    o = o.astype(jnp.float32)
+    perm = [(i, (i + 1) % p) for i in range(p)]
+    n_live = 0 if window <= 1 else min(p - 1, 1 + (window - 2) // sl)
+    kt, vt = k, v
+    for t in range(1, n_live + 1):
+        kt = jax.lax.ppermute(kt, axis, perm)
+        vt = jax.lax.ppermute(vt, axis, perm)
+        o2, lse2 = attend(q, kt, vt, causal=True, window=window,
+                          q_offset=t * sl)
+        o_new, lse_new = _lse_merge(o, lse, o2.astype(jnp.float32), lse2)
+        # src = (idx - t) mod p is earlier than idx iff t <= idx: the
+        # wrapped devices computed a partial for keys that do not exist
+        # before them — discard it (select keeps collectives uniform)
+        take = t <= idx
+        o = jnp.where(take, o_new, o)
+        lse = jnp.where(take, lse_new, lse)
+    return o.astype(q.dtype)
+
+
+def _ring_local_body(axis: str, local_impl: str, s_local: int,
+                     causal: bool = True, window: int = 0):
+    """Pick the ring per-device body for ``local_impl`` (the selection
+    twin of :func:`_zigzag_local_body`): flash-windowed when a window is
+    set and flash is on, plain flash otherwise, dense (with the window
+    folded into its bias) as the fallback.  THE one dispatch shared by
+    ``ring_attention`` and labformer's sp attention — the selection rule
+    must not fork between the model and the standalone path."""
+    if use_flash(local_impl, s_local):
+        if window:
+            return functools.partial(
+                _ring_body_flash_windowed, axis=axis, window=window
+            )
+        return functools.partial(_ring_body_flash, axis=axis, causal=causal)
+    return functools.partial(
+        _ring_body, axis=axis, causal=causal, window=window
+    )
+
+
 @functools.partial(
-    jax.jit, static_argnames=("mesh", "axis", "causal", "local_impl")
+    jax.jit, static_argnames=("mesh", "axis", "causal", "local_impl", "window")
 )
 def _ring_attention_sharded(q, k, v, *, mesh: Mesh, axis: str, causal: bool,
-                            local_impl: str = "dense"):
+                            local_impl: str = "dense", window: int = 0):
     spec = P(None, axis, None, None)  # (batch, seq, heads, d): seq sharded
-    if use_flash(local_impl, q.shape[1] // mesh.shape[axis]):
-        body = functools.partial(_ring_body_flash, axis=axis, causal=causal)
-    else:
-        body = functools.partial(_ring_body, axis=axis, causal=causal)
+    body = _ring_local_body(axis, local_impl, q.shape[1] // mesh.shape[axis],
+                            causal=causal, window=window)
     # check_vma=False: the flash body lowers a pallas_call, which carries
     # no varying-mesh-axes metadata
     return jax.shard_map(
@@ -225,6 +303,7 @@ def ring_attention(
     axis: str = "sp",
     causal: bool = True,
     local_impl: str = "dense",
+    window: int = 0,
 ) -> jax.Array:
     """Exact attention over a sequence-sharded (batch, seq, heads, d) input.
 
@@ -233,14 +312,25 @@ def ring_attention(
     "dense" | "flash" | "auto" — the per-step block attention ("flash"
     streams visiting K/V blocks through the Pallas kernel: O(seq/p * d)
     memory instead of (seq/p)^2 score blocks).
+
+    ``window`` > 0 (causal only) is sliding-window attention across the
+    ring: the flash path unrolls only the ``ceil((window-1)/shard)``
+    live rotations, making communication and compute O(window) per
+    device (see :func:`_ring_body_flash_windowed`); the dense path
+    masks by global position over the full rotation.
     """
     mesh = mesh or make_mesh(axes=(axis,))
+    if window and not causal:
+        raise NotImplementedError("sliding window requires causal=True")
+    if window < 0:
+        raise ValueError(f"window must be >= 0, got {window}")
     spec = NamedSharding(mesh, P(None, axis, None, None))
     q, k, v = (jax.device_put(commit(x, mesh_anchor(mesh)), spec) for x in (q, k, v))
     if q.shape[1] % mesh.shape[axis]:
         raise ValueError(f"seq {q.shape[1]} not divisible by mesh axis {mesh.shape[axis]}")
     return _ring_attention_sharded(
-        q, k, v, mesh=mesh, axis=axis, causal=causal, local_impl=local_impl
+        q, k, v, mesh=mesh, axis=axis, causal=causal, local_impl=local_impl,
+        window=window,
     )
 
 

@@ -1,0 +1,99 @@
+"""Detached-signature workflow (tools/sign_artifacts.py) — the analog of
+the reference's GPG-signed submissions (reference README.md:17-21,
+hw1/src/main.c.asc): sign writes armored detached signatures + the
+public key; verify succeeds from a FRESH keyring holding only the
+committed pubkey; tampering any signed byte fails verification."""
+
+import pathlib
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+pytestmark = pytest.mark.skipif(shutil.which("gpg") is None,
+                                reason="gpg not installed")
+
+
+def _run(cmd, root):
+    return subprocess.run(
+        [sys.executable, str(ROOT / "tools" / "sign_artifacts.py"),
+         cmd, "--root", str(root)],
+        capture_output=True, text=True,
+    )
+
+
+@pytest.fixture(scope="module")
+def signed_tree(tmp_path_factory):
+    """A miniature repo tree with two manifest entries present."""
+    root = tmp_path_factory.mktemp("signroot")
+    (root / "results").mkdir()
+    (root / "results" / "baselines.json").write_text('{"baselines": {}}\n')
+    (root / "tpulab" / "ops" / "pallas").mkdir(parents=True)
+    (root / "tpulab" / "ops" / "pallas" / "attention.py").write_text(
+        "def f():\n    return 1\n")
+    r = _run("sign", root)
+    assert r.returncode == 0, r.stdout + r.stderr
+    return root
+
+
+def test_sign_emits_armored_sigs_and_pubkey(signed_tree):
+    sig_dir = signed_tree / "results" / "signing"
+    pub = (sig_dir / "pubkey.asc").read_text()
+    assert "BEGIN PGP PUBLIC KEY BLOCK" in pub
+    sig = (sig_dir / "results__baselines.json.asc").read_text()
+    assert "BEGIN PGP SIGNATURE" in sig
+    # absent manifest entries are skipped, not failed
+    assert not (sig_dir / "bench.py.asc").exists()
+    # the PRIVATE key never leaves the gitignored homedir
+    assert (signed_tree / ".gnupg").exists()
+    assert "PRIVATE KEY" not in pub
+
+
+def test_verify_from_pubkey_only(signed_tree):
+    r = _run("verify", signed_tree)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "0 failed" in r.stdout
+
+
+def test_tampering_fails_verification(signed_tree, tmp_path):
+    tampered = tmp_path / "copy"
+    # verify needs only the tree + signatures — NOT .gnupg (whose
+    # gpg-agent sockets break copytree, and whose absence is the point:
+    # a third party never has the signer's homedir)
+    shutil.copytree(signed_tree, tampered,
+                    ignore=shutil.ignore_patterns(".gnupg"))
+    f = tampered / "results" / "baselines.json"
+    f.write_text(f.read_text() + " ")
+    r = _run("verify", tampered)
+    assert r.returncode == 1
+    assert "BAD SIGNATURE" in r.stderr
+
+
+def test_deleted_signature_fails_verification(signed_tree, tmp_path):
+    """Tamper-by-deletion: stripping a file's .asc (or all of them) must
+    fail — a present manifest file with no signature is never a skip."""
+    tampered = tmp_path / "copy"
+    shutil.copytree(signed_tree, tampered,
+                    ignore=shutil.ignore_patterns(".gnupg"))
+    (tampered / "results" / "signing" / "results__baselines.json.asc").unlink()
+    r = _run("verify", tampered)
+    assert r.returncode == 1
+    assert "MISSING SIGNATURE" in r.stderr
+    # stripping everything is a vacuous (= failed) verification
+    for p in (tampered / "results" / "signing").glob("*.asc"):
+        if p.name != "pubkey.asc":
+            p.unlink()
+    r2 = _run("verify", tampered)
+    assert r2.returncode == 1
+
+
+def test_committed_signatures_verify():
+    """The signatures committed in THIS repo must verify for a third
+    party holding only the tree (skips until the first sign run)."""
+    if not (ROOT / "results" / "signing" / "pubkey.asc").exists():
+        pytest.skip("repo not yet signed")
+    r = _run("verify", ROOT)
+    assert r.returncode == 0, r.stdout + r.stderr

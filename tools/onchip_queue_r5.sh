@@ -62,4 +62,10 @@ stage ref_harness3    python tools/run_reference_harness.py --backend tpu --lab 
 stage tune_flash      python tools/tune_flash.py
 # mechanical regression verdict over the fresh headline+registry lines
 stage regression      python tools/check_regression.py results/bench_r5.jsonl
+# re-sign: the stages above rewrite signed artifacts (pallas_tpu_parity
+# .json; baselines.json under a later --update) — signatures must track
+# them or tests/test_signing.py::test_committed_signatures_verify reds.
+# No relay gate: signing is host-only.
+python tools/sign_artifacts.py sign > "$L/resign.log" 2>&1
+echo "== resign rc=$? $(date)" >> $L/queue.status
 echo "QUEUE DONE $(date)" >> $L/queue.status

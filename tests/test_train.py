@@ -108,6 +108,23 @@ class TestMeshTraining:
         _, loss = train(steps=3, batch=4, seq=32, cfg=TINY, mesh_devices=8, log=_quiet)
         assert np.isfinite(loss)
 
+    def test_windowed_ring_train_matches_single_device(self):
+        """The FULL train step through the windowed ring sp path (flash
+        custom_vjp inside the unrolled O(window) rotation loop) must
+        reproduce the single-device windowed loss trajectory — the
+        topology changes the schedule, never the function."""
+        import dataclasses
+
+        wcfg = dataclasses.replace(TINY, attn_window=8, sp_impl="ring",
+                                   attn_impl="flash")
+        _, mesh_loss = train(steps=4, batch=4, seq=32, cfg=wcfg,
+                             mesh_devices=8, log=_quiet)
+        _, solo_loss = train(
+            steps=4, batch=4, seq=32,
+            cfg=dataclasses.replace(wcfg, attn_impl="dense"), log=_quiet)
+        assert np.isfinite(mesh_loss)
+        assert abs(mesh_loss - solo_loss) < 1e-4, (mesh_loss, solo_loss)
+
 
 class TestMemoryLevers:
     def test_remat_matches_plain(self):

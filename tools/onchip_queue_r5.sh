@@ -60,8 +60,17 @@ stage serving_tpu     python tools/serving_tpu.py
 stage ref_harness2    python tools/run_reference_harness.py --backend tpu --lab lab2 --k-times 5
 stage ref_harness3    python tools/run_reference_harness.py --backend tpu --lab lab3 --k-times 5
 stage tune_flash      python tools/tune_flash.py
-# mechanical regression verdict over the fresh headline+registry lines
-stage regression      python tools/check_regression.py results/bench_r5.jsonl
+# mechanical regression verdict + ratchet in ONE pass, ungated like the
+# re-sign below (host-only JSON diff — a relay gate here could hang the
+# queue after the chip stages already rewrote artifacts).  --update
+# prints the identical per-metric verdict and REFUSES to write on a
+# mixed run (any regression present), so a half-broken relay window can
+# never tighten baselines for the rows that happened to look good; on a
+# clean improving run it ratchets with round-5 provenance (VERDICT r4
+# weak #8: the gate was ratcheting against round-1/2 numbers).
+python tools/check_regression.py results/bench_r5.jsonl --update \
+    --date "2026-07-31 round 5 (onchip_queue_r5)" > "$L/regression.log" 2>&1
+echo "== regression+ratchet rc=$? $(date)" >> $L/queue.status
 # re-sign: the stages above rewrite signed artifacts (pallas_tpu_parity
 # .json; baselines.json under a later --update) — signatures must track
 # them or tests/test_signing.py::test_committed_signatures_verify reds.

@@ -29,7 +29,7 @@ import os
 
 import jax
 
-__version__ = "0.2.0"
+__version__ = "0.5.0"  # keep in sync with pyproject.toml [project] version
 
 # The reference suite is double-precision end-to-end on the host side
 # (lab1 vectors span [-1e100, 1e100]; lab3 statistics are f64 — see

@@ -89,15 +89,29 @@ def attention_reference(q, k, v, causal: bool = True, window: int = 0):
     return o.astype(q.dtype)
 
 
+def n_live_rotations(window: int, shard: int, p: int) -> int:
+    """How many of a causal ring's p-1 K/V rotations can contribute
+    under a sliding ``window``: the block visiting at step t sits t
+    shards earlier, so its NEAREST (query, key) pair is (t-1)*shard + 1
+    positions apart — dead once that exceeds window - 1.  THE one
+    counting shared by the dense and flash windowed bodies; window <= 1
+    (self-only) needs no rotation at all."""
+    if window <= 1:
+        return 0
+    return min(p - 1, 1 + (window - 2) // shard)
+
+
 def _ring_body(q, k, v, *, axis: str, causal: bool, window: int = 0):
     """Per-device ring attention over sequence shards (runs in shard_map).
 
     ``q, k, v``: (..., seq/p, heads, d).  K/V rotate p-1 times; each step
     folds the visiting block into the online-softmax accumulator with the
     correct global causal offsets.  ``window`` > 0 (causal only) adds the
-    sliding-window cut to the same global-position bias; blocks wholly
-    outside the window fold as all-masked no-ops (p == 0 — every row's
-    running max is already finite after the t=0 self block).
+    sliding-window cut to the same global-position bias, and the rotation
+    loop truncates to the ``1 + ceil((window-1)/shard)`` steps that can
+    contribute (the bound is static — same counting as the flash body's
+    ``n_live``): blocks past the window are provably dead, so neither
+    their ppermute nor their matmul runs.
     """
     p = jax.lax.axis_size(axis)
     idx = jax.lax.axis_index(axis)
@@ -138,7 +152,12 @@ def _ring_body(q, k, v, *, axis: str, causal: bool, window: int = 0):
         vt = jax.lax.ppermute(vt, axis, perm)
         return m, l, o, kt, vt
 
-    m, l, o, _, _ = jax.lax.fori_loop(0, p, step, (m0, l0, o0, k, v))
+    if causal and window:
+        # t=0 is the self block, then only the live rotations
+        n_steps = 1 + n_live_rotations(window, seq_local, p)
+    else:
+        n_steps = p
+    m, l, o, _, _ = jax.lax.fori_loop(0, n_steps, step, (m0, l0, o0, k, v))
     out = o / l[..., None].swapaxes(-2, -3)  # (..., h, q) -> (..., q, h, 1)
     return out.astype(q.dtype)
 
@@ -242,7 +261,7 @@ def _ring_body_flash_windowed(q, k, v, *, axis: str, window: int):
     o, lse = attend(q, k, v, causal=True, window=window)
     o = o.astype(jnp.float32)
     perm = [(i, (i + 1) % p) for i in range(p)]
-    n_live = 0 if window <= 1 else min(p - 1, 1 + (window - 2) // sl)
+    n_live = n_live_rotations(window, sl, p)
     kt, vt = k, v
     for t in range(1, n_live + 1):
         kt = jax.lax.ppermute(kt, axis, perm)

@@ -158,7 +158,8 @@ def _paged_attend(q, kpool_l, vpool_l, tables, lengths, block_size: int,
     return o.reshape(S, 1, h, dh).astype(q.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "block_size", "attn"))
+@functools.partial(jax.jit, static_argnames=("cfg", "block_size", "attn"),
+                   donate_argnums=(2, 3))
 def paged_decode_step(params, tokens, kpool, vpool, tables, lengths,
                       cfg: LabformerConfig, block_size: int,
                       attn: str = "gather"):
@@ -171,7 +172,16 @@ def paged_decode_step(params, tokens, kpool, vpool, tables, lengths,
 
     ``attn``: "gather" (XLA gather + dense attend) or "pallas" (the
     scalar-prefetch paged kernel, ops/pallas/paged — no materialized KV
-    copy)."""
+    copy).
+
+    The pools are DONATED (here and in paged_extend/_scatter_prefill):
+    each tick writes a handful of (block, offset) rows, and without
+    input-output aliasing XLA must materialize a fresh pool — a full
+    HBM copy of every layer's K and V pool per generated token, easily
+    rivaling the attention reads themselves at serving sizes.  The
+    engine never touches a stale pool reference (self.kpool/self.vpool
+    are reassigned from every call), and the prefix cache holds block
+    INDICES, not arrays, so nothing can read a donated buffer."""
     S = tokens.shape[0]
     h, dh, kvh = cfg.n_heads, cfg.head_dim, cfg.kv_heads
     x = embed_lookup(params["embed"], tokens, cfg.dtype)[:, None, :]
@@ -214,7 +224,8 @@ def paged_decode_step(params, tokens, kpool, vpool, tables, lengths,
     return logits, kpool, vpool
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "block_size", "bucket"))
+@functools.partial(jax.jit, static_argnames=("cfg", "block_size", "bucket"),
+                   donate_argnums=(2, 3))
 def paged_extend(params, tokens, kpool, vpool, table_row, start, n_valid,
                  cfg: LabformerConfig, block_size: int, bucket: int):
     """Extend one slot's paged KV by running the model over ``tokens``
@@ -265,7 +276,8 @@ def paged_extend(params, tokens, kpool, vpool, table_row, start, n_valid,
     return kpool, vpool
 
 
-@functools.partial(jax.jit, static_argnames=("bucket", "block_size"))
+@functools.partial(jax.jit, static_argnames=("bucket", "block_size"),
+                   donate_argnums=(0, 1))
 def _scatter_prefill(kpool, vpool, k_seq, v_seq, table_row, start, p,
                      bucket: int, block_size: int):
     """Move dense prefill K/V (L, bucket, kv, d) into the pool along one

@@ -333,10 +333,12 @@ def ring_attention(
     memory instead of (seq/p)^2 score blocks).
 
     ``window`` > 0 (causal only) is sliding-window attention across the
-    ring: the flash path unrolls only the ``ceil((window-1)/shard)``
-    live rotations, making communication and compute O(window) per
-    device (see :func:`_ring_body_flash_windowed`); the dense path
-    masks by global position over the full rotation.
+    ring: BOTH paths run only the live rotations
+    (:func:`n_live_rotations` — communication O(window) per device).
+    The flash path additionally streams each visiting block through the
+    kernel's banded ``q_offset`` masks
+    (:func:`_ring_body_flash_windowed`); the dense path masks by global
+    position within its truncated loop.
     """
     mesh = mesh or make_mesh(axes=(axis,))
     if window and not causal:

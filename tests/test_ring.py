@@ -188,6 +188,30 @@ class TestWindowedRing:
         with pytest.raises(NotImplementedError, match="causal"):
             ring_attention(q, k, v, mesh=mesh_sp, causal=False, window=4)
 
+    def test_live_rotation_count(self):
+        """The shared dense/flash rotation bound: step t's nearest
+        (q, k) pair is (t-1)*shard + 1 apart — brute-force cross-check."""
+        from tpulab.parallel.ring import n_live_rotations
+
+        for shard in (1, 4, 8):
+            for p in (2, 4, 8):
+                for window in (0, 1, 2, shard, shard + 1, 3 * shard, 10**6):
+                    if not window:
+                        continue  # windowless rings use n_steps = p
+                    # true brute force: enumerate every (i, j) pair of
+                    # every visiting step against the ring bodies' mask
+                    # condition (keep iff 0 <= reach < window); a step
+                    # is live iff ANY pair survives
+                    live = [
+                        t for t in range(1, p)
+                        if any(0 <= t * shard + i - j < window
+                               for i in range(shard) for j in range(shard))
+                    ]
+                    # liveness is contiguous from t=1, so count == max t
+                    assert live == list(range(1, len(live) + 1))
+                    assert n_live_rotations(window, shard, p) == len(live), (
+                        window, shard, p, live)
+
     def test_matches_windowed_ulysses(self, mesh_sp, rng):
         """The two windowed sp paths must agree with each other too."""
         q, k, v = _qkv(rng)

@@ -159,16 +159,54 @@ def test_int8_kv_logits_close():
 
 
 def test_int8_kv_refusals():
-    cfg = LabformerConfig(d_model=32, n_heads=4, n_layers=2, d_ff=64,
-                          max_seq=64)
-    params = init_params(cfg, seed=0)
-    with pytest.raises(ValueError, match="gather path"):
-        PagedEngine(params, cfg, slots=1, n_blocks=8, block_size=8,
-                    max_seq=32, attn="pallas", kv_dtype="int8")
     from tpulab.models.paged import init_pools
 
+    cfg = LabformerConfig(d_model=32, n_heads=4, n_layers=2, d_ff=64,
+                          max_seq=64)
     with pytest.raises(ValueError, match="expected"):
         init_pools(cfg, 8, 8, "fp4")
+
+
+def test_kernel_matches_gather_int8_pools():
+    """The kernel's in-kernel dequantization must agree with the gather
+    path's _pool_gather recipe on the SAME quantized pools — int8 KV no
+    longer forces the gather path."""
+    from tpulab.models.paged import _kv_quant, _paged_attend
+
+    rng = np.random.default_rng(5)
+    S, M, BS, d, P, h, kvh = 3, 4, 16, 64, 32, 8, 2
+    q = jnp.asarray(rng.standard_normal((S, 1, h, d)), jnp.bfloat16)
+    kf = rng.standard_normal((P, BS, kvh, d)).astype(np.float32)
+    vf = rng.standard_normal((P, BS, kvh, d)).astype(np.float32)
+    kp = tuple(jnp.asarray(a) for a in _kv_quant(jnp.asarray(kf)))
+    vp = tuple(jnp.asarray(a) for a in _kv_quant(jnp.asarray(vf)))
+    tables = jnp.asarray(
+        rng.choice(P, (S, M), replace=False).reshape(S, M), jnp.int32)
+    for window in (0, 11):
+        lengths = jnp.asarray([1, 30, 64], jnp.int32)
+        want = np.asarray(_paged_attend(q, kp, vp, tables, lengths, BS,
+                                        window), np.float32)
+        got = np.asarray(paged_attend_pallas(q, kp, vp, tables, lengths,
+                                             BS, window), np.float32)
+        np.testing.assert_allclose(got, want, atol=2e-2, rtol=2e-2,
+                                   err_msg=f"window={window}")
+
+
+def test_engine_pallas_int8_matches_gather_int8():
+    """Engine tokens through pallas+int8 == gather+int8 (the serving
+    matrix's last cell): same quantize-on-write pools, two read paths."""
+    cfg = LabformerConfig(d_model=64, n_heads=8, n_kv_heads=4, n_layers=2,
+                          d_ff=128, max_seq=64, dtype=jnp.bfloat16)
+    params = _trained_params(cfg)
+    prompt = (np.arange(5) % 7).astype(np.int32)
+
+    def tokens(attn):
+        eng = PagedEngine(params, cfg, slots=2, n_blocks=16, block_size=8,
+                          max_seq=64, attn=attn, kv_dtype="int8")
+        rid = eng.submit(prompt, max_new=6)
+        return eng.run()[rid]
+
+    assert np.array_equal(tokens("pallas"), tokens("gather"))
 
 
 def test_cancel_releases_exactly_what_admission_allocated():

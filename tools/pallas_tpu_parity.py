@@ -240,6 +240,29 @@ def run_checks() -> list:
             "tol": 2e-2,  # bf16 inputs, f32 softmax/acc both sides
             "within_tol": bool(perr < 2e-2),
         })
+
+    # int8 KV pools read IN-KERNEL (dequant via the gather recipe): the
+    # scale operands ride trailing-singleton lane blocks and the int8
+    # data rides (1, BS, 1, d) blocks — both layouts only real Mosaic
+    # tiling rules can certify
+    from tpulab.models.paged import _kv_quant
+
+    kq = tuple(jnp.asarray(a) for a in _kv_quant(kp.astype(jnp.float32)))
+    vq = tuple(jnp.asarray(a) for a in _kv_quant(vp.astype(jnp.float32)))
+    got = np.asarray(paged_attend_pallas(
+        q, kq, vq, tables, lengths, BS, 0, interpret=False
+    ).astype(jnp.float32))
+    want = np.asarray(_paged_attend(
+        q, kq, vq, tables, lengths, BS, 0).astype(jnp.float32))
+    qerr = float(np.max(np.abs(got - want)))
+    checks.append({
+        "kernel": "pallas_paged_attention_int8",
+        "shape": [S, M, BS, h, kvh, d],
+        "dtype": "int8+f32scale",
+        "max_abs_err": qerr,
+        "tol": 2e-2,  # identical dequant recipe both sides
+        "within_tol": bool(qerr < 2e-2),
+    })
     return checks
 
 

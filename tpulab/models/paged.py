@@ -391,14 +391,9 @@ class PagedEngine:
             # the kernel is single-device; under tp the gather path's
             # GSPMD partitioning is the supported route
             raise ValueError("attn='pallas' does not support mesh serving")
-        if kv_dtype == "int8":
-            if attn == "pallas":
-                raise ValueError(
-                    "kv_dtype='int8' is served by the gather path (the "
-                    "pallas kernel reads native-dtype pools)")
-            if mesh is not None:
-                raise ValueError("kv_dtype='int8' does not support mesh "
-                                 "serving (scale pools are unsharded)")
+        if kv_dtype == "int8" and mesh is not None:
+            raise ValueError("kv_dtype='int8' does not support mesh "
+                             "serving (scale pools are unsharded)")
         self.params = params
         self.cfg = cfg
         self.slots = slots

@@ -40,9 +40,12 @@ from jax.experimental.pallas import tpu as pltpu
 NEG_INF = float(np.finfo(np.float32).min)
 
 
-def _kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref, o_ref,
-            m_ref, l_ref, acc_ref, *, block_size: int, window: int,
-            out_dtype):
+def _kernel(tables_ref, lengths_ref, q_ref, *refs, block_size: int,
+            window: int, out_dtype, quantized: bool = False):
+    if quantized:
+        k_ref, ks_ref, v_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = refs
+    else:
+        k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref = refs
     s_i = pl.program_id(0)
     j = pl.program_id(2)
     n_blocks = pl.num_programs(2)
@@ -58,8 +61,18 @@ def _kernel(tables_ref, lengths_ref, q_ref, k_ref, v_ref, o_ref,
     @pl.when(j * block_size < length)
     def _attend():
         qb = q_ref[0, 0]                      # (G, d)
-        kb = k_ref[0, :, 0, :]                # (BS, d)
-        vb = v_ref[0, :, 0, :]
+        if quantized:
+            # in-kernel dequantization, SAME recipe as the gather
+            # path's _pool_gather (models/paged): f32 data * per-row
+            # scale, rounded back through the query dtype so both
+            # int8 read paths see identical KV values
+            kb = (k_ref[0, :, 0, :].astype(jnp.float32)
+                  * ks_ref[0, :, 0, :]).astype(qb.dtype)
+            vb = (v_ref[0, :, 0, :].astype(jnp.float32)
+                  * vs_ref[0, :, 0, :]).astype(qb.dtype)
+        else:
+            kb = k_ref[0, :, 0, :]            # (BS, d)
+            vb = v_ref[0, :, 0, :]
         scores = jax.lax.dot_general(
             qb, kb, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -97,11 +110,22 @@ def paged_attend_pallas(q, kpool_l, vpool_l, tables, lengths,
                         interpret: Optional[bool] = None):
     """Drop-in twin of models/paged._paged_attend.
 
-    q (S, 1, h, d); pools (P, BS, kvh, d); tables (S, M) int32; lengths
-    (S,) int32.  Returns (S, 1, h, d) in q's dtype.
+    q (S, 1, h, d); pools (P, BS, kvh, d) — or ``(int8 data, f32 scale
+    (P, BS, kvh))`` pairs for int8 KV serving, dequantized IN-KERNEL
+    with the gather path's exact recipe so the two int8 read paths
+    agree; tables (S, M) int32; lengths (S,) int32.  Returns
+    (S, 1, h, d) in q's dtype.
     """
     if interpret is None:
         interpret = jax.devices()[0].platform != "tpu"
+    quantized = isinstance(kpool_l, tuple)
+    if quantized != isinstance(vpool_l, tuple):
+        raise ValueError("kpool and vpool must both be quantized or both "
+                         "native")
+    kscale = vscale = None
+    if quantized:
+        kpool_l, kscale = kpool_l
+        vpool_l, vscale = vpool_l
     S, one, h, d = q.shape
     P, BS, kvh, dk = kpool_l.shape
     assert one == 1 and dk == d
@@ -125,17 +149,35 @@ def paged_attend_pallas(q, kpool_l, vpool_l, tables, lengths,
     tables_flat = tables.reshape(-1).astype(jnp.int32)
     lengths = lengths.astype(jnp.int32)
 
+    q_spec = pl.BlockSpec((1, 1, G, d),
+                          lambda s, c, j, tabs, lens: (s, c, 0, 0))
+    pool_spec = pl.BlockSpec(
+        (1, BS, 1, d), lambda s, c, j, tabs, lens: (tabs[s * M + j], 0, c, 0))
+    # operands/in_specs hold the POOL-SIDE inputs only (q rides its own
+    # spec and argument slot) — one list to keep in sync with _kernel's
+    # ref unpack order
+    in_specs = [pool_spec]
+    operands = [kpool_l]
+    if quantized:
+        # scales ride a trailing-singleton lane dim (see the lse note in
+        # ops/pallas/attention._flash_kernel): a (BS, 1) block over
+        # (P, BS, kvh) has lane = kvh-with-block-1, which Mosaic's
+        # tiling rejects; (P, BS, kvh, 1) with block (1, BS, 1, 1)
+        # satisfies lane == array dim == 1
+        scale_spec = pl.BlockSpec(
+            (1, BS, 1, 1),
+            lambda s, c, j, tabs, lens: (tabs[s * M + j], 0, c, 0))
+        in_specs.append(scale_spec)
+        operands.append(kscale[..., None])
+    in_specs.append(pool_spec)
+    operands.append(vpool_l)
+    if quantized:
+        in_specs.append(scale_spec)
+        operands.append(vscale[..., None])
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(S, kvh, M),
-        in_specs=[
-            pl.BlockSpec((1, 1, G, d),
-                         lambda s, c, j, tabs, lens: (s, c, 0, 0)),
-            pl.BlockSpec((1, BS, 1, d),
-                         lambda s, c, j, tabs, lens: (tabs[s * M + j], 0, c, 0)),
-            pl.BlockSpec((1, BS, 1, d),
-                         lambda s, c, j, tabs, lens: (tabs[s * M + j], 0, c, 0)),
-        ],
+        in_specs=[q_spec, *in_specs],
         out_specs=pl.BlockSpec((1, 1, G, d),
                                lambda s, c, j, tabs, lens: (s, c, 0, 0)),
         scratch_shapes=[
@@ -145,12 +187,13 @@ def paged_attend_pallas(q, kpool_l, vpool_l, tables, lengths,
         ],
     )
     kernel = functools.partial(
-        _kernel, block_size=block_size, window=window, out_dtype=q.dtype
+        _kernel, block_size=block_size, window=window, out_dtype=q.dtype,
+        quantized=quantized,
     )
     out = pl.pallas_call(
         kernel,
         out_shape=jax.ShapeDtypeStruct((S, kvh, G, d), q.dtype),
         grid_spec=grid_spec,
         interpret=interpret,
-    )(tables_flat, lengths, qs, kpool_l, vpool_l)
+    )(tables_flat, lengths, qs, *operands)
     return out[:, :, :g, :].reshape(S, 1, h, d)

@@ -203,3 +203,46 @@ class TestSlidingWindow:
         with pytest.raises(NotImplementedError):
             flash_attention(q, k, v, causal=False, window=32,
                             block_q=64, block_k=64)
+
+
+class TestBlockEdgePredicates:
+    """_block_edges gates which (qb, kb) blocks the fwd AND both bwd
+    kernels compute/mask: a wrong ``active`` silently ZEROES real
+    contributions (no crash), a wrong ``edge`` skips the positional
+    mask.  Brute-force the predicates against the kernels' own mask
+    condition across window/offset/block geometries."""
+
+    def test_predicates_match_mask_brute_force(self):
+        from tpulab.ops.pallas.attention import _block_edges
+
+        for bq, bk in ((8, 8), (8, 16), (16, 8)):
+            for s_q, s_k in ((32, 32), (16, 32)):
+                for window in (0, 1, 5, 8, 17, 64):
+                    for q_offset in (0, 8, 32, 48):
+                        for qb in range(s_q // bq):
+                            for kb in range(s_k // bk):
+                                keep = [
+                                    (k_pos <= q_pos)
+                                    and (not window
+                                         or k_pos > q_pos - window)
+                                    for i in range(bq)
+                                    for j in range(bk)
+                                    for q_pos in [q_offset + qb * bq + i]
+                                    for k_pos in [kb * bk + j]
+                                ]
+                                active, edge = _block_edges(
+                                    qb, kb, bq, bk, window, q_offset)
+                                want_active = any(keep)
+                                want_fully_visible = all(keep)
+                                # active must never UNDER-approximate
+                                # (dropping a live block loses weight);
+                                # over-approximation is mere waste
+                                if want_active:
+                                    assert bool(active), (
+                                        bq, bk, window, q_offset, qb, kb)
+                                # a block the kernel treats as fully
+                                # visible (active and not edge) must
+                                # truly have every position visible
+                                if bool(active) and not bool(edge):
+                                    assert want_fully_visible, (
+                                        bq, bk, window, q_offset, qb, kb)

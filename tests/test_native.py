@@ -667,6 +667,28 @@ class TestDaemonSamplingControls:
 
 
 class TestDaemonPromptLookup:
+    def test_spec_batches_through_engine_with_counters(self, daemon):
+        """Speculative requests ride the shared engine now: after a
+        prompt_lookup request the SAME engine's generate_stats exposes
+        the new verify counters (spec_rounds/spec_accepted), and an
+        over-window draft_k refuses loudly instead of compiling a new
+        shape."""
+        status, _ = _raw_request_bytes(
+            daemon,
+            b'{"lab": "generate", "config": {"steps": 12, '
+            b'"prompt_lookup": true}}',
+            b"abcabcabcabc")
+        assert status == 0
+        s, st = _raw_request_bytes(daemon, b'{"lab": "generate_stats"}', b"")
+        stats = json.loads(st)
+        assert s == 0 and stats.get("spec_rounds", 0) > 0, stats
+        assert stats.get("verify_passes", 0) > 0
+        status, err = _raw_request(
+            daemon,
+            b'{"lab": "generate", "config": {"steps": 2, '
+            b'"prompt_lookup": true, "draft_k": 9}}', b"x")
+        assert status == 1 and "verify window" in err
+
     def test_prompt_lookup_over_wire_is_lossless(self, daemon):
         plain = _raw_request_bytes(
             daemon, b'{"lab": "generate", "config": {"steps": 8}}', b"lkp")

@@ -114,6 +114,56 @@ def test_unusable_inputs_exit_2(tmp_path):
     assert proc.returncode == 2, proc.stderr
 
 
+def test_update_refuses_worse_direction_without_note(tmp_path):
+    """VERDICT round-5 #6: --update must never move a baseline in the
+    worse direction unless the update carries explicit provenance."""
+    proc, bfile = _run(tmp_path, [{"metric": "m_ms", "value": 1.5}], BASE,
+                       extra=("--update", "--date", "r6"))
+    assert proc.returncode == 1
+    assert "NOT ratcheting" in proc.stderr
+    new = json.loads(bfile.read_text())["baselines"]
+    assert new["m_ms"]["value"] == 1.0  # untouched
+    assert "regression_accepted" not in new["m_ms"]
+
+
+def test_accept_regression_moves_baseline_with_provenance(tmp_path):
+    """With --accept-regression NOTE the regressed entry moves AND
+    records the note; co-improving metrics ratchet in the same pass."""
+    proc, bfile = _run(tmp_path, [
+        {"metric": "m_ms", "value": 1.5},     # regressed: accepted
+        {"metric": "m_tps", "value": 140.0},  # improved: ratchets
+    ], BASE, extra=("--update", "--date", "r6",
+                    "--accept-regression", "relay rebuilt, new floor"))
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    new = json.loads(bfile.read_text())["baselines"]
+    assert new["m_ms"]["value"] == 1.5
+    assert new["m_ms"]["measured"] == "r6"
+    assert new["m_ms"]["regression_accepted"] == "relay rebuilt, new floor"
+    assert new["m_tps"]["value"] == 140.0
+    assert "regression_accepted" not in new["m_tps"]
+
+
+def test_later_improvement_clears_accepted_note(tmp_path):
+    """A clean ratchet supersedes an earlier accepted regression: the
+    stale regression_accepted note must not survive onto the improved
+    value (false provenance)."""
+    base = {"m_ms": {"value": 1.5, "tol_rel": 0.2, "direction": "lower",
+                     "measured": "r6", "regression_accepted": "relay"}}
+    proc, bfile = _run(tmp_path, [{"metric": "m_ms", "value": 0.9}], base,
+                       extra=("--update", "--date", "r7"))
+    assert proc.returncode == 0
+    new = json.loads(bfile.read_text())["baselines"]
+    assert new["m_ms"]["value"] == 0.9 and new["m_ms"]["measured"] == "r7"
+    assert "regression_accepted" not in new["m_ms"]
+
+
+def test_accept_regression_requires_update(tmp_path):
+    proc, _ = _run(tmp_path, [{"metric": "m_ms", "value": 1.5}], BASE,
+                   extra=("--accept-regression", "note"))
+    assert proc.returncode == 2
+    assert "--update" in proc.stderr
+
+
 def test_update_refuses_on_mixed_run(tmp_path):
     proc, bfile = _run(tmp_path, [
         {"metric": "m_ms", "value": 0.5},    # improved

@@ -62,6 +62,31 @@ class TestCheckpointResume:
         train(steps=8, batch=2, seq=32, cfg=TINY, ckpt_dir=d, save_every=4,
               resume=True, log=_quiet)
 
+    def test_resume_tolerates_pre_field_sidecar(self, tmp_path):
+        """A sidecar recorded before a config field existed must keep
+        resuming as long as this invocation leaves the field at its
+        dataclass default; an explicit non-default value for the
+        unrecorded field still refuses loudly (round-5 advisor: the
+        old all-keys diff hard-failed every pre-field checkpoint
+        forever)."""
+        d = str(tmp_path / "ck")
+        train(steps=4, batch=2, seq=32, cfg=TINY, ckpt_dir=d, save_every=4,
+              log=_quiet)
+        sc = os.path.join(d, "tpulab_config.json")
+        with open(sc) as f:
+            sidecar = json.load(f)
+        sidecar["config"].pop("attn_window")  # pretend pre-window era
+        with open(sc, "w") as f:
+            json.dump(sidecar, f)
+        # default attn_window == 0: the missing key matches
+        train(steps=8, batch=2, seq=32, cfg=TINY, ckpt_dir=d, save_every=4,
+              resume=True, log=_quiet)
+        changed = LabformerConfig(d_model=32, n_heads=4, n_layers=2,
+                                  d_ff=64, max_seq=32, attn_window=8)
+        with pytest.raises(ValueError, match="not recorded"):
+            train(steps=12, batch=2, seq=32, cfg=changed, ckpt_dir=d,
+                  save_every=4, resume=True, log=_quiet)
+
     def test_fresh_run_clears_stale_dir(self, tmp_path):
         d = str(tmp_path / "ck")
         train(steps=5, batch=2, seq=32, cfg=TINY, ckpt_dir=d, save_every=5, log=_quiet)

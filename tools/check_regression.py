@@ -60,10 +60,19 @@ def main(argv=None) -> int:
                          "beyond tolerance")
     ap.add_argument("--date", default=None,
                     help="date stamp recorded with --update entries")
+    ap.add_argument("--accept-regression", default=None, metavar="NOTE",
+                    help="with --update: ALSO move baselines for "
+                         "regressed metrics, recording NOTE as the "
+                         "entry's regression_accepted provenance.  "
+                         "Without it, --update refuses to move any "
+                         "baseline in the worse direction (and, as "
+                         "before, refuses to ratchet a mixed run).")
     args = ap.parse_args(argv)
     if args.update and not args.date:
         ap.error("--update requires --date (provenance must move with "
                  "the ratcheted value)")
+    if args.accept_regression and not args.update:
+        ap.error("--accept-regression only makes sense with --update")
 
     try:
         table = json.loads(pathlib.Path(args.baselines).read_text())
@@ -107,6 +116,18 @@ def main(argv=None) -> int:
             spec["value"] = val
             if args.date:
                 spec["measured"] = args.date
+            # a clean improvement supersedes any earlier accepted
+            # regression: leaving the note would attach false
+            # provenance to the ratcheted value
+            spec.pop("regression_accepted", None)
+        elif state == "regressed" and args.update and args.accept_regression:
+            # moving a baseline in the WORSE direction is only legal
+            # with explicit provenance: the note travels with the entry
+            # so later rounds can see the regression was accepted, not
+            # laundered in by a half-broken run (VERDICT round-5 #6)
+            spec["value"] = val
+            spec["measured"] = args.date
+            spec["regression_accepted"] = args.accept_regression
     for m in missing:
         print(f"[missing] {m}: not in this bench run")
     for m in sorted(set(got) - set(base)):
@@ -114,19 +135,30 @@ def main(argv=None) -> int:
         # silently stop being checked
         print(f"[unknown] {m}: measured but not in the baseline table")
 
-    if args.update and improved:
-        if regressed:
+    if args.update and (improved or regressed):
+        if regressed and not args.accept_regression:
             # a half-broken run must not permanently tighten baselines
-            # for the metrics that happened to look good
-            print("NOT ratcheting: this run also contains regressions — "
-                  "fix or rerun before --update", file=sys.stderr)
+            # for the metrics that happened to look good — and must
+            # NEVER move one in the worse direction without provenance
+            print("NOT ratcheting: this run contains regressions — fix, "
+                  "rerun, or pass --accept-regression NOTE before "
+                  "--update", file=sys.stderr)
         else:
             pathlib.Path(args.baselines).write_text(
                 json.dumps(table, indent=2) + "\n")
-            print(f"ratcheted {len(improved)} baseline(s) -> {args.baselines}")
+            moved = len(improved) + (len(regressed)
+                                     if args.accept_regression else 0)
+            print(f"updated {moved} baseline(s) -> {args.baselines}"
+                  + (f" ({len(regressed)} regression(s) accepted: "
+                     f"{args.accept_regression})"
+                     if args.accept_regression and regressed else ""))
 
     print(f"summary: {len(ok)} ok, {len(improved)} improved, "
           f"{len(regressed)} regressed, {len(missing)} missing")
+    # accepted-and-recorded regressions are a deliberate baseline move,
+    # not a gate failure
+    if regressed and args.update and args.accept_regression:
+        return 0
     return 1 if regressed else 0
 
 

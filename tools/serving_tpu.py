@@ -49,12 +49,14 @@ def _make(cfg_kw, slots, max_seq=512, n_blocks=512, block_size=16):
                              block_size=block_size, max_seq=max_seq)
 
 
-def _run_jobs(params, cfg, eng_kw, jobs, reps=3, warm_prefix=None):
+def _run_jobs(params, cfg, eng_kw, jobs, reps=3, warm_prefix=None,
+              submit_kw=None):
     """Median wall seconds + generated-token count for a job list.
 
     A fresh engine per run keeps block-pool state comparable across
     reps; ``warm_prefix`` (token array) is submitted + drained first so
-    the measured jobs hit a warm prefix cache."""
+    the measured jobs hit a warm prefix cache.  ``submit_kw`` forwards
+    per-request knobs (e.g. ``spec="lookup"``) to every submit."""
     from tpulab.models.paged import PagedEngine
 
     def once():
@@ -64,7 +66,7 @@ def _run_jobs(params, cfg, eng_kw, jobs, reps=3, warm_prefix=None):
             eng.run()
         t0 = time.perf_counter()
         for prompt, n in jobs:
-            eng.submit(prompt, max_new=n)
+            eng.submit(prompt, max_new=n, **(submit_kw or {}))
         out = eng.run()
         dt = time.perf_counter() - t0
         return dt, sum(len(v) for v in out.values()), eng.stats()
@@ -163,6 +165,32 @@ def main(argv=None) -> int:
             "tokens": toks, "wall_s": round(t, 4),
             "tokens_per_s": round(toks / t, 1),
         })
+
+    # --- batched speculative decode (prompt-lookup proposer) vs plain
+    # ticks on lookup-friendly prompts: multi-token verify rounds
+    # commit 1..k+1 tokens per target pass, so the headline is target
+    # passes (ticks) per generated token alongside tokens/s
+    spec_prompt = np.tile(np.arange(24, dtype=np.int32) % 12, 8).astype(
+        np.int32)  # 192 tokens of period-12 structure (templated text)
+    spec_jobs = [(spec_prompt, args.steps) for _ in range(4)]
+    t_plain, toks_p, st_plain = _run_jobs(
+        params, cfg, dict(eng_kw, slots=4), spec_jobs, reps=args.reps)
+    t_spec, toks_s, st_spec = _run_jobs(
+        params, cfg, dict(eng_kw, slots=4, spec_k=4), spec_jobs,
+        reps=args.reps, submit_kw=dict(spec="lookup"))
+    rounds = max(st_spec.get("spec_rounds", 0), 1)
+    scenarios.append({
+        "scenario": "spec_lookup_batch4_k4",
+        "tokens": toks_s, "wall_s": round(t_spec, 4),
+        "tokens_per_s": round(toks_s / t_spec, 1),
+        "accepted_len_mean": round(
+            st_spec.get("spec_accepted", 0) / rounds, 3),
+        "verify_passes_per_token": round(
+            st_spec.get("ticks", 0) / max(toks_s, 1), 4),
+        "plain_ticks_per_token": round(
+            st_plain.get("ticks", 0) / max(toks_p, 1), 4),
+        "speedup_vs_plain": round(t_plain / t_spec, 3),
+    })
 
     # --- prefill throughput: long prompts, 1 new token each
     long_jobs = [(rng.integers(0, cfg.vocab, (384,)).astype(np.int32), 1)

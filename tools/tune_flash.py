@@ -157,7 +157,10 @@ def main(argv=None) -> int:
     # flash_attention pass them through the custom_vjp); the forward
     # time is reused, not re-benchmarked
     for s in args.seqs:
-        cand = [r for r in rows if r["seq"] == s and "fwd_ms" in r]
+        # same b=1 guard as the best pool below (phase 3 runs later, but
+        # the filter must not depend on phase ordering)
+        cand = [r for r in rows if r["seq"] == s
+                and r.get("batch", 1) == 1 and "fwd_ms" in r]
         if not cand:
             continue
         fb = min(cand, key=lambda r: r["fwd_ms"])
@@ -192,13 +195,17 @@ def main(argv=None) -> int:
 
     best = {}
     for s in args.seqs:
-        cand = [r for r in rows if r["seq"] == s and "fwd_ms" in r]
+        # b=1 rows only: phase 3's --train-shape rows share a seq with
+        # the per-seq sweep, and a batched row's time would contaminate
+        # the b=1 winner pool (round-5 advisor finding)
+        pool = [r for r in rows if r["seq"] == s and r.get("batch", 1) == 1]
+        cand = [r for r in pool if "fwd_ms" in r]
         if cand:
             best[f"fwd_s{s}"] = min(cand, key=lambda r: r["fwd_ms"])
-        cand_b = [r for r in rows if r["seq"] == s and "fwdbwd_ms" in r]
+        cand_b = [r for r in pool if "fwdbwd_ms" in r]
         if cand_b:
             best[f"fwdbwd_s{s}"] = min(cand_b, key=lambda r: r["fwdbwd_ms"])
-        cand_bo = [r for r in rows if r["seq"] == s and "bwd_ms" in r]
+        cand_bo = [r for r in pool if "bwd_ms" in r]
         if cand_bo:
             best[f"bwd_s{s}"] = min(cand_bo, key=lambda r: r["bwd_ms"])
     if train_shape:

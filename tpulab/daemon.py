@@ -118,11 +118,19 @@ class _ByteBudget:
 
 
 #: one serving-length policy: the paged engines' max_seq AND the cap on
-#: the single-stream strategies' (beam / speculative) dense caches
+#: the single-stream strategies' (beam) dense caches
 _SERVE_MAX_SEQ = 512
 
-#: serializes host-orchestrated speculative loops (each interleaves many
-#: small dispatches; running two at once thrashes the device queue)
+#: verify window the shared engines compile (requests' draft_k <= this):
+#: speculative requests batch through the SAME PagedEngine ticks as
+#: plain traffic (paged_verify, models/paged) instead of serializing a
+#: host-orchestrated loop behind a global lock
+_SPEC_K = 4
+
+#: serializes the remaining host-orchestrated single-stream strategy
+#: (beam search: many small dispatches; running two at once thrashes
+#: the device queue).  Speculative decoding no longer takes this lock —
+#: it rides the engine's continuous batching.
 _SPEC_LOCK = threading.Lock()
 
 #: engine -> int8 draft params, built lazily on the first speculative
@@ -131,6 +139,7 @@ _SPEC_LOCK = threading.Lock()
 import weakref
 
 _DRAFTS: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_DRAFT_BUILD_LOCK = threading.Lock()
 
 
 def _draft_for(engine):
@@ -139,14 +148,15 @@ def _draft_for(engine):
     checkpoint served under different attn/kv_dtype knobs builds one
     draft per variant — accepted duplication (knob variants of one
     checkpoint are an edge case; path-keying would add staleness
-    bookkeeping the engine key gets for free).  Callers hold
-    _SPEC_LOCK, which also makes the build-once race-free."""
-    draft = _DRAFTS.get(engine)
-    if draft is None:
-        from tpulab.models.quant import quantize_decode_params
+    bookkeeping the engine key gets for free).  _DRAFT_BUILD_LOCK makes
+    the build-once race-free without serializing any decode."""
+    with _DRAFT_BUILD_LOCK:
+        draft = _DRAFTS.get(engine)
+        if draft is None:
+            from tpulab.models.quant import quantize_decode_params
 
-        draft = quantize_decode_params(engine.params, engine.cfg)
-        _DRAFTS[engine] = draft
+            draft = quantize_decode_params(engine.params, engine.cfg)
+            _DRAFTS[engine] = draft
     return draft
 
 
@@ -213,6 +223,7 @@ class _GenerateService:
     def generate(self, engine, prompt, steps: int, *,
                  temperature: float = 0.0, seed: int = 0,
                  repetition_penalty: float = 1.0, stop_byte: int = -1,
+                 spec: str = "off", spec_k: int = 0, spec_ngram: int = 0,
                  on_progress=None):
         """Block until the request finishes; returns the full token
         array.  ``on_progress(new_tokens)``, if given, is called with
@@ -228,7 +239,8 @@ class _GenerateService:
             rid = engine.submit(prompt, max_new=steps,
                                 temperature=temperature, seed=seed,
                                 repetition_penalty=repetition_penalty,
-                                stop_byte=stop_byte)
+                                stop_byte=stop_byte, spec=spec,
+                                spec_k=spec_k, spec_ngram=spec_ngram)
             req = engine.pending[-1]  # just appended under this cond
             if not st.stepper_alive:
                 st.stepper_alive = True
@@ -389,6 +401,11 @@ def _engine_for(ckpt, attn: str = "gather", kv_dtype: str = "native",
     engine = PagedEngine(
         params, cfg, slots=4, n_blocks=128, block_size=16,
         max_seq=_SERVE_MAX_SEQ, attn=attn, kv_dtype=kv_dtype, mesh=mesh,
+        # spec capability costs nothing until a speculative request
+        # arrives (the verify program compiles lazily); the gather-only
+        # constraint is the engine's own (no pallas verify kernel, tp
+        # uncertified)
+        spec_k=_SPEC_K if (attn == "gather" and mesh is None) else 0,
     )
     with _GEN_SERVICE.lock:
         hit = _ENGINES.get(key)
@@ -419,9 +436,15 @@ def _handle_generate(header: dict, payload: bytes,
     (finish right after emitting it; -1 = off), ``stream`` (status-2
     chunk frames), ``attn``/``kv_dtype`` (engine knobs), and
     ``speculative`` + ``draft_k`` (lossless greedy speculative decode
-    with a lazily-built int8 draft — same bytes as plain greedy),
-    ``prompt_lookup`` + ``lookup_ngram`` (draft-FREE lossless
-    speculation: n-gram proposals from the committed sequence),
+    with a lazily-built int8 draft — same bytes as plain greedy;
+    ``draft_k`` <= 4, the engine verify window), ``prompt_lookup`` +
+    ``lookup_ngram`` (draft-FREE lossless speculation: n-gram proposals
+    from the committed sequence) — both now BATCH through the shared
+    engine's multi-token verify ticks (models/paged.paged_verify), so
+    concurrent speculative clients make interleaved progress instead of
+    serializing behind a global lock, and compose with
+    ``repetition_penalty``/``stream``/``stop_byte`` (sampling still
+    refuses) —,
     ``beams`` (beam search; beams=1 == greedy), and ``tp`` (serve the
     engine tensor-parallel over a ``{"tp": N}`` device mesh — the
     gather path's GSPMD partitioning; tokens stay bit-equal to the
@@ -472,17 +495,23 @@ def _handle_generate(header: dict, payload: bytes,
         or float(config.get("repetition_penalty", 1.0)) != 1.0
         or bool(config.get("stream"))
     )
-    # config-only errors: reject BEFORE a cold engine build is paid
-    if bool(config.get("speculative")) and deterministic_combo:
+    # config-only errors: reject BEFORE a cold engine build is paid.
+    # The spec modes are ENGINE-served now, so repetition_penalty and
+    # stream ride the shared engine's batched verify ticks like any
+    # other request (penalized spec is bit-certified in
+    # tests/test_paged_spec.py); only SAMPLING stays refused — a
+    # sampled slot would silently fall back to plain single-token
+    # ticks, and this daemon refuses silent flag drops on principle.
+    sampled = float(config.get("temperature", 0.0)) != 0.0
+    if bool(config.get("speculative")) and sampled:
         raise ValueError(
-            "speculative decoding is greedy and unstreamed: drop "
-            "temperature/repetition_penalty/stream")
+            "speculative decoding is greedy: drop temperature")
     if bool(config.get("prompt_lookup")) and (
-        deterministic_combo or bool(config.get("speculative"))
+        sampled or bool(config.get("speculative"))
     ):
         raise ValueError(
-            "prompt_lookup decoding is greedy and unstreamed: drop "
-            "temperature/repetition_penalty/stream/speculative")
+            "prompt_lookup decoding is greedy: drop "
+            "temperature/speculative")
     if beams and (deterministic_combo or bool(config.get("speculative"))
                   or bool(config.get("prompt_lookup")) or stop_byte >= 0):
         raise ValueError(
@@ -491,6 +520,32 @@ def _handle_generate(header: dict, payload: bytes,
             "prompt_lookup/stop_byte")
     if beams < 0:
         raise ValueError(f"beams must be >= 0, got {beams}")
+    # speculative requests ride the shared engine's batched verify
+    # rounds (models/paged.paged_verify) — validate the spec knobs
+    # BEFORE a cold engine build is paid
+    spec_mode = "off"
+    spec_k = 0
+    spec_ngram = 0
+    if bool(config.get("prompt_lookup")) or bool(config.get("speculative")):
+        spec_k = int(config.get("draft_k", 4))
+        if spec_k < 1:
+            raise ValueError(f"draft_k must be >= 1, got {spec_k}")
+        if spec_k > _SPEC_K:
+            raise ValueError(
+                f"draft_k={spec_k} exceeds the engine verify window "
+                f"{_SPEC_K}")
+        if attn == "pallas":
+            raise ValueError(
+                "speculative decoding serves through the gather engine "
+                "(no pallas verify kernel): drop attn='pallas'")
+        if bool(config.get("prompt_lookup")):
+            spec_mode = "lookup"
+            spec_ngram = int(config.get("lookup_ngram", 3))
+            if spec_ngram < 1:
+                raise ValueError(
+                    f"lookup_ngram must be >= 1, got {spec_ngram}")
+        else:
+            spec_mode = "draft"
     if tp > 1 and (beams or bool(config.get("speculative"))
                    or bool(config.get("prompt_lookup"))):
         # the host-orchestrated strategies bypass the mesh engine's
@@ -514,29 +569,6 @@ def _handle_generate(header: dict, payload: bytes,
         prompt = tok.encode(bytes(payload))
         eng_stop = -1
 
-    def _single_stream(k, fn):
-        """Common scaffold for the host-orchestrated strategies:
-        validate k + the serving-length policy, serialize on the spec
-        lock, run, decode bytes, trim at the stop byte (the engine
-        semantics: the stop byte is the final emitted byte)."""
-        if k < 1:
-            raise ValueError(f"draft_k must be >= 1, got {k}")
-        if len(prompt) + steps + k + 2 > _SERVE_MAX_SEQ:
-            raise ValueError(
-                f"prompt + steps + draft_k + 2 = "
-                f"{len(prompt) + steps + k + 2} exceeds the daemon "
-                f"serving cap {_SERVE_MAX_SEQ}")
-        with _SPEC_LOCK:
-            out, _acc = fn(k)
-        toks = [int(t) for t in np.asarray(out[0])]
-        data = (bytes(t & 0xFF for t in toks) if tok is None
-                else tok.decode(toks))
-        if stop_byte >= 0:
-            cut = data.find(bytes([stop_byte]))
-            if cut >= 0:
-                data = data[: cut + 1]
-        return data
-
     if beams:
         # beam search: host backtrack over a cache-reordering scan —
         # like speculative, a single-stream strategy served outside the
@@ -559,41 +591,22 @@ def _handle_generate(header: dict, payload: bytes,
             return bytes(t & 0xFF for t in toks)
         return tok.decode(toks)
 
-    if bool(config.get("prompt_lookup")):
-        # draft-free speculation: n-gram proposals from the committed
-        # sequence, verified by the target — lossless vs plain greedy,
-        # no draft build at all.
-        ngram = int(config.get("lookup_ngram", 3))
-        if ngram < 1:
-            raise ValueError(f"lookup_ngram must be >= 1, got {ngram}")
-        from tpulab.models.speculative import prompt_lookup_generate
-
-        return _single_stream(
-            int(config.get("draft_k", 4)),
-            lambda k: prompt_lookup_generate(
-                engine.params, engine.cfg, prompt[None, :], steps=steps,
-                k=k, ngram=ngram))
-
-    if bool(config.get("speculative")):
-        # lossless greedy speculative decoding: the engine's (merged)
-        # params serve as target, an int8-quantized copy drafts.  Host-
-        # orchestrated (no continuous batching) — concurrent strategy
-        # requests serialize on one lock instead of thrashing the
-        # device with interleaved host loops.  The sampling-combo
-        # refusal already ran pre-engine-build.
+    if spec_mode == "draft":
+        # lossless greedy speculative decoding IN the shared engine:
+        # the engine's (merged) params verify, an int8-quantized copy
+        # proposes from per-slot dense caches.  Concurrent speculative
+        # clients batch through the same verify ticks as plain traffic
+        # — the old host-orchestrated loop (and its _SPEC_LOCK
+        # serialization) is retired for the paged path.
         if engine.cfg.n_experts:
             raise ValueError(
                 "speculative decoding needs an int8 draft; MoE "
                 "checkpoints are not quantizable (models/quant.py)")
-        from tpulab.models.speculative import speculative_generate
-
-        def run(k):
-            draft = _draft_for(engine)
-            return speculative_generate(
-                draft, engine.cfg, engine.params, engine.cfg,
-                prompt[None, :], steps=steps, k=k)
-
-        return _single_stream(int(config.get("draft_k", 4)), run)
+        if engine.draft_params is None:
+            draft = _draft_for(engine)  # built OUTSIDE the engine cond
+            st = _GEN_SERVICE._state_for(engine)
+            with st.cond:  # serialize install against the stepper
+                engine.set_draft(draft, engine.cfg)
 
     on_progress = None
     if send_chunk is not None and bool(config.get("stream")):
@@ -629,6 +642,7 @@ def _handle_generate(header: dict, payload: bytes,
         seed=int(config.get("seed", 0)),
         repetition_penalty=float(config.get("repetition_penalty", 1.0)),
         stop_byte=eng_stop,
+        spec=spec_mode, spec_k=spec_k, spec_ngram=spec_ngram,
         on_progress=on_progress,
     )
     if tok is None:

@@ -23,7 +23,15 @@ decode step over all S slots, and one prefill-scatter per prompt-length
 bucket (dense prefill reuses generate._prefill on ``prompt[:-1]``, a
 static scatter moves its K/V into the pool, and the first engine step
 consumes the held-back last prompt token through the normal decode
-path — no per-length logits plumbing).
+path — no per-length logits plumbing).  ``spec_k > 0`` adds a THIRD
+fixed-shape program under the same discipline: ``paged_verify``
+(shape ``(slots, spec_k + 1)``) runs one compute-dense target pass over
+each slot's ``[committed, d_1..d_k]`` window, so speculating slots
+commit 1..k+1 tokens per tick (lossless for greedy — bit-identical
+stream) while sampled/plain slots ride row 0 of the same batch as
+ordinary single-token ticks.  Proposers are per-request: prompt-lookup
+n-grams (zero extra model) or an opt-in dense draft model
+(``set_draft``; e.g. the int8-quantized target).
 
 Prefix sharing: block-aligned prompt prefixes are cached (LRU, evicted
 under pool pressure) and their physical blocks reference-counted —
@@ -56,6 +64,8 @@ from tpulab.models.generate import (_attend_cached, _prefill,
                                     apply_repetition_penalty)
 from tpulab.models.labformer import LabformerConfig, _mlp, _rmsnorm, _rope
 from tpulab.models.quant import embed_lookup, qmat, unembed
+from tpulab.models.speculative import (_draft_propose_slots, _lookup_propose,
+                                       _prefill_jit)
 from tpulab.parallel.ring import NEG_INF
 
 TRASH = 0  # physical block 0 swallows must-not-land writes
@@ -114,26 +124,31 @@ def _pool_gather(pool, idx, dtype):
 
 
 def _rope_at(x, pos, theta: float):
-    """labformer._rope for one token per slot: x (S, 1, heads, d),
-    pos (S,) — identical freqs/halving so paged decode matches the
-    dense path bit-for-bit."""
+    """labformer._rope at explicit per-slot positions: x (S, W, heads,
+    d), pos (S,) (one token per slot, broadcast over W == 1) or (S, W)
+    (the speculative verify window) — identical freqs/halving so paged
+    decode matches the dense path bit-for-bit."""
     d = x.shape[-1]
     half = d // 2
     freqs = (theta ** (-np.arange(0, half) / half)).astype(np.float32)
-    ang = pos[:, None].astype(jnp.float32) * freqs[None, :]     # (S, half)
-    cos = jnp.cos(ang)[:, None, None, :].astype(x.dtype)
-    sin = jnp.sin(ang)[:, None, None, :].astype(x.dtype)
+    if pos.ndim == 1:
+        pos = pos[:, None]
+    ang = pos[..., None].astype(jnp.float32) * freqs[None, None, :]
+    cos = jnp.cos(ang)[:, :, None, :].astype(x.dtype)          # (S, W, 1, half)
+    sin = jnp.sin(ang)[:, :, None, :].astype(x.dtype)
     x1, x2 = x[..., :half], x[..., half:]
     return jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
 
 
 def _paged_attend(q, kpool_l, vpool_l, tables, lengths, block_size: int,
                   window: int = 0):
-    """q (S, 1, h, d); pools (P, BS, kv, d); tables (S, M); lengths (S,)
-    = number of valid logical positions.  Gathers each slot's logical
-    key space (M*BS positions) and masks to [0, length).  Grouped heads
-    as in generate._attend_cached."""
-    S, _, h, dh = q.shape
+    """q (S, W, h, d); pools (P, BS, kv, d); tables (S, M); lengths (S,)
+    = number of valid logical positions for query ROW 0 (row j of a
+    W-wide window sits one position later per row, so it sees lengths+j
+    keys — causal within the window, exactly generate._attend_cached's
+    rule over a gathered key space).  W == 1 is plain decode.  Grouped
+    heads as in generate._attend_cached."""
+    S, W, h, dh = q.shape
     kvh = (kpool_l[0] if isinstance(kpool_l, tuple) else kpool_l).shape[2]
     g = h // kvh
     M = tables.shape[1]
@@ -142,20 +157,20 @@ def _paged_attend(q, kpool_l, vpool_l, tables, lengths, block_size: int,
     v = _pool_gather(vpool_l, tables, q.dtype).reshape(
         S, M * block_size, kvh, dh)
     q = q / np.sqrt(dh).astype(q.dtype)
-    qg = q.reshape(S, 1, kvh, g, dh)
+    qg = q.reshape(S, W, kvh, g, dh)
     s = jnp.einsum("bqcgd,bkcd->bcgqk", qg, k).astype(jnp.float32)
-    valid = jnp.arange(M * block_size)[None, :] < lengths[:, None]
+    key_pos = jnp.arange(M * block_size)[None, None, :]         # (1, 1, K)
+    row_len = lengths[:, None] + jnp.arange(W)[None, :]         # (S, W)
+    valid = key_pos < row_len[:, :, None]
     if window:
         # sliding-window serving: the newest valid position is the
-        # query itself (length - 1); keys below length - window are out
+        # query itself (row_len - 1); keys below row_len - window are out
         valid = jnp.logical_and(
-            valid,
-            jnp.arange(M * block_size)[None, :] > lengths[:, None] - 1 - window,
-        )
-    s = jnp.where(valid[:, None, None, None, :], s, NEG_INF)
+            valid, key_pos > row_len[:, :, None] - 1 - window)
+    s = jnp.where(valid[:, None, None, :, :], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1)
     o = jnp.einsum("bcgqk,bkcd->bqcgd", p, v.astype(jnp.float32))
-    return o.reshape(S, 1, h, dh).astype(q.dtype)
+    return o.reshape(S, W, h, dh).astype(q.dtype)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "block_size", "attn"),
@@ -222,6 +237,74 @@ def paged_decode_step(params, tokens, kpool, vpool, tables, lengths,
     x = _rmsnorm(x, params["final_norm"])
     logits = unembed(x, params["embed"])[:, 0, :]
     return logits, kpool, vpool
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "block_size", "W"),
+                   donate_argnums=(2, 3))
+def paged_verify(params, tokens, kpool, vpool, tables, lengths, n_draft,
+                 cfg: LabformerConfig, block_size: int, W: int):
+    """One batched speculative VERIFY pass over every slot.
+
+    tokens (S, W) with W = spec_k + 1: row 0 is each slot's committed
+    last token (the normal decode input), rows 1..k its draft proposals;
+    token j of slot s sits at logical position ``lengths[s] + j``.  One
+    compute-dense target forward scores all W positions per slot against
+    the paged pool — logits row j is the target's next-token
+    distribution after window prefix ``tokens[:, :j+1]`` — turning k
+    memory-bound single-token ticks into one MXU-shaped pass.
+
+    ``n_draft`` (S,) int32 = number of VALID draft rows per slot: K/V
+    writes for rows j > n_draft[s] (padding; sampled/penalty-free-ride
+    slots run with n_draft 0, i.e. a plain single-token tick inside the
+    same batch) route to TRASH, as do rows whose logical block would
+    fall past the table (drafts near a slot's budget end).  Reads are
+    position-masked per ROW (query row j sees keys [0, lengths+j]), so
+    rejected drafts leave only stale KV past the committed frontier —
+    the never-roll-back discipline models/speculative.py documents; the
+    next round simply overwrites.
+
+    Returns (logits (S, W, vocab), pools); pools DONATED exactly as in
+    paged_decode_step.  Same fixed-shape/two-compiled-programs
+    discipline: ONE verify program serves any mix of speculating,
+    sampled, and plain slots."""
+    S = tokens.shape[0]
+    h, dh, kvh = cfg.n_heads, cfg.head_dim, cfg.kv_heads
+    x = embed_lookup(params["embed"], tokens, cfg.dtype)        # (S, W, d)
+
+    j = jnp.arange(W)
+    pos = lengths[:, None] + j[None, :]                         # (S, W)
+    logical = (pos // block_size).astype(jnp.int32)
+    M = tables.shape[1]
+    writable = jnp.logical_and(j[None, :] <= n_draft[:, None], logical < M)
+    blk = jnp.where(
+        writable,
+        jnp.take_along_axis(tables, jnp.minimum(logical, M - 1), axis=1),
+        TRASH,
+    )
+    off = (pos % block_size).astype(jnp.int32)
+
+    def layer_step(carry, inputs):
+        x = carry
+        layer, kpool_l, vpool_l = inputs
+        xn = _rmsnorm(x, layer["ln1"])
+        q = qmat(xn, layer["wq"]).reshape(S, W, h, dh)
+        k = qmat(xn, layer["wk"]).reshape(S, W, kvh, dh)
+        v = qmat(xn, layer["wv"]).reshape(S, W, kvh, dh)
+        q = _rope_at(q, pos, cfg.rope_theta)
+        k = _rope_at(k, pos, cfg.rope_theta)
+        kpool_l = _pool_write(kpool_l, (blk, off), k)
+        vpool_l = _pool_write(vpool_l, (blk, off), v)
+        o = _paged_attend(q, kpool_l, vpool_l, tables, lengths + 1,
+                          block_size, window=cfg.attn_window)
+        x = x + qmat(o.reshape(S, W, cfg.d_model), layer["wo"])
+        y, _ = _mlp(_rmsnorm(x, layer["ln2"]), layer, cfg)
+        return x + y, (kpool_l, vpool_l)
+
+    x, (kpool, vpool) = jax.lax.scan(
+        layer_step, x, (params["blocks"], kpool, vpool)
+    )
+    x = _rmsnorm(x, params["final_norm"])
+    return unembed(x, params["embed"]), kpool, vpool
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "block_size", "bucket"),
@@ -348,6 +431,9 @@ class _Request:
     seed: int = 0
     repetition_penalty: float = 1.0  # HF convention; 1.0 = off
     stop_byte: int = -1         # finish early after emitting it; -1 = off
+    spec: str = "off"           # "off" | "lookup" | "draft" proposer
+    spec_k: int = 0             # drafts per verify round (<= engine spec_k)
+    spec_ngram: int = 3         # lookup proposer n-gram length
     out: List[int] = field(default_factory=list)
     cancelled: bool = False     # finish at the next tick (client gone)
 
@@ -367,11 +453,26 @@ class PagedEngine:
     def __init__(self, params, cfg: LabformerConfig, *, slots: int = 4,
                  n_blocks: int = 64, block_size: int = 16,
                  max_seq: int = 256, prefill_chunk: int = 0, mesh=None,
-                 attn: str = "gather", kv_dtype: str = "native"):
+                 attn: str = "gather", kv_dtype: str = "native",
+                 spec_k: int = 0, spec_ngram: int = 3,
+                 draft_params=None, draft_cfg=None):
         if max_seq % block_size:
             raise ValueError("max_seq must be a multiple of block_size")
         if prefill_chunk < 0:
             raise ValueError("prefill_chunk must be >= 0 (0 = whole tail)")
+        if spec_k < 0:
+            raise ValueError(f"spec_k must be >= 0, got {spec_k}")
+        if spec_ngram < 1:
+            raise ValueError(f"spec_ngram must be >= 1, got {spec_ngram}")
+        if spec_k and attn != "gather":
+            # the verify program attends through the gather path only;
+            # mixing a pallas decode tick with a gather verify tick
+            # would also break the spec-vs-plain bit-equality contract
+            raise ValueError("spec_k > 0 requires attn='gather' "
+                             "(no pallas verify kernel)")
+        if spec_k and mesh is not None:
+            raise ValueError("spec_k > 0 does not support mesh serving "
+                             "(the verify program is uncertified on tp)")
         if cfg.lora_rank:
             # the paged decode reads base weights only — serving an
             # adapter-active model would silently drop the finetune
@@ -466,23 +567,100 @@ class PagedEngine:
             "prefix_hits": 0, "prefix_misses": 0, "evictions": 0,
             "ticks": 0, "tokens_out": 0, "requests_done": 0,
             "blocks_retired": 0,
+            # speculative observability: verify_passes = ticks served by
+            # the verify program; spec_rounds = per-slot verify rounds;
+            # spec_accepted = drafts accepted (sum of m over rounds);
+            # spec_tokens = tokens committed by speculating slots.  The
+            # speedup signal is tokens_out / ticks (>1 only via spec).
+            "verify_passes": 0, "spec_rounds": 0, "spec_accepted": 0,
+            "spec_tokens": 0,
         }
+        # batched speculative decoding: spec_k > 0 compiles ONE extra
+        # fixed-shape program (paged_verify, window spec_k + 1) that a
+        # tick uses whenever any active slot speculates — per-request
+        # proposers ("lookup" n-gram / "draft" dense model) ride the
+        # same batch as plain and sampled slots
+        self.spec_k = spec_k
+        self.spec_ngram = spec_ngram
+        self.draft_params = None
+        self.draft_cfg = None
+        self.d_kc = self.d_vc = None
+        if draft_params is not None:
+            self.set_draft(draft_params, draft_cfg)
         # per-slot cursor: first logical block not yet window-retired,
         # so each tick checks only the 0-or-1 newly dead block instead
         # of rescanning every already-TRASHed entry
         self._retire_from = [0] * slots
 
+    def set_draft(self, draft_params, draft_cfg: LabformerConfig = None):
+        """Enable the dense-draft proposer (opt-in ``spec="draft"``):
+        a second model — typically the int8-quantized target, any
+        same-vocab (params, cfg) works — autoregressively proposes
+        drafts from per-slot dense KV caches.  Idempotent (the first
+        draft wins): the daemon builds it lazily on the first
+        speculative request, possibly from racing threads."""
+        if self.draft_params is not None:
+            return
+        if self.spec_k <= 0:
+            raise ValueError("set_draft on an engine with spec_k=0: "
+                             "build the engine with spec_k > 0")
+        cfg = draft_cfg if draft_cfg is not None else self.cfg
+        if cfg.vocab != self.cfg.vocab:
+            raise ValueError("draft and target must share a vocabulary")
+        self.draft_cfg = cfg
+        self.draft_params = draft_params
+        # dense per-slot caches: propose writes k+1 positions past any
+        # committed frontier (< max_seq), and admission prefill pads to
+        # a power-of-two bucket — the cache must hold both
+        self._draft_cache_len = max(
+            self.max_blocks * self.block_size + self.spec_k + 2,
+            _bucket(self.max_blocks * self.block_size),
+        )
+        shape = (cfg.n_layers, self.slots, self._draft_cache_len,
+                 cfg.kv_heads, cfg.head_dim)
+        self.d_kc = jnp.zeros(shape, cfg.dtype)
+        self.d_vc = jnp.zeros(shape, cfg.dtype)
+
     # ------------------------------------------------------------- admission
     def submit(self, prompt, max_new: int, *, temperature: float = 0.0,
                seed: int = 0, repetition_penalty: float = 1.0,
-               stop_byte: int = -1) -> int:
+               stop_byte: int = -1, spec: str = "off", spec_k: int = 0,
+               spec_ngram: int = 0) -> int:
         """Queue a request.  ``temperature == 0`` decodes greedily;
         otherwise the slot samples from its own seeded PRNG stream —
         per-request sampling coexists with greedy slots in one batch.
         ``repetition_penalty`` discounts bytes already in the request's
         prompt or output (HF convention; applies to greedy too);
         ``stop_byte >= 0`` finishes the request early right after that
-        byte is emitted (it IS the final output token — callers trim)."""
+        byte is emitted (it IS the final output token — callers trim).
+
+        ``spec="lookup"`` / ``spec="draft"`` opt the request into
+        speculative verify rounds (engine built with ``spec_k > 0``;
+        "draft" additionally needs :meth:`set_draft`): each tick the
+        slot proposes up to ``spec_k`` draft tokens (0 = the engine
+        default) and commits 1..spec_k+1 of them per verify pass —
+        LOSSLESS for greedy slots (bit-identical stream to
+        ``spec="off"``).  A sampled (``temperature > 0``) request keeps
+        its spec flag but falls back to single-token ticks inside the
+        same batch.  ``spec_ngram`` overrides the engine's lookup
+        n-gram length (0 = engine default)."""
+        if spec not in ("off", "lookup", "draft"):
+            raise ValueError(
+                f"spec={spec!r}; expected 'off', 'lookup' or 'draft'")
+        if spec != "off":
+            if self.spec_k <= 0:
+                raise ValueError(
+                    f"spec={spec!r} needs an engine built with spec_k > 0")
+            if spec == "draft" and self.draft_params is None:
+                raise ValueError(
+                    "spec='draft' needs a draft model: call "
+                    "engine.set_draft(...) first")
+        if not 0 <= spec_k <= self.spec_k:
+            raise ValueError(
+                f"spec_k must be in [0, {self.spec_k}] (engine verify "
+                f"window), got {spec_k}")
+        if spec_ngram < 0:
+            raise ValueError(f"spec_ngram must be >= 0, got {spec_ngram}")
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if len(prompt) == 0:
             raise ValueError("empty prompt")
@@ -510,7 +688,9 @@ class PagedEngine:
         self._next_id += 1
         self.pending.append(
             _Request(rid, prompt, max_new, float(temperature), int(seed),
-                     float(repetition_penalty), int(stop_byte))
+                     float(repetition_penalty), int(stop_byte), spec,
+                     int(spec_k) or self.spec_k,
+                     int(spec_ngram) or self.spec_ngram)
         )
         return rid
 
@@ -591,6 +771,8 @@ class PagedEngine:
             row[:need_total] = shared + fresh
             self.tables[s] = row
             self._prefill_slot(s, req, row, shared_pos)
+            if req.spec == "draft":
+                self._draft_prefill_slot(s, req)
             self._register_prefix(req.prompt, row)
             self.temps[s] = req.temperature
             self.keys[s] = np.asarray(
@@ -664,12 +846,79 @@ class PagedEngine:
         self.lengths[s] = p
         self.last_tok[s] = req.prompt[-1]
 
+    def _draft_prefill_slot(self, s: int, req: _Request):
+        """Fill the slot's DENSE draft cache for prompt[:-1] (the draft
+        has no paged pool and no prefix cache — its dense prefill is
+        part of the opt-in dense-draft cost).  Padding/bucket garbage at
+        positions >= p-1 is overwritten by the propose scan before any
+        read: _draft_propose writes its input's KV at every position it
+        later attends, and rounds advance by at most the k+1 positions
+        the previous round wrote."""
+        p = len(req.prompt) - 1
+        if p == 0:
+            return
+        bucket = _bucket(p)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :p] = req.prompt[:-1]
+        _, kc, vc = _prefill_jit(self.draft_params, jnp.asarray(padded),
+                                 self.draft_cfg, self._draft_cache_len)
+        self.d_kc = self.d_kc.at[:, s].set(kc[:, 0])
+        self.d_vc = self.d_vc.at[:, s].set(vc[:, 0])
+
     # ---------------------------------------------------------------- decode
+    def _emit(self, s: int, req: _Request, tok: int) -> bool:
+        """Append ONE committed token to slot ``s``; returns True when
+        the request is done (stop byte / cancel / budget)."""
+        tok = int(tok)
+        self.counters["tokens_out"] += 1
+        req.out.append(tok)
+        self.lengths[s] += 1
+        self.last_tok[s] = tok
+        self.seen[s, tok] = True
+        stopped = req.stop_byte >= 0 and tok == req.stop_byte
+        return stopped or req.cancelled or len(req.out) >= req.max_new
+
+    def _release_slot(self, s: int, req: _Request):
+        """Retire a finished request: deref what ADMISSION allocated
+        (prompt + max_new), regardless of how early the request finished
+        — req.max_new is immutable by contract (a cancel flags the
+        request instead of shrinking it, or this count would leak
+        blocks).  TRASH entries are blocks the sliding-window retirement
+        already released mid-decode."""
+        used = self._blocks_needed(len(req.prompt) + req.max_new)
+        for b in self.tables[s, :used]:
+            if int(b) != TRASH:
+                self._deref(int(b))
+        self.tables[s] = TRASH
+        self.lengths[s] = 0
+        self.temps[s] = 0.0
+        self.penalties[s] = 1.0
+        self.seen[s] = False
+        self._retire_from[s] = 0
+        self.active[s] = None
+        self._done[req.req_id] = np.asarray(req.out, np.int32)
+        self.counters["requests_done"] += 1
+
+    def _spec_budget(self, req: _Request) -> int:
+        """Draft count this round for a speculating slot: capped by the
+        request's own k and by budget-1, so a round commits at most the
+        remaining budget and every ACCEPTED position stays inside the
+        blocks admission allocated (writes for padding rows route to
+        TRASH in paged_verify)."""
+        if req.spec == "off" or req.temperature > 0:
+            return 0
+        return max(0, min(req.spec_k, req.max_new - len(req.out) - 1))
+
     def step(self) -> List[int]:
         """One engine tick; returns req_ids finished this tick."""
         self._admit()
         if not any(r is not None for r in self.active):
             return []
+        if self.spec_k and any(
+            self._spec_budget(r) > 0
+            for r in self.active if r is not None
+        ):
+            return self._step_spec()
         logits, self.kpool, self.vpool = paged_decode_step(
             self.params, jnp.asarray(self.last_tok), self.kpool, self.vpool,
             jnp.asarray(self.tables), jnp.asarray(self.lengths),
@@ -689,36 +938,143 @@ class PagedEngine:
         for s, req in enumerate(self.active):
             if req is None:
                 continue
-            self.counters["tokens_out"] += 1
-            req.out.append(int(nxt[s]))
-            self.lengths[s] += 1
-            self.last_tok[s] = nxt[s]
-            self.seen[s, int(nxt[s])] = True
-            stopped = req.stop_byte >= 0 and int(nxt[s]) == req.stop_byte
-            if stopped or req.cancelled or len(req.out) >= req.max_new:
-                # deref what ADMISSION allocated (prompt + max_new),
-                # regardless of how early the request finished —
-                # req.max_new is immutable by contract (a cancel flags
-                # the request instead of shrinking it, or this count
-                # would leak blocks).  TRASH entries are blocks the
-                # sliding-window retirement already released mid-decode.
-                used = self._blocks_needed(len(req.prompt) + req.max_new)
-                for b in self.tables[s, :used]:
-                    if int(b) != TRASH:
-                        self._deref(int(b))
-                self.tables[s] = TRASH
-                self.lengths[s] = 0
-                self.temps[s] = 0.0
-                self.penalties[s] = 1.0
-                self.seen[s] = False
-                self._retire_from[s] = 0
-                self.active[s] = None
-                self._done[req.req_id] = np.asarray(req.out, np.int32)
-                self.counters["requests_done"] += 1
+            if self._emit(s, req, int(nxt[s])):
+                self._release_slot(s, req)
                 finished.append(req.req_id)
         if self.cfg.attn_window:
             self._retire_windowed_blocks()
         return finished
+
+    def _step_spec(self) -> List[int]:
+        """One speculative tick: propose per-slot drafts, run ONE
+        batched paged_verify pass, commit each slot's longest agreeing
+        prefix plus the target's own next token (1..k+1 tokens/slot) —
+        greedy slots emit the bit-identical stream the plain tick would,
+        in fewer target passes.  Non-speculating and sampled slots ride
+        row 0 of the same pass as ordinary single-token ticks."""
+        k, W, S = self.spec_k, self.spec_k + 1, self.slots
+        tokens = np.zeros((S, W), np.int32)
+        tokens[:, 0] = self.last_tok
+        n_draft = np.zeros(S, np.int32)
+        want_draft = [s for s, r in enumerate(self.active)
+                      if r is not None and r.spec == "draft"
+                      and self._spec_budget(r) > 0]
+        if want_draft:
+            # ONE vmapped draft pass proposes for every slot (per-slot
+            # positions); non-draft slots' rows are scratch proposals
+            # into scratch cache lines, simply ignored below
+            drafts_all, self.d_kc, self.d_vc = _draft_propose_slots(
+                self.draft_params, jnp.asarray(self.last_tok),
+                self.d_kc, self.d_vc, jnp.asarray(self.lengths),
+                self.draft_cfg, k,
+            )
+            drafts_all = np.asarray(drafts_all)
+        for s, req in enumerate(self.active):
+            if req is None:
+                continue
+            k_eff = self._spec_budget(req)
+            if k_eff < 1:
+                continue
+            if req.spec == "draft":
+                prop = drafts_all[s, :k_eff]
+            else:
+                hist = np.concatenate(
+                    [req.prompt, np.asarray(req.out, np.int32)])
+                prop = _lookup_propose(hist, k_eff, req.spec_ngram)
+            tokens[s, 1:1 + k_eff] = prop[:k_eff]
+            n_draft[s] = k_eff
+        logits, self.kpool, self.vpool = paged_verify(
+            self.params, jnp.asarray(tokens), self.kpool, self.vpool,
+            jnp.asarray(self.tables), jnp.asarray(self.lengths),
+            jnp.asarray(n_draft), self.cfg, self.block_size, W,
+        )
+        toks0, new_keys = _sample_tokens(
+            logits[:, 0, :], jnp.asarray(self.temps),
+            jnp.asarray(self.keys, jnp.uint32),
+            jnp.asarray(self.penalties), jnp.asarray(self.seen),
+        )
+        # ONE coalesced fetch per tick (the host round-trip discipline
+        # models/speculative._spec_loop documents).  Acceptance needs
+        # only the per-row argmax CHOICES (S, W) — the full (S, W,
+        # vocab) logits ship to the host only when a penalized slot is
+        # actually speculating this tick (its evolving-seen penalty is
+        # applied host-side)
+        choices = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        need_logits = any(
+            n_draft[s] > 0 and self.penalties[s] != 1.0
+            for s in range(S))
+        if need_logits:
+            logits_np, choices_np, nxt0, new_keys = jax.device_get(
+                (logits, choices, toks0, new_keys))
+        else:
+            logits_np = None
+            choices_np, nxt0, new_keys = jax.device_get(
+                (choices, toks0, new_keys))
+        self.keys = np.array(new_keys, np.uint32)
+        self.counters["ticks"] += 1
+        self.counters["verify_passes"] += 1
+        finished = []
+        for s, req in enumerate(self.active):
+            if req is None:
+                continue
+            if n_draft[s] == 0:
+                committed = [int(nxt0[s])]
+            else:
+                committed = self._accept(
+                    s, tokens[s], int(n_draft[s]), choices_np[s],
+                    logits_np[s] if logits_np is not None else None)
+                self.counters["spec_rounds"] += 1
+                self.counters["spec_accepted"] += len(committed) - 1
+            done = False
+            for t in committed:
+                if n_draft[s]:
+                    self.counters["spec_tokens"] += 1
+                if self._emit(s, req, t):
+                    done = True
+                    break
+            if done:
+                self._release_slot(s, req)
+                finished.append(req.req_id)
+        if self.cfg.attn_window:
+            self._retire_windowed_blocks()
+        return finished
+
+    def _accept(self, s: int, window: np.ndarray, k_eff: int,
+                choices: np.ndarray,
+                logits: Optional[np.ndarray] = None) -> List[int]:
+        """Greedy accept/commit for one slot's verify round: the longest
+        draft prefix the target agrees with, plus the target's token
+        after that prefix (the correction on disagreement, the bonus on
+        full acceptance) — 1..k_eff+1 tokens, exactly the stream plain
+        greedy ticks would emit.
+
+        The common case reads the device-computed argmax ``choices``
+        (W,); a penalized slot instead re-argmaxes its ``logits`` rows
+        HOST-side with the seen set EVOLVING over the window (token d_j
+        is "seen" for every later row), replicating
+        apply_repetition_penalty + argmax bit-for-bit (same IEEE f32
+        ops, same first-index tie-break)."""
+        drafts = window[1:1 + k_eff]
+        pen = float(self.penalties[s])
+        seen = self.seen[s].copy() if pen != 1.0 else None
+        committed: List[int] = []
+        for j in range(k_eff + 1):
+            if seen is None:
+                choice = int(choices[j])
+            else:
+                lg = logits[j]
+                lg = np.where(
+                    seen,
+                    np.where(lg > 0, lg / np.float32(pen),
+                             lg * np.float32(pen)),
+                    lg)
+                choice = int(np.argmax(lg))
+            committed.append(choice)
+            if j >= k_eff or int(drafts[j]) != choice:
+                break
+            if seen is not None:  # agreed token is committed: later
+                seen[choice] = True  # rows see it as already emitted
+        return committed
 
     def _retire_windowed_blocks(self):
         """Free KV blocks that fell wholly behind the sliding window.

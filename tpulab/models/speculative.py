@@ -72,6 +72,30 @@ def _draft_propose(params, last_token, k_caches, v_caches, pos, cfg, k: int):
     return drafts.T[:, :k], k_caches, v_caches  # (b, k)
 
 
+@functools.partial(jax.jit, static_argnames=("cfg", "k"),
+                   donate_argnums=(2, 3))
+def _draft_propose_slots(params, last_tok, k_caches, v_caches, pos,
+                         cfg: LabformerConfig, k: int):
+    """Per-SLOT draft proposals at per-slot positions — the batched
+    engine's dense-draft proposer (tpulab.models.paged): slot s greedily
+    decodes ``k`` tokens from ``last_tok[s]`` at position ``pos[s]``
+    against its own dense cache row.
+
+    last_tok (S,), caches (L, S, C, kv, d), pos (S,) -> (drafts (S, k),
+    caches).  vmap over the slot axis reuses :func:`_draft_propose`
+    verbatim (positions differ per slot, which the shared-scalar-pos
+    batch path cannot express); the caches are DONATED so each round
+    updates in place instead of copying every layer's cache per
+    propose."""
+    def one(tok, kc_s, vc_s, p):
+        drafts, kc_o, vc_o = _draft_propose(
+            params, tok[None], kc_s[:, None], vc_s[:, None], p, cfg, k)
+        return drafts[0], kc_o[:, 0], vc_o[:, 0]
+
+    return jax.vmap(one, in_axes=(0, 1, 1, 0), out_axes=(0, 1, 1))(
+        last_tok, k_caches, v_caches, pos)
+
+
 @functools.partial(jax.jit, static_argnames=("cfg",))
 def _target_verify(params, window, k_caches, v_caches, pos, cfg):
     """window (b, k+1) = [committed, drafts...] at positions pos.. ->

@@ -552,16 +552,26 @@ def train(
             # a silent train/serve divergence.  Refuse on mismatch; the
             # user either re-passes the original flags or starts a fresh
             # checkpoint dir.  (round-4 advisor finding)
-            from tpulab.models.labformer import cfg_to_dict
+            from tpulab.models.labformer import LabformerConfig, cfg_to_dict
 
             with open(sc_path) as f:
                 recorded = json.load(f).get("config", {})
             current = cfg_to_dict(cfg)
-            diff = {
-                k: (recorded.get(k), current.get(k))
-                for k in sorted(set(recorded) | set(current))
-                if recorded.get(k) != current.get(k)
-            }
+            # compare only keys the sidecar actually RECORDS: a sidecar
+            # written before a config field existed must not fail every
+            # resume forever — a missing recorded key matches as long as
+            # this invocation leaves the field at its dataclass default
+            # (an explicit non-default flag is still a real divergence,
+            # and recorded-vs-flags value mismatches stay hard errors).
+            # (round-5 advisor finding)
+            defaults = cfg_to_dict(LabformerConfig())
+            diff = {}
+            for k in sorted(set(recorded) | set(current)):
+                if k in recorded:
+                    if recorded[k] != current.get(k):
+                        diff[k] = (recorded[k], current.get(k))
+                elif current.get(k) != defaults.get(k):
+                    diff[k] = ("<not recorded>", current.get(k))
             if diff:
                 detail = ", ".join(
                     f"{k}: sidecar={a!r} flags={b!r}" for k, (a, b) in diff.items()

@@ -1,0 +1,83 @@
+#!/bin/bash
+# Round-7 sequential on-chip evidence queue (single chip -- no contention).
+#
+# Claim discipline (docs/tpu_runs.md + .claude/skills/verify): TPU-claiming
+# processes are WAITED on, never killed -- a killed claim wedges the relay
+# for every later process.  Each stage is gated on a live compiled-matmul
+# probe.  If a previous round's queue left a probe pending (its PID in
+# $PRIOR_PROBE_PID, output at /tmp/queue_probe.out), that claim is REUSED
+# as the relay sentinel instead of stacking a second claim behind it.
+#
+# Round-7 addition: the bench stage now emits the steady-state
+# paged-tick row (paged_tick_4slots_ticks_per_s -- the fused
+# device-resident decode tick, admission excluded) and the serving
+# stage emits decode_tick_overhead (tokens/s with the one-tick async
+# overlap window on vs off, h2d_ticks/host_syncs counters --
+# tools/serving_tpu.py), so the zero-transfer decode win lands
+# automatically when the relay heals.  The regression pass ratchets the
+# CPU-proxy paged_tick baseline up to the chip number.
+cd /root/repo || exit 1
+L=results/logs
+mkdir -p "$L"
+
+wait_relay() {
+  while true; do
+    if [ -n "$PRIOR_PROBE_PID" ] && kill -0 "$PRIOR_PROBE_PID" 2>/dev/null; then
+      sleep 60
+      continue
+    fi
+    if grep -q compile-ok /tmp/queue_probe.out 2>/dev/null; then
+      # consume the sentinel so every LATER stage re-probes (the relay
+      # can drop again between stages)
+      PRIOR_PROBE_PID=""
+      rm -f /tmp/queue_probe.out
+      return 0
+    fi
+    PRIOR_PROBE_PID=""
+    python -c "import jax, jax.numpy as jnp; x = jnp.ones((128, 128)); (x @ x).block_until_ready(); print('compile-ok')" \
+        > /tmp/queue_probe.out 2>&1
+    # loop re-checks the probe output; a failed probe (relay down but
+    # fast-failing) falls through to another attempt after the check
+    grep -q compile-ok /tmp/queue_probe.out 2>/dev/null || sleep 120
+  done
+}
+
+stage() {  # stage <name> <cmd...>
+  name=$1; shift
+  echo "== $name wait-relay $(date)" >> $L/queue.status
+  wait_relay
+  echo "== $name start $(date)" >> $L/queue.status
+  "$@" > "$L/$name.log" 2>&1
+  echo "== $name rc=$? $(date)" >> $L/queue.status
+}
+
+date > $L/queue.status
+stage bench_r7        python bench.py --skip-probe
+# committed fallback for the driver's round-end bench (see
+# bench.py::_last_good_headline): the freshest on-chip lines
+grep '"metric"' $L/bench_r7.log > results/bench_r7.jsonl 2>/dev/null || true
+stage parity          python tools/pallas_tpu_parity.py
+stage flash_train     python tools/flash_train_proof.py
+stage train_mfu       python tools/train_mfu_probe.py
+stage serving_tpu     python tools/serving_tpu.py
+stage ref_harness2    python tools/run_reference_harness.py --backend tpu --lab lab2 --k-times 5
+stage ref_harness3    python tools/run_reference_harness.py --backend tpu --lab lab3 --k-times 5
+stage tune_flash      python tools/tune_flash.py
+# mechanical regression verdict + ratchet in ONE pass, ungated like the
+# re-sign below (host-only JSON diff — a relay gate here could hang the
+# queue after the chip stages already rewrote artifacts).  --update
+# refuses to move any baseline in the worse direction without an
+# explicit --accept-regression note (VERDICT r5 #6 guard), so a
+# half-broken relay window can never launder a regression into the
+# table; on a clean improving run it ratchets with round-7 provenance —
+# including the paged_tick CPU-proxy baseline up to its chip value.
+python tools/check_regression.py results/bench_r7.jsonl --update \
+    --date "round 7 (onchip_queue_r7)" > "$L/regression.log" 2>&1
+echo "== regression+ratchet rc=$? $(date)" >> $L/queue.status
+# re-sign: the stages above rewrite signed artifacts (pallas_tpu_parity
+# .json; baselines.json under a later --update) — signatures must track
+# them or tests/test_signing.py::test_committed_signatures_verify reds.
+# No relay gate: signing is host-only.
+python tools/sign_artifacts.py sign > "$L/resign.log" 2>&1
+echo "== resign rc=$? $(date)" >> $L/queue.status
+echo "QUEUE DONE $(date)" >> $L/queue.status

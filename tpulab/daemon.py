@@ -198,6 +198,14 @@ class _GenerateService:
     the short-held registry lock (_ENGINES cache + state lookup); it is
     never held across device compute.
 
+    The engine runs its one-tick async overlap window by default
+    (``PagedEngine(overlap=1)``): ``engine.step()`` dispatches tick t+1
+    before draining tick t, so each stepper iteration publishes the
+    PREVIOUS tick's tokens — streaming consumers read the one-tick-
+    delayed emit queue through the same ``req.out`` growth they always
+    did, just one tick later, and the stepper keeps looping until the
+    engine's in-flight window is empty (``engine.inflight_depth``).
+
     Failure policy: if a step raises, the stepper fails EVERY request
     on that engine (each waiter re-raises a clear error instead of
     hanging in cond.wait forever) and the engine is dropped from the
@@ -295,9 +303,9 @@ class _GenerateService:
         try:
             while True:
                 with st.cond:
-                    if not engine.pending and not any(
-                        r is not None for r in engine.active
-                    ):
+                    if (not engine.pending and not engine.inflight_depth
+                            and not any(
+                                r is not None for r in engine.active)):
                         # clear INSIDE this locked region: after the
                         # lock drops, a submitter must either see the
                         # stepper alive (and it still is) or dead (and
@@ -658,7 +666,11 @@ def _handle_generate(header: dict, payload: bytes,
 
 def _handle_generate_stats(header: dict) -> bytes:
     """Engine observability over the wire: PagedEngine.stats() JSON for
-    the requested ckpt_dir's engine (empty object if none is warm)."""
+    the requested ckpt_dir's engine (empty object if none is warm).
+    Includes the overlap counters — ``host_syncs`` (forced drains of
+    the async window), ``h2d_ticks`` (ticks that needed a host upload)
+    and ``inflight_depth`` — so the zero-transfer steady state is
+    visible in production, not just benches."""
     config = header.get("config") or {}
     path = config.get("ckpt_dir")
     key = (os.path.realpath(path) if path else None,

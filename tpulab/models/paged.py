@@ -33,6 +33,26 @@ ordinary single-token ticks.  Proposers are per-request: prompt-lookup
 n-grams (zero extra model) or an opt-in dense draft model
 (``set_draft``; e.g. the int8-quantized target).
 
+Steady-state decode is served by a FOURTH compiled program,
+``paged_tick`` = decode step + per-slot sampling + functional state
+advance in ONE dispatch: the per-slot decode state (``last_tok``,
+``lengths``, ``tables``, ``temps``, ``keys``, ``penalties``, ``seen``,
+``active``) lives in device-resident arrays donated through every tick
+like the KV pools, so a steady-state tick performs ZERO host<->device
+transfers (enforced by ``jax.transfer_guard`` in
+tests/test_paged_overlap.py).  Host mutation points — admission,
+release, sliding-window block retirement, speculative commits — go
+through small jitted scatter-updaters instead of re-uploading whole
+arrays, and the engine keeps numpy MIRRORS of the same state for its
+host-side bookkeeping (block refcounts, budgets, proposers).  On top
+of that, ``PagedEngine(overlap=1)`` (the default) runs the host ONE
+TICK BEHIND the device: tick t+1 is dispatched feeding tick t's
+still-on-device tokens while the host drains tick t-1's fetched tokens
+for emit/stop/stream — admission and the speculative path force the
+(rare) sync barrier, and the never-roll-back pool discipline makes the
+one-tick-late stop detection safe (overshoot positions land in the
+slot's own tail blocks or TRASH and are length-masked on read).
+
 Prefix sharing: block-aligned prompt prefixes are cached (LRU, evicted
 under pool pressure) and their physical blocks reference-counted —
 requests repeating a system prompt share its KV blocks instead of
@@ -173,11 +193,9 @@ def _paged_attend(q, kpool_l, vpool_l, tables, lengths, block_size: int,
     return o.reshape(S, W, h, dh).astype(q.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "block_size", "attn"),
-                   donate_argnums=(2, 3))
-def paged_decode_step(params, tokens, kpool, vpool, tables, lengths,
-                      cfg: LabformerConfig, block_size: int,
-                      attn: str = "gather"):
+def _decode_core(params, tokens, kpool, vpool, tables, lengths,
+                 cfg: LabformerConfig, block_size: int,
+                 attn: str = "gather"):
     """One batched decode step for every slot.
 
     tokens (S,) sit at logical positions ``lengths`` (the next free
@@ -237,6 +255,14 @@ def paged_decode_step(params, tokens, kpool, vpool, tables, lengths,
     x = _rmsnorm(x, params["final_norm"])
     logits = unembed(x, params["embed"])[:, 0, :]
     return logits, kpool, vpool
+
+
+#: standalone decode-step program (prefill's first-token path, direct
+#: callers); the engine's steady state runs _decode_core fused inside
+#: :func:`paged_tick` instead
+paged_decode_step = functools.partial(
+    jax.jit, static_argnames=("cfg", "block_size", "attn"),
+    donate_argnums=(2, 3))(_decode_core)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "block_size", "W"),
@@ -388,8 +414,7 @@ def _scatter_prefill(kpool, vpool, k_seq, v_seq, table_row, start, p,
     return kpool, vpool
 
 
-@jax.jit
-def _sample_tokens(logits, temps, keys, penalties, seen):
+def _sample_core(logits, temps, keys, penalties, seen):
     """Per-slot next token: greedy where temperature == 0, else a
     categorical draw from the slot's own PRNG stream.  Returns
     ``(tokens (S,), next_keys (S, 2))`` — keys advance every tick so a
@@ -413,6 +438,90 @@ def _sample_tokens(logits, temps, keys, penalties, seen):
 
     sampled = jax.vmap(one)(logits, temps, use)
     return jnp.where(temps > 0, sampled, greedy), nxt_keys
+
+
+#: standalone sampler (the speculative path's row-0 sampling); the
+#: steady state runs _sample_core fused inside :func:`paged_tick`
+_sample_tokens = jax.jit(_sample_core)
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "block_size", "attn"),
+                   donate_argnums=(1, 2, 3))
+def paged_tick(params, state, kpool, vpool, cfg: LabformerConfig,
+               block_size: int, attn: str = "gather"):
+    """ONE fused steady-state tick: decode step + per-slot sampling +
+    functional state advance, zero host<->device transfers.
+
+    ``state`` is the engine's device-resident per-slot state dict
+    (last_tok, lengths, tables, temps, keys, penalties, seen, active) —
+    DONATED along with the pools, so every tick updates the decode
+    state in place on device and the host never re-uploads it.  The
+    advance mirrors what the host loop commits per emitted token:
+    ``last_tok`` <- sampled token, ``lengths`` += 1, ``seen[s, tok]``
+    marked — each masked by ``active`` so idle slots (TRASH tables)
+    hold their state for the next admission.  ``keys`` split UNMASKED
+    (every tick, every slot — the pre-fusion per-tick advance), so
+    admission MUST reseed a slot's key row (_slot_write does).  Returns
+    ``(tokens (S,), state, kpool, vpool)``; the tokens stay on device
+    until the host drains them (one tick late under ``overlap=1``)."""
+    logits, kpool, vpool = _decode_core(
+        params, state["last_tok"], kpool, vpool, state["tables"],
+        state["lengths"], cfg, block_size, attn)
+    toks, nxt_keys = _sample_core(logits, state["temps"], state["keys"],
+                                  state["penalties"], state["seen"])
+    act = state["active"]
+    state = dict(
+        state,
+        last_tok=jnp.where(act, toks, state["last_tok"]),
+        lengths=state["lengths"] + act.astype(jnp.int32),
+        keys=nxt_keys,
+        seen=state["seen"].at[jnp.arange(toks.shape[0]), toks].max(act),
+    )
+    return toks, state, kpool, vpool
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _slot_write(state, s, length, last_tok, temp, key, penalty, seen_row,
+                table_row, active):
+    """Scatter ONE slot's full decode state (admission and release both
+    route through this single compiled updater) — the host uploads one
+    table row + one seen row + scalars instead of whole (S, ...) arrays."""
+    return dict(
+        state,
+        last_tok=state["last_tok"].at[s].set(last_tok),
+        lengths=state["lengths"].at[s].set(length),
+        tables=state["tables"].at[s].set(table_row),
+        temps=state["temps"].at[s].set(temp),
+        keys=state["keys"].at[s].set(key),
+        penalties=state["penalties"].at[s].set(penalty),
+        seen=state["seen"].at[s].set(seen_row),
+        active=state["active"].at[s].set(active),
+    )
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _table_trash(state, s, j):
+    """Point one table entry at TRASH (sliding-window retirement)."""
+    return dict(state, tables=state["tables"].at[s, j].set(TRASH))
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _spec_commit(state, adv, last_tok, new_keys, marks):
+    """Advance the device state after a host-side speculative accept:
+    ``adv`` (S,) tokens committed per slot this round, ``last_tok``
+    (S,) the final committed token (ignored where adv == 0), ``marks``
+    (S, W) the committed token ids (positions >= adv are padding) for
+    the ``seen`` scatter, ``new_keys`` from the row-0 sampling pass."""
+    S, W = marks.shape
+    moved = adv > 0
+    valid = jnp.arange(W)[None, :] < adv[:, None]
+    return dict(
+        state,
+        lengths=state["lengths"] + adv,
+        last_tok=jnp.where(moved, last_tok, state["last_tok"]),
+        keys=new_keys,
+        seen=state["seen"].at[jnp.arange(S)[:, None], marks].max(valid),
+    )
 
 
 def _bucket(n: int) -> int:
@@ -455,11 +564,16 @@ class PagedEngine:
                  max_seq: int = 256, prefill_chunk: int = 0, mesh=None,
                  attn: str = "gather", kv_dtype: str = "native",
                  spec_k: int = 0, spec_ngram: int = 3,
-                 draft_params=None, draft_cfg=None):
+                 draft_params=None, draft_cfg=None, overlap: int = 1):
         if max_seq % block_size:
             raise ValueError("max_seq must be a multiple of block_size")
         if prefill_chunk < 0:
             raise ValueError("prefill_chunk must be >= 0 (0 = whole tail)")
+        if overlap not in (0, 1):
+            # deeper windows would need per-entry slot snapshots (a slot
+            # could be released AND re-admitted inside the window); one
+            # tick already hides the host bookkeeping behind the device
+            raise ValueError(f"overlap must be 0 or 1, got {overlap}")
         if spec_k < 0:
             raise ValueError(f"spec_k must be >= 0, got {spec_k}")
         if spec_ngram < 1:
@@ -502,6 +616,11 @@ class PagedEngine:
         self.block_size = block_size
         self.max_blocks = max_seq // block_size
         if mesh is None:
+            # commit params once: numpy leaves (a device_get'd
+            # checkpoint) would otherwise re-upload IMPLICITLY on every
+            # tick — the transfer-guard test would flag them, and the
+            # real chip would pay the h2d per token
+            self.params = jax.device_put(params)
             self.kpool, self.vpool = init_pools(cfg, n_blocks, block_size,
                                                 kv_dtype)
         else:
@@ -574,7 +693,24 @@ class PagedEngine:
             # speedup signal is tokens_out / ticks (>1 only via spec).
             "verify_passes": 0, "spec_rounds": 0, "spec_accepted": 0,
             "spec_tokens": 0,
+            # overlap observability: host_syncs = forced barriers that
+            # drained the async window (admission / spec / idle);
+            # h2d_ticks = ticks that needed a host upload (admission,
+            # spec proposals, window retirement) — steady-state decode
+            # keeps this flat while `ticks` climbs.
+            "host_syncs": 0, "h2d_ticks": 0,
         }
+        # device-resident decode state: the authoritative per-slot
+        # arrays every paged_tick donates through (the numpy fields
+        # above stay as HOST MIRRORS for admission/refcount/proposer
+        # bookkeeping); mesh serving replicates them over the mesh so
+        # jit never mixes committed single-device and sharded inputs
+        self._dev = self._init_dev_state()
+        # one-tick async window: device token arrays not yet fetched
+        # (dispatch t+1, then drain t — the host runs a tick behind)
+        self.overlap = overlap
+        self._inflight: List = []
+        self._h2d = False
         # batched speculative decoding: spec_k > 0 compiles ONE extra
         # fixed-shape program (paged_verify, window spec_k + 1) that a
         # tick uses whenever any active slot speculates — per-request
@@ -592,6 +728,46 @@ class PagedEngine:
         # of rescanning every already-TRASHed entry
         self._retire_from = [0] * slots
 
+    def _init_dev_state(self):
+        # DEVICE-allocated (jnp.zeros/ones, never jnp.asarray of a
+        # numpy array): these buffers are DONATED through every tick,
+        # and on CPU a numpy-backed array can be a zero-copy alias —
+        # donating it lets XLA recycle memory numpy still owns (real
+        # heap corruption, observed before this comment existed)
+        dev = {
+            "last_tok": jnp.zeros(self.slots, jnp.int32),
+            "lengths": jnp.zeros(self.slots, jnp.int32),
+            "tables": jnp.zeros((self.slots, self.max_blocks), jnp.int32),
+            "temps": jnp.zeros(self.slots, jnp.float32),
+            "keys": jnp.zeros((self.slots, 2), jnp.uint32),
+            "penalties": jnp.ones(self.slots, jnp.float32),
+            "seen": jnp.zeros((self.slots, self.cfg.vocab), bool),
+            "active": jnp.zeros(self.slots, bool),
+        }
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            sh = NamedSharding(self.mesh, P())
+            # device->device replication: fresh per-device buffers
+            return {k: jax.device_put(v, sh) for k, v in dev.items()}
+        return dev
+
+    def _push_slot(self, s: int, active: bool):
+        """Scatter slot ``s``'s HOST-mirror state into the device state
+        (the admission/release upload — the only paths that rewrite a
+        whole slot).  Marks the tick as h2d."""
+        self._h2d = True
+        # COPIES, not views: a zero-copy aliased jit input reads the
+        # numpy buffer asynchronously, and the host keeps mutating
+        # these mirrors (e.g. _emit marks seen) after dispatch
+        self._dev = _slot_write(
+            self._dev, s, np.int32(self.lengths[s]),
+            np.int32(self.last_tok[s]), np.float32(self.temps[s]),
+            np.array(self.keys[s], np.uint32),
+            np.float32(self.penalties[s]), np.array(self.seen[s]),
+            np.array(self.tables[s], np.int32), active,
+        )
+
     def set_draft(self, draft_params, draft_cfg: LabformerConfig = None):
         """Enable the dense-draft proposer (opt-in ``spec="draft"``):
         a second model — typically the int8-quantized target, any
@@ -608,7 +784,7 @@ class PagedEngine:
         if cfg.vocab != self.cfg.vocab:
             raise ValueError("draft and target must share a vocabulary")
         self.draft_cfg = cfg
-        self.draft_params = draft_params
+        self.draft_params = jax.device_put(draft_params)  # as for params
         # dense per-slot caches: propose writes k+1 positions past any
         # committed frontier (< max_seq), and admission prefill pads to
         # a power-of-two bucket — the cache must hold both
@@ -785,6 +961,7 @@ class PagedEngine:
             self.seen[s] = False
             self.seen[s, req.prompt] = True
             self.active[s] = req
+            self._push_slot(s, True)
 
     def _register_prefix(self, prompt: np.ndarray, row: np.ndarray):
         """Cache this request's full prefill blocks for future sharing
@@ -891,11 +1068,14 @@ class PagedEngine:
                 self._deref(int(b))
         self.tables[s] = TRASH
         self.lengths[s] = 0
+        self.last_tok[s] = 0
         self.temps[s] = 0.0
         self.penalties[s] = 1.0
         self.seen[s] = False
+        self.keys[s] = 0
         self._retire_from[s] = 0
         self.active[s] = None
+        self._push_slot(s, False)
         self._done[req.req_id] = np.asarray(req.out, np.int32)
         self.counters["requests_done"] += 1
 
@@ -909,32 +1089,41 @@ class PagedEngine:
             return 0
         return max(0, min(req.spec_k, req.max_new - len(req.out) - 1))
 
-    def step(self) -> List[int]:
-        """One engine tick; returns req_ids finished this tick."""
-        self._admit()
-        if not any(r is not None for r in self.active):
-            return []
-        if self.spec_k and any(
-            self._spec_budget(r) > 0
-            for r in self.active if r is not None
-        ):
-            return self._step_spec()
-        logits, self.kpool, self.vpool = paged_decode_step(
-            self.params, jnp.asarray(self.last_tok), self.kpool, self.vpool,
-            jnp.asarray(self.tables), jnp.asarray(self.lengths),
-            self.cfg, self.block_size, attn=self.attn,
-        )
-        toks, new_keys = _sample_tokens(
-            logits, jnp.asarray(self.temps),
-            jnp.asarray(self.keys, jnp.uint32),
-            jnp.asarray(self.penalties), jnp.asarray(self.seen),
-        )
-        nxt = np.asarray(toks)
-        # np.array (copy), not np.asarray: a zero-copy view of a jax
-        # buffer is read-only, and admission writes keys[s] in place
-        self.keys = np.array(new_keys, np.uint32)
-        self.counters["ticks"] += 1
-        finished = []
+    def _head_admittable(self) -> bool:
+        """Whether the head request could be admitted RIGHT NOW (free
+        slot given, enough free + cache-evictable blocks, counting its
+        shared-prefix credit) — the same arithmetic _admit applies,
+        minus the side effects.  If a release inside THIS tick's drain
+        frees enough blocks, admission just happens one tick later (the
+        gate re-evaluates every step) — bounded delay, never
+        starvation.  The _lookup_prefix LRU freshen is a harmless side
+        effect: the entry IS being matched, just not consumed yet."""
+        req = self.pending[0]
+        shared, _ = self._lookup_prefix(req.prompt)
+        need_new = (self._blocks_needed(len(req.prompt) + req.max_new)
+                    - len(shared))
+        if need_new <= len(self.free):
+            return True
+        # simulate _admit's pin: once it refs the matched blocks they
+        # stop counting as evictable, so the credit must be computed
+        # post-pin or the gate would pass every tick while _admit keeps
+        # declining — the every-tick barrier this gate exists to stop
+        for b in shared:
+            self.block_refs[b] += 1
+        try:
+            return need_new <= len(self.free) + self._evictable_blocks()
+        finally:
+            for b in shared:  # plain unpin: never frees (refs were > 0)
+                self.block_refs[b] -= 1
+
+    def _drain_one(self, finished: List[int]):
+        """Fetch the oldest in-flight tick's tokens (EXPLICIT
+        device_get — the engine's only d2h) and run the host
+        bookkeeping for it: emit / stop / release / window retirement.
+        Slots whose request already finished in an earlier drained tick
+        skip their (overshoot) token — the pool writes it made are
+        length-masked or in blocks release just reclaimed."""
+        nxt = jax.device_get(self._inflight.pop(0))
         for s, req in enumerate(self.active):
             if req is None:
                 continue
@@ -943,7 +1132,83 @@ class PagedEngine:
                 finished.append(req.req_id)
         if self.cfg.attn_window:
             self._retire_windowed_blocks()
+
+    def _drain_all(self, finished: List[int]):
+        """Sync barrier: empty the async window (admission, the
+        speculative path, and going idle all require host state to be
+        CURRENT before proceeding)."""
+        if self._inflight:
+            self.counters["host_syncs"] += 1
+        while self._inflight:
+            self._drain_one(finished)
+
+    def _spec_wanted(self) -> bool:
+        return bool(self.spec_k) and any(
+            r is not None and self._spec_budget(r) > 0 for r in self.active)
+
+    def step(self) -> List[int]:
+        """One engine tick; returns req_ids finished this tick (under
+        ``overlap=1`` a request finishes the tick AFTER its final token
+        was computed — the host runs one tick behind the device)."""
+        finished: List[int] = []
+        self._h2d = False
+        if (self.pending and any(r is None for r in self.active)
+                and self._head_admittable()):
+            # admission needs current slot/block occupancy and rewrites
+            # slot state: the one structural sync barrier.  Gated on a
+            # FREE slot and on the head request actually FITTING (free
+            # + evictable blocks) — a backed-up queue behind fully-busy
+            # slots, or a block-starved head behind a long request,
+            # must not drain the async window every tick for an
+            # admission that cannot happen anyway.
+            self._drain_all(finished)
+            self._admit()
+        spec = self._spec_wanted()
+        if spec and self._inflight:
+            # the verify path is host-orchestrated (proposals +
+            # acceptance): drain, then re-check — the stale budget can
+            # only overestimate, never miss a speculating slot
+            self._drain_all(finished)
+            spec = self._spec_wanted()
+        if not any(r is not None for r in self.active):
+            self._drain_all(finished)
+            self._count_h2d()
+            return finished
+        if spec:
+            finished.extend(self._step_spec())
+            self._h2d = True
+            self._count_h2d()
+            return finished
+        if self._inflight and all(
+            r is None or r.cancelled
+            or len(r.out) + len(self._inflight) >= r.max_new
+            for r in self.active
+        ):
+            # every active slot's final token is already in flight —
+            # drain instead of dispatching a tick whose output no
+            # request could consume (keeps `ticks` == tokens for plain
+            # greedy runs, bit-matching the synchronous loop's counter)
+            self._drain_one(finished)
+        else:
+            toks, self._dev, self.kpool, self.vpool = paged_tick(
+                self.params, self._dev, self.kpool, self.vpool,
+                self.cfg, self.block_size, self.attn,
+            )
+            self._inflight.append(toks)
+            self.counters["ticks"] += 1
+            while len(self._inflight) > self.overlap:
+                self._drain_one(finished)
+        if not any(r is not None for r in self.active):
+            # the wave just ended: drain stragglers so the engine never
+            # parks fetched-but-unprocessed ticks across idle periods
+            self._drain_all(finished)
+        self._count_h2d()
         return finished
+
+    def _count_h2d(self):
+        if self._h2d:
+            self.counters["h2d_ticks"] += 1
+            self._h2d = False
 
     def _step_spec(self) -> List[int]:
         """One speculative tick: propose per-slot drafts, run ONE
@@ -951,7 +1216,14 @@ class PagedEngine:
         prefix plus the target's own next token (1..k+1 tokens/slot) —
         greedy slots emit the bit-identical stream the plain tick would,
         in fewer target passes.  Non-speculating and sampled slots ride
-        row 0 of the same pass as ordinary single-token ticks."""
+        row 0 of the same pass as ordinary single-token ticks.
+
+        Host-orchestrated by nature (proposals in, acceptance out), so
+        the caller drains the async window first; still, the verify pass
+        reads the DEVICE-resident tables/lengths/sampling state and the
+        accepted commits go back through one batched ``_spec_commit``
+        scatter — the only per-tick upload left is the (S, W) proposal
+        window itself."""
         k, W, S = self.spec_k, self.spec_k + 1, self.slots
         tokens = np.zeros((S, W), np.int32)
         tokens[:, 0] = self.last_tok
@@ -961,14 +1233,15 @@ class PagedEngine:
                       and self._spec_budget(r) > 0]
         if want_draft:
             # ONE vmapped draft pass proposes for every slot (per-slot
-            # positions); non-draft slots' rows are scratch proposals
-            # into scratch cache lines, simply ignored below
+            # positions, straight from the device-resident state); non-
+            # draft slots' rows are scratch proposals into scratch cache
+            # lines, simply ignored below
             drafts_all, self.d_kc, self.d_vc = _draft_propose_slots(
-                self.draft_params, jnp.asarray(self.last_tok),
-                self.d_kc, self.d_vc, jnp.asarray(self.lengths),
+                self.draft_params, self._dev["last_tok"],
+                self.d_kc, self.d_vc, self._dev["lengths"],
                 self.draft_cfg, k,
             )
-            drafts_all = np.asarray(drafts_all)
+            drafts_all = jax.device_get(drafts_all)
         for s, req in enumerate(self.active):
             if req is None:
                 continue
@@ -985,13 +1258,12 @@ class PagedEngine:
             n_draft[s] = k_eff
         logits, self.kpool, self.vpool = paged_verify(
             self.params, jnp.asarray(tokens), self.kpool, self.vpool,
-            jnp.asarray(self.tables), jnp.asarray(self.lengths),
+            self._dev["tables"], self._dev["lengths"],
             jnp.asarray(n_draft), self.cfg, self.block_size, W,
         )
         toks0, new_keys = _sample_tokens(
-            logits[:, 0, :], jnp.asarray(self.temps),
-            jnp.asarray(self.keys, jnp.uint32),
-            jnp.asarray(self.penalties), jnp.asarray(self.seen),
+            logits[:, 0, :], self._dev["temps"], self._dev["keys"],
+            self._dev["penalties"], self._dev["seen"],
         )
         # ONE coalesced fetch per tick (the host round-trip discipline
         # models/speculative._spec_loop documents).  Acceptance needs
@@ -1004,16 +1276,18 @@ class PagedEngine:
             n_draft[s] > 0 and self.penalties[s] != 1.0
             for s in range(S))
         if need_logits:
-            logits_np, choices_np, nxt0, new_keys = jax.device_get(
-                (logits, choices, toks0, new_keys))
+            logits_np, choices_np, nxt0 = jax.device_get(
+                (logits, choices, toks0))
         else:
             logits_np = None
-            choices_np, nxt0, new_keys = jax.device_get(
-                (choices, toks0, new_keys))
-        self.keys = np.array(new_keys, np.uint32)
+            choices_np, nxt0 = jax.device_get((choices, toks0))
         self.counters["ticks"] += 1
         self.counters["verify_passes"] += 1
         finished = []
+        adv = np.zeros(S, np.int32)
+        last = np.zeros(S, np.int32)
+        marks = np.zeros((S, W), np.int32)
+        to_release = []
         for s, req in enumerate(self.active):
             if req is None:
                 continue
@@ -1029,12 +1303,24 @@ class PagedEngine:
             for t in committed:
                 if n_draft[s]:
                     self.counters["spec_tokens"] += 1
+                marks[s, adv[s]] = t
+                adv[s] += 1
+                last[s] = t
                 if self._emit(s, req, t):
                     done = True
                     break
             if done:
-                self._release_slot(s, req)
-                finished.append(req.req_id)
+                to_release.append((s, req))
+        # batched device commit for EVERY slot this round, BEFORE the
+        # releases (whose _push_slot rewrites finished slots wholesale —
+        # committing after would double-advance them)
+        self._dev = _spec_commit(
+            self._dev, jnp.asarray(adv), jnp.asarray(last), new_keys,
+            jnp.asarray(marks),
+        )
+        for s, req in to_release:
+            self._release_slot(s, req)
+            finished.append(req.req_id)
         if self.cfg.attn_window:
             self._retire_windowed_blocks()
         return finished
@@ -1100,6 +1386,13 @@ class PagedEngine:
                 if b != TRASH:
                     self._deref(b)
                     self.tables[s, j] = TRASH
+                    # device table mirror follows through a one-entry
+                    # scatter; ordering is safe under overlap — the
+                    # block was already outside every in-flight query's
+                    # window (reads masked), so a late TRASH only
+                    # redirects dead addresses
+                    self._h2d = True
+                    self._dev = _table_trash(self._dev, s, j)
                     self.counters["blocks_retired"] += 1
             self._retire_from[s] = max(self._retire_from[s], n_dead)
 
@@ -1122,25 +1415,51 @@ class PagedEngine:
                 return "active"
         return "gone"
 
+    @property
+    def inflight_depth(self) -> int:
+        """Device ticks dispatched but not yet drained by the host (0
+        when idle; the daemon's stepper loops until this hits 0)."""
+        return len(self._inflight)
+
     def stats(self) -> Dict[str, int]:
-        """Serving observability: counters plus live pool occupancy."""
+        """Serving observability: counters plus live pool occupancy and
+        the async window's current depth (``inflight_depth``: device
+        ticks dispatched but not yet drained by the host)."""
         return {
             **self.counters,
             "blocks_free": len(self.free),
             "blocks_total": self.n_usable_blocks,
             "cache_entries": len(self.prefix_cache),
+            "inflight_depth": self.inflight_depth,
         }
 
     def run(self) -> Dict[int, np.ndarray]:
         """Drain queue + active slots; {req_id: generated tokens} for
         the requests completed by THIS call (earlier runs' results are
         consumed by their own return — a long-lived engine doesn't
-        accumulate them)."""
+        accumulate them).
+
+        The convergence guard counts only ticks that DISPATCHED device
+        work: empty ticks (bursty queues on a long-lived engine, drain-
+        only iterations) no longer burn it down.  A state where nothing
+        can ever progress — pending work, no admission possible, no
+        active slots, nothing in flight — raises immediately instead of
+        spinning the guard to exhaustion."""
         guard = 0
-        while self.pending or any(r is not None for r in self.active):
+        while (self.pending or self._inflight
+               or any(r is not None for r in self.active)):
+            before = (self.counters["ticks"], self.counters["tokens_out"],
+                      self.counters["requests_done"], len(self.pending),
+                      len(self._inflight))
             self.step()
-            guard += 1
-            if guard > 100_000:
-                raise RuntimeError("engine did not converge")
+            if self.counters["ticks"] != before[0]:
+                guard += 1  # real device work: keep the old 100k bound
+                if guard > 100_000:
+                    raise RuntimeError("engine did not converge")
+            elif (self.counters["tokens_out"], self.counters["requests_done"],
+                  len(self.pending), len(self._inflight)) == before[1:]:
+                raise RuntimeError(
+                    "engine cannot make progress: pending request not "
+                    "admittable and nothing active or in flight")
         done, self._done = self._done, {}
         return done

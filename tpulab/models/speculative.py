@@ -86,7 +86,10 @@ def _draft_propose_slots(params, last_tok, k_caches, v_caches, pos,
     verbatim (positions differ per slot, which the shared-scalar-pos
     batch path cannot express); the caches are DONATED so each round
     updates in place instead of copying every layer's cache per
-    propose."""
+    propose.  The engine passes ``last_tok``/``pos`` straight from its
+    DEVICE-resident state (models/paged), so a draft round uploads
+    nothing — only the lookup proposer reads the host mirror (it needs
+    the committed history, which lives in ``req.out`` anyway)."""
     def one(tok, kc_s, vc_s, p):
         drafts, kc_o, vc_o = _draft_propose(
             params, tok[None], kc_s[:, None], vc_s[:, None], p, cfg, k)

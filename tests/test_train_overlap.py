@@ -1,0 +1,285 @@
+"""Device-resident training step: donated state, K-step fused dispatch,
+one-step-async loop (tpulab/train.py + the labformer step builders).
+
+Headline properties (the training analog of tests/test_paged_overlap.py):
+  * the (step, loss) trajectory is BIT-IDENTICAL across overlap on/off
+    and steps_per_call K in {1, 4}, for the synthetic stream, the
+    native-loader corpus stream, and the LoRA finetune path;
+  * steady-state steps perform ZERO implicit host<->device transfers
+    (``jax.transfer_guard("disallow")``; the batch upload is an
+    EXPLICIT device_put) and the live-buffer count stays flat — the
+    donated step aliases params/opt_state instead of copying;
+  * re-using a donated params/opt_state tree raises (the donation
+    tripwire);
+  * ``--inject-fault`` + ``--recover`` rollback replays bit-identically
+    under the async window: late NaN detection discards the in-flight
+    block and lands on the same final params as a fault-free run;
+  * ``--log-every`` thins [train] lines while preserving exact
+    step/loss pairing from the delayed drain, and the batched eval
+    fetch reports bit-identical val_loss.
+"""
+
+import re
+
+import jax
+import numpy as np
+import pytest
+
+from tpulab.models.labformer import LabformerConfig, init_train_state
+from tpulab.train import batches, device_resident, train
+
+TINY = LabformerConfig(d_model=32, n_heads=4, n_layers=2, d_ff=64, max_seq=32)
+
+
+def _run(**kw):
+    lines = []
+    kw.setdefault("batch", 4)
+    kw.setdefault("seq", 32)
+    kw.setdefault("cfg", TINY)
+    _, loss = train(log=lines.append, **kw)
+    return lines, loss
+
+
+def _pairs(lines):
+    """Exact (step, loss-string) pairing of the emitted [train] lines."""
+    out = []
+    for l in lines:
+        m = re.match(r"\[train\] step (\d+) loss (\S+) ", l)
+        if m:
+            out.append((int(m.group(1)), m.group(2)))
+    return out
+
+
+def _counters(lines):
+    for l in lines:
+        if l.startswith("[train] counters"):
+            return dict(kv.split("=") for kv in l.split()[2:])
+    raise AssertionError(f"no counters line in {lines}")
+
+
+class TestTrajectoryBitIdentical:
+    def test_synthetic_overlap_and_k(self):
+        """ISSUE acceptance: overlap on/off x K in {1, 4} all reproduce
+        the synchronous K=1 trajectory — same (step, loss) lines, same
+        final loss bit for bit.  steps=9 exercises the K=4 remainder
+        (two fused blocks + a K=1 tail)."""
+        base_lines, base_loss = _run(steps=9, overlap=0)
+        for kw in (dict(overlap=1),
+                   dict(overlap=1, steps_per_call=4),
+                   dict(overlap=0, steps_per_call=4)):
+            lines, loss = _run(steps=9, **kw)
+            assert _pairs(lines) == _pairs(base_lines), kw
+            assert loss == base_loss, kw
+
+    def test_step_k_bit_identical_machinery(self):
+        """The fused K-step program IS the single step scanned: per-step
+        losses and the advanced params agree bit for bit with K
+        sequential calls of the donated 1-step program."""
+        batch_at = batches(TINY.vocab, 4, 32, seed=3)
+        toks = np.stack([batch_at(i) for i in range(8)])
+
+        p1, o1, step = init_train_state(TINY, None, seed=0, donate=True)
+        p1, o1 = device_resident(p1), device_resident(o1)
+        seq_losses = []
+        for i in range(8):
+            p1, o1, l = step(p1, o1, jax.device_put(toks[i]))
+            seq_losses.append(float(jax.device_get(l)))
+
+        p2, o2, step2 = init_train_state(TINY, None, seed=0, donate=True)
+        p2, o2 = device_resident(p2), device_resident(o2)
+        k_losses = []
+        for i in (0, 4):
+            p2, o2, ls = step2.step_k(p2, o2, jax.device_put(toks[i:i + 4]))
+            k_losses.extend(np.asarray(jax.device_get(ls)).tolist())
+
+        assert k_losses == seq_losses
+        for a, b in zip(jax.tree_util.tree_leaves(jax.device_get(p1)),
+                        jax.tree_util.tree_leaves(jax.device_get(p2))):
+            assert np.array_equal(a, b)
+
+    def test_corpus_overlap_and_k(self, tmp_path):
+        """The native-loader corpus stream (strictly sequential cursor)
+        survives K-blocking and the async window: identical windows in
+        identical order, bit-identical trajectory."""
+        d = tmp_path / "corpus"
+        d.mkdir()
+        rng = np.random.default_rng(0)
+        for i in range(2):
+            (d / f"f{i}.bin").write_bytes(rng.integers(
+                0, 256, 4096, dtype=np.uint8).tobytes())
+        base_lines, base_loss = _run(steps=8, overlap=0,
+                                     data_dir=str(d))
+        for kw in (dict(overlap=1),
+                   dict(overlap=1, steps_per_call=4)):
+            lines, loss = _run(steps=8, data_dir=str(d), **kw)
+            assert _pairs(lines) == _pairs(base_lines), kw
+            assert loss == base_loss, kw
+
+    def test_lora_overlap_and_k(self):
+        """The LoRA finetune step (adapter-only grads, donated base
+        pass-through) holds the same bit-identity bar."""
+        base_lines, base_loss = _run(steps=9, overlap=0, lora_rank=2)
+        for kw in (dict(overlap=1),
+                   dict(overlap=1, steps_per_call=4)):
+            lines, loss = _run(steps=9, lora_rank=2, **kw)
+            assert _pairs(lines) == _pairs(base_lines), kw
+            assert loss == base_loss, kw
+
+    def test_vision_overlap(self):
+        """The labvision family shares the donated async loop (K stays
+        1 — token-block fusion is labformer-only)."""
+        from tpulab.models.labvision import LabvisionConfig
+
+        cfg = LabvisionConfig(n_classes=4, img_size=16, channels=(8, 16))
+        _, on = train(model="labvision", steps=4, batch=8, cfg=cfg,
+                      overlap=1, log=lambda *a: None)
+        _, off = train(model="labvision", steps=4, batch=8, cfg=cfg,
+                       overlap=0, log=lambda *a: None)
+        assert on == off
+
+
+class TestRecovery:
+    def test_fault_rollback_bit_identical_params(self, tmp_path):
+        """A fault detected ONE BLOCK LATE (async window open, K=4
+        elsewhere; the fault step itself runs as a forced K=1 call)
+        discards the in-flight dispatch, rolls back to the snapshot and
+        replays to EXACTLY the fault-free final params and loss."""
+        import os
+
+        import orbax.checkpoint as ocp
+
+        def load_params(d):
+            mgr = ocp.CheckpointManager(os.path.abspath(d))
+            step = mgr.latest_step()
+            r = mgr.restore(step, args=ocp.args.Composite(
+                state=ocp.args.StandardRestore()))
+            return r["state"]["params"], step
+
+        d_fault = str(tmp_path / "fault")
+        d_clean = str(tmp_path / "clean")
+        msgs = []
+        _, recovered = train(
+            steps=10, batch=4, seq=32, cfg=TINY, ckpt_dir=d_fault,
+            save_every=5, recover=2, inject_fault=(7,), overlap=1,
+            steps_per_call=4, log=lambda m: msgs.append(str(m)),
+        )
+        clean_lines, straight = _run(steps=10, overlap=0,
+                                     ckpt_dir=d_clean, save_every=5)
+        assert any("[fault]" in m for m in msgs), msgs
+        assert any("[recover]" in m and "snapshot 5" in m for m in msgs), msgs
+        assert recovered == straight
+        # the replayed tail of the trajectory matches the fault-free one
+        assert _pairs(msgs)[-5:] == _pairs(clean_lines)[-5:]
+        pf, sf = load_params(d_fault)
+        pc, sc = load_params(d_clean)
+        assert sf == sc == 10
+        for a, b in zip(jax.tree_util.tree_leaves(pf),
+                        jax.tree_util.tree_leaves(pc)):
+            assert np.array_equal(np.asarray(a), np.asarray(b))
+
+    def test_budget_exhaustion_still_fails_fast_under_overlap(self, tmp_path):
+        with pytest.raises(FloatingPointError, match="non-finite loss"):
+            train(steps=10, batch=4, seq=32, cfg=TINY,
+                  ckpt_dir=str(tmp_path / "rec"), save_every=5, recover=1,
+                  inject_fault=(6, 7), overlap=1, steps_per_call=4,
+                  log=lambda *a: None)
+
+
+class TestDeviceResidency:
+    def test_donation_tripwire(self):
+        """Re-using a donated params/opt_state tree must raise — the
+        buffers were aliased into the update, not copied."""
+        p, o, step = init_train_state(TINY, None, seed=0, donate=True)
+        p, o = device_resident(p), device_resident(o)
+        tok = batches(TINY.vocab, 2, 32, seed=0)(0)
+        old_p, old_o = p, o
+        p, o, _ = step(p, o, tok)
+        leaf = jax.tree_util.tree_leaves(old_p)[0]
+        assert leaf.is_deleted()
+        # jaxlib raises RuntimeError on direct array use and ValueError
+        # (INVALID_ARGUMENT) when a deleted buffer enters a jit call
+        with pytest.raises((RuntimeError, ValueError), match="deleted"):
+            step(old_p, old_o, tok)
+
+    def test_steady_state_zero_uploads_flat_buffers(self):
+        """ISSUE acceptance: a steady-state train step moves NOTHING
+        implicitly between host and device — params/opt_state are
+        device-resident and ALIASED through every call (flat live-array
+        count), the token batch rides one EXPLICIT device_put, and the
+        loss fetch is an explicit device_get after the guarded window.
+        Covers the 1-step and the fused K-step programs."""
+        p, o, step = init_train_state(TINY, None, seed=0, donate=True)
+        p, o = device_resident(p), device_resident(o)
+        batch_at = batches(TINY.vocab, 2, 32, seed=1)
+        toks = [jax.device_put(batch_at(i)) for i in range(10)]
+        block = jax.device_put(np.stack([batch_at(10 + j) for j in range(4)]))
+        warm_block = jax.device_put(
+            np.stack([batch_at(20 + j) for j in range(4)]))
+        # compile both programs OUTSIDE the guard
+        p, o, l = step(p, o, toks[0])
+        p, o, l = step.step_k(p, o, warm_block)
+        n0 = len(jax.live_arrays())
+        with jax.transfer_guard("disallow"):
+            for t in toks[1:7]:
+                p, o, l = step(p, o, t)
+            p, o, lk = step.step_k(p, o, block)
+        n1 = len(jax.live_arrays())
+        # 6 single steps + 1 fused call: state aliased in place, only
+        # the rebound loss outputs differ -> the census stays flat
+        assert n1 <= n0 + 2, (n0, n1)
+        assert np.all(np.isfinite(jax.device_get(lk)))
+
+
+class TestLoggingAndEval:
+    def test_log_every_preserves_pairing(self):
+        """Thinned lines are an exact subset: same (step, loss) pairs
+        from the delayed-loss queue, every other step."""
+        full_lines, _ = _run(steps=6, overlap=1)
+        thin_lines, _ = _run(steps=6, overlap=1, log_every=2)
+        full = _pairs(full_lines)
+        assert _pairs(thin_lines) == [p for p in full if p[0] % 2 == 0]
+
+    def test_eval_batched_fetch_bit_identical(self):
+        """[eval] lines (dispatch-all, fetch-once) agree across the
+        async window and K-fusion — eval boundaries end blocks, so the
+        evaluated params are per-step exact."""
+        base, _ = _run(steps=8, overlap=0, eval_every=4, eval_batches=3)
+        want = [l for l in base if l.startswith("[eval]")]
+        assert len(want) == 2
+        for kw in (dict(overlap=1),
+                   dict(overlap=1, steps_per_call=4)):
+            lines, _ = _run(steps=8, eval_every=4, eval_batches=3, **kw)
+            assert [l for l in lines if l.startswith("[eval]")] == want, kw
+
+    def test_counters_and_remainder_accounting(self, tmp_path):
+        """K=4 over 10 steps with a save boundary at 5: fused blocks
+        0-3 and 5-8, forced K=1 remainders at 4 and 9 (the driver
+        compiles exactly two programs), checkpoints land on schedule,
+        and the boundary drains show up as host_syncs."""
+        import orbax.checkpoint as ocp
+
+        d = str(tmp_path / "ck")
+        lines, _ = _run(steps=10, overlap=1, steps_per_call=4,
+                        ckpt_dir=d, save_every=5)
+        c = _counters(lines)
+        assert c["fused_calls"] == "2", c
+        assert c["dispatches"] == "4", c
+        assert int(c["host_syncs"]) >= 1, c
+        mgr = ocp.CheckpointManager(d)
+        assert mgr.latest_step() == 10
+        assert _pairs(lines) == _pairs(_run(steps=10, overlap=0)[0])
+
+
+class TestRefusals:
+    def test_steps_per_call_needs_labformer(self):
+        with pytest.raises(ValueError, match="steps_per_call"):
+            train(model="labvision", steps=2, steps_per_call=4,
+                  log=lambda *a: None)
+
+    def test_bad_knobs_refused(self):
+        with pytest.raises(ValueError, match="steps_per_call"):
+            train(steps=2, cfg=TINY, steps_per_call=0, log=lambda *a: None)
+        with pytest.raises(ValueError, match="log_every"):
+            train(steps=2, cfg=TINY, log_every=0, log=lambda *a: None)
+        with pytest.raises(ValueError, match="overlap"):
+            train(steps=2, cfg=TINY, overlap=-1, log=lambda *a: None)

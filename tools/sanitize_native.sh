@@ -1,0 +1,91 @@
+#!/bin/bash
+# One sanitizer pass over the native tier (VERDICT round-5 weak #7).
+#
+#   1. ASan+UBSan: native/fastcodec (CPython extension) and
+#      native/loader (ctypes .so) rebuilt instrumented IN PLACE (the
+#      production .so's are stashed and restored), then exercised by
+#      the real python tests — tests/test_native.py, tests/test_loader.py,
+#      tests/test_io.py — under LD_PRELOAD=libasan.
+#      detect_leaks=0: CPython's arena allocator reports thousands of
+#      intentional "leaks"; the pass is for heap corruption and UB,
+#      which abort loudly (-fno-sanitize-recover=undefined).
+#      Tests that COMPILE jax programs (the daemon fixture, the train
+#      driver integrations) are deselected: jaxlib 0.4.36's XLA
+#      compiler aborts under an ASan-preloaded interpreter before any
+#      native code runs — an environment limit, not a native finding.
+#      Every deselected loader path stays covered functionally by the
+#      regular suite and concurrently by the TSan driver below.
+#   2. TSan: the loader's worker/consumer choreography cannot run under
+#      a preloaded libtsan with an uninstrumented CPython, so the
+#      thread pass compiles native/loader/tpulab_loader.cpp TOGETHER
+#      with tools/tsan_loader_driver.cpp (everything instrumented) and
+#      hammers claim/publish, resume cursors, the relaxed short_reads
+#      counter, and mid-stream shutdown across 2/4/8 worker threads.
+#
+# The combined log is committed at results/logs/native_sanitizers.log;
+# exit is nonzero if any stage fails.  Host-only (no TPU claim): safe
+# to run outside the relay queue.
+set -u
+cd "$(dirname "$0")/.." || exit 1
+L=results/logs
+mkdir -p "$L"
+LOG=$L/native_sanitizers.log
+: > "$LOG"
+note() { echo "$@" | tee -a "$LOG"; }
+note "== native sanitizer pass: $(gcc --version | head -1)"
+
+rc=0
+SAN="-fsanitize=address,undefined -fno-sanitize-recover=undefined -g -O1 -fno-omit-frame-pointer"
+PYINC=$(python -c "import sysconfig; print(sysconfig.get_paths()['include'])")
+EXT=$(python -c "import sysconfig; print(sysconfig.get_config_var('EXT_SUFFIX'))")
+
+# stash the production artifacts; instrumented builds go IN PLACE so the
+# ctypes path (io/loader.py) and the sys.path extension hook
+# (io/imagefile.py) pick them up without any code changes
+cp -a native/lib native/lib.pre-sanitize
+restore() { rm -rf native/lib; mv native/lib.pre-sanitize native/lib; }
+trap restore EXIT
+
+note "== build: fastcodec + loader under ASan/UBSan"
+# PIPESTATUS, not the pipeline exit: `| tee` would otherwise mask a
+# compiler failure and the pass would run GREEN against the stashed
+# uninstrumented production .so's
+gcc -shared -fPIC $SAN -Wall -I"$PYINC" \
+    -o "native/lib/_tpulab_fastcodec$EXT" native/fastcodec/fastcodecmodule.c \
+    2>&1 | tee -a "$LOG"
+[ "${PIPESTATUS[0]}" -eq 0 ] || rc=1
+g++ -std=c++17 -shared -fPIC $SAN -Wall -pthread \
+    -o native/lib/libtpulab_loader.so native/loader/tpulab_loader.cpp \
+    2>&1 | tee -a "$LOG"
+[ "${PIPESTATUS[0]}" -eq 0 ] || rc=1
+
+ASAN_LIB=$(gcc -print-file-name=libasan.so)
+note "== pytest under ASan/UBSan (preload $ASAN_LIB)"
+env LD_PRELOAD="$ASAN_LIB" \
+    ASAN_OPTIONS="detect_leaks=0" \
+    UBSAN_OPTIONS="print_stacktrace=1,halt_on_error=1" \
+    JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+    python -m pytest tests/test_io.py tests/test_loader.py \
+        -q -p no:cacheprovider -k "not train" 2>&1 | tee -a "$LOG"
+[ "${PIPESTATUS[0]}" -eq 0 ] || rc=1
+env LD_PRELOAD="$ASAN_LIB" \
+    ASAN_OPTIONS="detect_leaks=0" \
+    UBSAN_OPTIONS="print_stacktrace=1,halt_on_error=1" \
+    JAX_PLATFORMS=cpu PALLAS_AXON_POOL_IPS= \
+    python -m pytest tests/test_native.py \
+        -q -p no:cacheprovider -k "Fastcodec or rejects_bad_usage" \
+        2>&1 | tee -a "$LOG"
+[ "${PIPESTATUS[0]}" -eq 0 ] || rc=1
+
+note "== build + run: loader under TSan (dedicated threaded driver)"
+TSAN_BIN=$(mktemp -t tsan_loader.XXXXXX)
+g++ -std=c++17 -fsanitize=thread -g -O1 -Wall -pthread \
+    -o "$TSAN_BIN" tools/tsan_loader_driver.cpp native/loader/tpulab_loader.cpp \
+    2>&1 | tee -a "$LOG"
+[ "${PIPESTATUS[0]}" -eq 0 ] || rc=1
+TSAN_OPTIONS="halt_on_error=1" "$TSAN_BIN" 2>&1 | tee -a "$LOG"
+[ "${PIPESTATUS[0]}" -eq 0 ] || rc=1
+rm -f "$TSAN_BIN"
+
+note "== sanitizer pass rc=$rc"
+exit $rc

@@ -32,25 +32,28 @@ ROOT = pathlib.Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(ROOT))
 
 
-def _measure(cfg_kw, s: int, b: int, reps: int, train: bool,
-             smoke: bool = False):
-    import jax
+def _probe_cfg(cfg_kw, s: int, smoke: bool):
+    """THE probe model shape (one place: the fused_k4 rows are deltas
+    against the per-step rows, so they must measure the same model)."""
     import jax.numpy as jnp
 
-    from tpulab.bench import _mfu_fields, labformer_fwd_flops
-    from tpulab.models.labformer import (
-        LabformerConfig,
-        forward,
-        init_train_state,
-    )
-    from tpulab.runtime.device import commit, default_device
-    from tpulab.runtime.timing import measure_ms
+    from tpulab.models.labformer import LabformerConfig
 
     dims = (dict(d_model=64, n_heads=2, n_layers=2, d_ff=128) if smoke
             else dict(d_model=512, n_heads=8, n_layers=8, d_ff=2048))
-    cfg = LabformerConfig(
-        max_seq=s, dtype=jnp.bfloat16, **dims, **cfg_kw,
-    )
+    return LabformerConfig(max_seq=s, dtype=jnp.bfloat16, **dims, **cfg_kw)
+
+
+def _measure(cfg_kw, s: int, b: int, reps: int, train: bool,
+             smoke: bool = False):
+    import jax
+
+    from tpulab.bench import _mfu_fields, labformer_fwd_flops
+    from tpulab.models.labformer import forward, init_train_state
+    from tpulab.runtime.device import commit, default_device
+    from tpulab.runtime.timing import measure_ms
+
+    cfg = _probe_cfg(cfg_kw, s, smoke)
     device = default_device()
     params, opt_state, step = init_train_state(cfg, mesh=None, seed=0)
     params = jax.device_put(params, device)
@@ -75,6 +78,45 @@ def _measure(cfg_kw, s: int, b: int, reps: int, train: bool,
            "tokens_per_s": round(b * s / (ms / 1e3), 1),
            **_mfu_fields(flops, ms, device)}
     return row
+
+
+def _measure_fused(cfg_kw, s: int, b: int, reps: int, k: int = 4,
+                   smoke: bool = False):
+    """The device-resident train step: donated (params, opt_state) and
+    K fused optimizer steps per dispatch (``step.step_k``).  State feeds
+    forward call to call (donation consumes it), so this times the loop
+    the way the driver actually runs it — per-step ms is the K-call
+    median divided by K."""
+    import time
+
+    import jax
+
+    from tpulab.bench import _mfu_fields, labformer_fwd_flops
+    from tpulab.models.labformer import init_train_state
+    from tpulab.runtime.device import default_device
+    from tpulab.train import device_resident
+
+    cfg = _probe_cfg(cfg_kw, s, smoke)
+    device = default_device()
+    params, opt_state, step = init_train_state(cfg, None, seed=0, donate=True)
+    params = device_resident(params)
+    opt_state = device_resident(opt_state)
+    rng = np.random.default_rng(0)
+    block = jax.device_put(
+        rng.integers(0, cfg.vocab, (k, b, s + 1)).astype(np.int32))
+    params, opt_state, losses = step.step_k(params, opt_state, block)
+    jax.device_get(losses)  # compile + settle outside the timer
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        params, opt_state, losses = step.step_k(params, opt_state, block)
+        jax.device_get(losses)
+        times.append((time.perf_counter() - t0) * 1e3)
+    ms = float(np.median(times)) / k
+    return {"median_ms": round(ms, 3),
+            "tokens_per_s": round(b * s / (ms / 1e3), 1),
+            "steps_per_call": k,
+            **_mfu_fields(3 * labformer_fwd_flops(cfg, b, s), ms, device)}
 
 
 def main(argv=None) -> int:
@@ -123,11 +165,23 @@ def main(argv=None) -> int:
         (f"fwd_s{big}_dense", dict(attn_impl="dense"), big, b, False),
         (f"train_s{small}_dense", dict(attn_impl="dense"), small, b, True),
         (f"fwd_s{small}_dense", dict(attn_impl="dense"), small, b, False),
+        # the device-resident loop on the same shapes: donated state +
+        # K=4 fused dispatch — the delta vs train_s*_ isolates per-step
+        # dispatch/sync overhead on the real chip
+        (f"train_s{big}_flash_fused_k4", dict(attn_impl="flash"), big, b,
+         "fused"),
+        (f"train_s{small}_dense_fused_k4", dict(attn_impl="dense"), small, b,
+         "fused"),
     ]
-    for name, kw, s, b_, train in cases:
+    for name, kw, s, b_, mode in cases:
         try:
-            report["cases"][name] = _measure(kw, s, b_, args.reps, train,
-                                             smoke=args.smoke)
+            if mode == "fused":
+                report["cases"][name] = _measure_fused(
+                    kw, s, b_, args.reps, smoke=args.smoke)
+            else:
+                report["cases"][name] = _measure(kw, s, b_, args.reps,
+                                                 bool(mode),
+                                                 smoke=args.smoke)
         except Exception as e:  # keep partial evidence on a relay drop
             report["cases"][name] = {"error": f"{type(e).__name__}: {e}"}
         print(json.dumps({name: report["cases"][name]}), flush=True)

@@ -444,6 +444,103 @@ def bench_paged_tick(
     }
 
 
+def bench_train_step(
+    steps: int = 48, k: int = 8, reps: int = 5, b: int = 1, s: int = 16
+) -> Dict[str, Any]:
+    """Train-step overhead: steady-state optimizer steps/s on a small
+    model — the per-step host-cost metric the device-resident training
+    step exists to cut (CPU proxy; the on-chip number rides
+    tools/onchip_queue_r8.sh).
+
+    The PRE-CHANGE loop re-synced on ``float(loss)`` after every
+    dispatch, rebuilt the numpy batch on the blocked host, and ran an
+    UNDONATED step (params + opt_state — the program's two largest
+    trees — freshly allocated every call).  Reported value is the new
+    loop (donated state, K-step fused dispatch, one-step-async drain);
+    ``sync_steps_per_s`` is the pre-change loop on the same model, and
+    ``speedup_vs_sync`` is the ISSUE's >= 1.3x acceptance gate."""
+    import time
+    from collections import deque
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpulab.models.labformer import (
+        LabformerConfig,
+        init_params,
+        make_train_step,
+    )
+    from tpulab.runtime.device import default_device
+    from tpulab.train import batches, device_resident
+
+    cfg = LabformerConfig(d_model=32, n_heads=4, n_layers=2, d_ff=64,
+                          max_seq=s, dtype=jnp.float32)
+    device = default_device()
+    batch_at = batches(cfg.vocab, b, s, seed=0)
+    assert steps % k == 0, "steps must be a multiple of k"
+    # the step programs compile ONCE (shared across every timed window);
+    # fresh state per window replaces what the donated loop consumed —
+    # built by the SAME optimizer object each step closed over, so the
+    # opt_state pytree can never drift from the compiled program
+    opt_old, step_old = make_train_step(cfg, None, donate=False)
+    opt_new, step_new = make_train_step(cfg, None, donate=True)
+
+    def fresh(donate):
+        params = init_params(cfg, seed=0)
+        opt_state = (opt_new if donate else opt_old).init(params)
+        if donate:
+            return device_resident(params), device_resident(opt_state)
+        return jax.device_put(params), jax.device_put(opt_state)
+
+    def window_old():
+        p, o = fresh(donate=False)
+        p, o, l = step_old(p, o, batch_at(0))  # warm outside the timer
+        float(l)
+        t0 = time.perf_counter()
+        for i in range(steps):
+            data = batch_at(i)                 # host build BLOCKS the device
+            p, o, l = step_old(p, o, data)
+            float(l)                           # per-step sync fetch
+        return time.perf_counter() - t0
+
+    def window_new():
+        p, o = fresh(donate=True)
+        p, o, l = step_new.step_k(
+            p, o, jax.device_put(np.stack([batch_at(j) for j in range(k)])))
+        jax.device_get(l)                      # warm outside the timer
+        pending: deque = deque()
+        t0 = time.perf_counter()
+        for i0 in range(0, steps, k):
+            block = jax.device_put(
+                np.stack([batch_at(i0 + j) for j in range(k)]))
+            p, o, l = step_new.step_k(p, o, block)
+            pending.append(l)
+            while len(pending) > 1:            # one-block-async drain
+                jax.device_get(pending.popleft())
+        while pending:
+            jax.device_get(pending.popleft())
+        return time.perf_counter() - t0
+
+    for w in (window_old, window_new):
+        w()  # compile + cache warm
+    times = {"old": [], "new": []}
+    for _ in range(max(reps, 3)):
+        times["old"].append(window_old())
+        times["new"].append(window_new())
+    t_new = float(np.median(times["new"]))
+    t_old = float(np.median(times["old"]))
+    return {
+        "metric": f"train_step_b{b}_s{s}_k{k}_steps_per_s",
+        "value": round(steps / t_new, 1),
+        "unit": "steps/s",
+        "vs_baseline": None,
+        "sync_steps_per_s": round(steps / t_old, 1),
+        "speedup_vs_sync": round(t_old / t_new, 3),
+        "device": device.platform,
+        **variance_fields([t * 1e3 for t in times["new"]]),
+    }
+
+
 def bench_labformer_decode(
     b: int = 8, steps: int = 128, reps: int = 3, dtype: str = "bfloat16",
     int8: bool = False, kv_heads: int = 0,
@@ -608,6 +705,7 @@ def run_benchmarks(only: Optional[str] = None, yield_markers: bool = False,
         "speculative_decode": bench_speculative_decode,
         "paged_engine": bench_paged_engine,
         "paged_tick_overhead": bench_paged_tick,
+        "train_step_overhead": bench_train_step,
         "labvision_train": bench_labvision_train,
         "hw2_sort": bench_sort,
         "lab5_reduce": bench_reduce,

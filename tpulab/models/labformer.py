@@ -641,15 +641,55 @@ def loss_fn(params, tokens, cfg: LabformerConfig, mesh: Optional[Mesh] = None):
     return loss
 
 
+def _finalize_step(body, donate: bool):
+    """Jit a ``(params, opt_state, data) -> (params, opt_state, loss)``
+    step body and attach the K-step fused program.
+
+    ``donate=True`` passes ``donate_argnums=(0, 1)``: XLA aliases the
+    params and opt_state updates in place instead of allocating fresh
+    trees every step — the two largest buffers in the program stop
+    being copied, and re-using a donated input afterwards raises (the
+    tripwire tests/test_train_overlap.py relies on).
+
+    The returned step carries ``step.step_k``: ``lax.scan`` of the SAME
+    traced body over a stacked ``(K, ...)`` data block — K optimizer
+    steps in ONE jitted dispatch, per-step losses returned ``(K,)``.
+    Because the scan body is the identical trace, the loss trajectory is
+    bit-identical to K sequential ``step`` calls (asserted by
+    tests/test_train_overlap.py for K in {1, 4}).
+    """
+    donate_argnums = (0, 1) if donate else ()
+    step = jax.jit(body, donate_argnums=donate_argnums)
+
+    def k_body(params, opt_state, blocks):
+        def one(carry, data):
+            p, o, loss = body(carry[0], carry[1], data)
+            return (p, o), loss
+
+        (params, opt_state), losses = jax.lax.scan(
+            one, (params, opt_state), blocks)
+        return params, opt_state, losses
+
+    step.step_k = jax.jit(k_body, donate_argnums=donate_argnums)
+    return step
+
+
 def make_train_step(
     cfg: LabformerConfig, mesh: Optional[Mesh], optimizer=None, accum: int = 1,
-    zero1: bool = False, zero2: bool = False,
+    zero1: bool = False, zero2: bool = False, donate: bool = False,
 ):
     """Jitted (params, opt_state, tokens) -> (params, opt_state, loss).
 
     ``accum > 1`` splits the batch into ``accum`` microbatches and
     averages their gradients inside one jitted step (``lax.scan``) —
     the effective batch grows without growing activation memory.
+
+    ``donate=True`` donates ``(params, opt_state)`` to the step (XLA
+    aliases the update in place; the caller must rebind, never re-use,
+    the donated trees), and every step exposes ``step.step_k`` — the
+    K-step fused program over a ``(K, batch, seq+1)`` token block (see
+    :func:`_finalize_step`).  Off by default: benches and tests that
+    re-invoke a step on held-fixed state rely on undonated inputs.
 
     ``zero1`` shards optimizer moments over the dp axis (ZeRO stage 1):
     each dp rank stores and updates 1/dp of the Adam state, XLA slicing
@@ -678,7 +718,7 @@ def make_train_step(
                 "lora_rank > 0 with zero1/zero2 is pointless: the "
                 "optimizer state is already O(rank) per layer"
             )
-        return optimizer, _make_lora_step(cfg, mesh, optimizer, accum)
+        return optimizer, _make_lora_step(cfg, mesh, optimizer, accum, donate)
 
     def _constrain_grads(grads):
         return jax.tree_util.tree_map(
@@ -686,7 +726,6 @@ def make_train_step(
             zero1_shardings(grads, cfg, mesh),
         )
 
-    @jax.jit
     def train_step(params, opt_state, tokens):
         loss, grads = _accum_value_and_grad(
             lambda p, t: loss_fn(p, t, cfg, mesh), params, tokens, accum,
@@ -700,7 +739,7 @@ def make_train_step(
             )
         return params, opt_state, loss
 
-    return optimizer, train_step
+    return optimizer, _finalize_step(train_step, donate)
 
 
 def _accum_value_and_grad(loss_of, wrt, tokens, accum, post_grads=None):
@@ -770,17 +809,19 @@ def _join_lora(base, lora):
 
 
 def _make_lora_step(cfg: LabformerConfig, mesh: Optional[Mesh], optimizer,
-                    accum: int = 1):
+                    accum: int = 1, donate: bool = False):
     """Finetune step: gradients and optimizer over ADAPTER leaves only.
 
     ``value_and_grad`` differentiates w.r.t. the lora subtree alone, so
     XLA dead-code-eliminates every base weight-gradient matmul — the
     step costs forward + activation backprop + O(rank) adapter grads,
-    and ``opt_state`` holds moments for the adapters only.
+    and ``opt_state`` holds moments for the adapters only.  ``donate``
+    aliases the whole params tree (frozen base leaves pass through as
+    pure aliases — zero-copy) plus the adapter opt_state, and attaches
+    the K-step fused program (:func:`_finalize_step`).
     """
     import optax
 
-    @jax.jit
     def lora_step(params, opt_state, tokens):
         lora, base = _split_lora(params)
         loss, grads = _accum_value_and_grad(
@@ -791,7 +832,7 @@ def _make_lora_step(cfg: LabformerConfig, mesh: Optional[Mesh], optimizer,
         lora = optax.apply_updates(lora, updates)
         return _join_lora(base, lora), opt_state, loss
 
-    return lora_step
+    return _finalize_step(lora_step, donate)
 
 
 def merge_lora(params, cfg: LabformerConfig):
@@ -832,11 +873,13 @@ def init_train_state(
     accum: int = 1,
     zero1: bool = False,
     zero2: bool = False,
+    donate: bool = False,
 ):
     zero1 = bool(zero1 or zero2)
     params = init_params(cfg, seed)
     optimizer, train_step = make_train_step(
-        cfg, mesh, optimizer, accum=accum, zero1=zero1, zero2=zero2
+        cfg, mesh, optimizer, accum=accum, zero1=zero1, zero2=zero2,
+        donate=donate,
     )
     # LoRA finetuning: optimizer state covers the adapter subtree only
     # (the step never updates base leaves)

@@ -102,17 +102,20 @@ def loss_fn(params, images, labels, cfg: LabvisionConfig):
 
 
 def make_train_step(cfg: LabvisionConfig, mesh: Optional[Mesh] = None,
-                    optimizer=None):
+                    optimizer=None, donate: bool = False):
     """Jitted (params, opt_state, images, labels) -> (params, opt_state, loss).
 
     With a mesh, batch inputs shard over the ``dp`` axis and params
     replicate — XLA inserts the psum for the gradient all-reduce.
+    ``donate=True`` donates (params, opt_state) so XLA aliases the
+    update in place (the train driver's device-resident loop; callers
+    must rebind, never re-use, the donated trees).
     """
     import optax
 
     optimizer = optimizer or optax.adamw(1e-3)
 
-    @jax.jit
+    @functools.partial(jax.jit, donate_argnums=(0, 1) if donate else ())
     def step(params, opt_state, images, labels):
         loss, grads = jax.value_and_grad(loss_fn)(params, images, labels, cfg)
         updates, opt_state = optimizer.update(grads, opt_state, params)
@@ -131,9 +134,9 @@ def shard_batch(images, labels, mesh: Mesh):
 
 
 def init_train_state(cfg: LabvisionConfig, mesh: Optional[Mesh] = None,
-                     seed: int = 0, optimizer=None):
+                     seed: int = 0, optimizer=None, donate: bool = False):
     params = init_params(cfg, seed)
-    optimizer, step = make_train_step(cfg, mesh, optimizer)
+    optimizer, step = make_train_step(cfg, mesh, optimizer, donate=donate)
     if mesh is not None:
         params = jax.device_put(params, NamedSharding(mesh, P()))
     return params, optimizer.init(params), step

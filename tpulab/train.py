@@ -31,9 +31,31 @@ import dataclasses
 import json
 import sys
 import time
+from collections import deque
 from typing import Optional
 
 import numpy as np
+
+
+def device_resident(tree):
+    """Materialize a state pytree as XLA-OWNED device buffers, safe to
+    DONATE: every leaf passes through a device computation (jnp.copy),
+    never a zero-copy view of host numpy.
+
+    ``jax.device_put`` of a host array on the CPU backend may alias the
+    numpy allocation instead of copying; donating such a buffer lets the
+    runtime recycle memory it does not own.  Observed on jaxlib 0.4.36
+    with the persistent compilation cache active (the test suite's
+    configuration): silently WRONG losses followed by a glibc
+    "corrupted double-linked list" abort.  Everything entering the
+    donated train step — init state, checkpoint restores — must come
+    through here first.  Shardings and committed-ness are preserved
+    (jnp.copy of a committed/sharded leaf stays put).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    return jax.tree_util.tree_map(jnp.copy, tree)
 
 
 def corpus_windows(src: np.ndarray, batch: int, seq: int, seed: int):
@@ -236,6 +258,9 @@ def train(
     init_from: Optional[str] = None,
     tokenizer: Optional[str] = None,
     opt_name: str = "adamw",
+    steps_per_call: int = 1,
+    overlap: int = 1,
+    log_every: int = 1,
 ):
     """Run the loop; returns (final_step, last_loss).
 
@@ -247,11 +272,44 @@ def train(
     ``model``: "labformer" (byte LM, the default) or "labvision" (CNN on
     the synthetic lab3 color-class task) — both share the checkpoint/
     resume, fail-fast, sanitize and tracing machinery below.
+
+    Device-resident loop knobs (the training analog of the paged
+    engine's fused tick + async window, tpulab/models/paged.py):
+
+    * ``steps_per_call > 1`` dispatches K optimizer steps as ONE jitted
+      program (``lax.scan`` over a stacked ``(K, batch, seq+1)`` token
+      block, per-step losses out).  Checkpoint/eval/fault boundaries
+      and the tail force K=1 remainder calls, so step accounting, eval
+      cadence and resume replay stay bit-identical to the K=1 loop.
+    * ``overlap`` (0 or 1) keeps that many dispatched blocks in flight:
+      the host builds (and uploads) the NEXT block while the device
+      runs the current one, and loss finiteness/logging happens one
+      block late from the drained queue.  Boundaries (eval, save, end,
+      rollback) force a full drain, so a late non-finite loss rolls
+      back through ``--recover`` exactly like the synchronous loop.
+    * ``log_every`` emits ``[train]`` lines every N steps (every step's
+      loss is still finiteness-checked); the delayed drain preserves
+      exact step/loss pairing in the emitted lines.
     """
     import jax
 
     if sanitize:
         jax.config.update("jax_debug_nans", True)
+    if steps_per_call < 1:
+        raise ValueError(f"steps_per_call must be >= 1, got {steps_per_call}")
+    if steps_per_call > 1 and model != "labformer":
+        raise ValueError(
+            "steps_per_call > 1 scans stacked token blocks — only the "
+            "labformer trainer fuses multi-step dispatches"
+        )
+    if log_every < 1:
+        raise ValueError(f"log_every must be >= 1, got {log_every}")
+    if overlap < 0:
+        raise ValueError(f"overlap must be >= 0, got {overlap}")
+    # jax_debug_nans re-runs the offending jit un-jitted on the ORIGINAL
+    # inputs — donated buffers would already be deleted, so sanitize
+    # runs keep the undonated (copying) step
+    donate = not sanitize
 
     # refuse rather than silently no-op: a user asking for ZeRO-1 is
     # counting on the optimizer-memory shard — running replicated and
@@ -315,7 +373,7 @@ def train(
         cfg = cfg or LabvisionConfig()
         mesh = make_mesh({"dp": mesh_devices}) if mesh_devices else None
         params, opt_state, vstep = vision_train_state(
-            cfg, mesh, seed=seed, optimizer=optimizer
+            cfg, mesh, seed=seed, optimizer=optimizer, donate=donate
         )
 
         def batch_at(step: int):
@@ -338,12 +396,19 @@ def train(
         def eval_loss(params, step: int = 0):
             import jax.numpy as jnp
 
-            tot = 0.0
-            for j in range(eval_batches):
-                rng = np.random.default_rng((seed << 21) ^ (7919 + j))
-                imgs, labels = synth_batch(cfg, batch, rng)
-                tot += float(_eval_fn(params, jnp.asarray(imgs), jnp.asarray(labels), cfg))
-            return tot / eval_batches
+            # dispatch every val batch, then fetch ONCE: the device
+            # pipelines the eval programs instead of blocking on a
+            # float() per batch.  The host sum runs in the same order
+            # over the same f32 values — reported val_loss bit-identical
+            losses = [
+                _eval_fn(params, jnp.asarray(imgs), jnp.asarray(labels), cfg)
+                for imgs, labels in (
+                    synth_batch(cfg, batch,
+                                np.random.default_rng((seed << 21) ^ (7919 + j)))
+                    for j in range(eval_batches)
+                )
+            ]
+            return sum(float(v) for v in jax.device_get(losses)) / eval_batches
     elif model == "labformer":
         from tpulab.models.labformer import LabformerConfig, init_train_state
 
@@ -411,7 +476,7 @@ def train(
                 mesh = make_mesh(n_devices=mesh_devices, axes=axes)
         params, opt_state, train_step = init_train_state(
             cfg, mesh, seed=seed, optimizer=optimizer, accum=accum,
-            zero1=zero1, zero2=zero2,
+            zero1=zero1, zero2=zero2, donate=donate,
         )
         if init_from:
             params = _warm_start(params, cfg, init_from)
@@ -463,12 +528,15 @@ def train(
 
             def eval_loss(params, step: int = 0):
                 n_eval = step // eval_every if eval_every else 0
-                return sum(
-                    float(_eval_fn(params,
-                                   val_at(n_eval * eval_batches + j),
-                                   cfg, mesh))
+                # dispatch all windows, fetch once (same float sum order
+                # -> bit-identical val_loss; see the labvision variant)
+                losses = [
+                    _eval_fn(params, val_at(n_eval * eval_batches + j),
+                             cfg, mesh)
                     for j in range(eval_batches)
-                ) / eval_batches
+                ]
+                return sum(float(v)
+                           for v in jax.device_get(losses)) / eval_batches
         elif data_dir:
             # validation from the SAME corpus, different sampling seed:
             # fresh random windows the training stream almost surely
@@ -487,9 +555,14 @@ def train(
                     data_dir, batch=batch, row_tokens=seq + 1,
                     seed=seed + 104729, start_step=n_eval * eval_batches,
                 ) as val:
-                    out = sum(
-                        float(_eval_fn(params, val.next(), cfg, mesh))
+                    # dispatch all windows, fetch once (bit-identical
+                    # float sum; the loader's IO overlaps the device)
+                    losses = [
+                        _eval_fn(params, val.next(), cfg, mesh)
                         for _ in range(eval_batches)
+                    ]
+                    out = sum(
+                        float(v) for v in jax.device_get(losses)
                     ) / eval_batches
                     if val.short_reads():
                         log(f"[eval] WARNING: {val.short_reads()} val rows "
@@ -500,10 +573,11 @@ def train(
             val_at = batches(cfg.vocab, batch, seq, seed + 104729)
 
             def eval_loss(params, step: int = 0):
-                return sum(
-                    float(_eval_fn(params, val_at(j), cfg, mesh))
-                    for j in range(eval_batches)
-                ) / eval_batches
+                # dispatch all, fetch once (bit-identical float sum)
+                losses = [_eval_fn(params, val_at(j), cfg, mesh)
+                          for j in range(eval_batches)]
+                return sum(float(v)
+                           for v in jax.device_get(losses)) / eval_batches
     else:
         raise ValueError(f"unknown model {model!r}")
 
@@ -591,61 +665,169 @@ def train(
     loss = float("nan")
     fired_faults: set = set()
     recoveries = 0
+    # device-resident loop state: dispatched-but-undrained blocks plus
+    # the counters the final "[train] counters" line reports — the
+    # training analog of the paged engine's stats()
+    pending: deque = deque()  # (first_step, k, device_losses, ms_per_step)
+    counters = {"dispatches": 0, "fused_calls": 0, "host_syncs": 0}
+    if donate:
+        # materialize the state trees as device-OWNED buffers ONCE: the
+        # donated step aliases them in place forever after.  Host numpy
+        # leaves would ride an implicit h2d on the first call (breaking
+        # the steady-state zero-upload contract) — and a zero-copy
+        # device_put view must never be donated (see device_resident)
+        params = device_resident(params)
+        opt_state = device_resident(opt_state)
+    # the batch upload is the loop's ONE deliberate h2d, made EXPLICIT
+    # (device_put) so a transfer guard can certify nothing else moves;
+    # mesh runs keep handing numpy to jit (GSPMD places the shards)
+    put = (jax.device_put if (model == "labformer" and mesh is None)
+           else (lambda x: x))
+
+    def _block_len(s: int) -> int:
+        """Longest fused block starting at step ``s``: capped at
+        ``steps_per_call``, never crossing an eval/save boundary (blocks
+        END there so the boundary sees exactly the per-step params),
+        never covering an unfired injected fault (fault steps run as
+        K=1 calls), never past ``steps``.  Anything shorter than a full
+        K runs as K=1 remainder calls, so the driver compiles exactly
+        TWO programs (the 1-step and the K-step)."""
+        k = min(steps_per_call, steps - s)
+        for j in range(k):
+            cur = s + j
+            if cur in inject_fault and cur not in fired_faults:
+                k = j if j else 1
+                break
+            if j < k - 1 and (
+                (eval_every and (cur + 1) % eval_every == 0)
+                or (manager is not None and (cur + 1) % save_every == 0)
+            ):
+                k = j + 1
+                break
+        return k if k == steps_per_call else 1
+
+    def _drain_oldest():
+        """Fetch (EXPLICIT device_get — the loop's only d2h) and check
+        the oldest in-flight block one block late: every per-step loss
+        is finiteness-checked, ``loss`` advances, and the delayed
+        [train] lines keep exact step/loss pairing.  Returns the
+        rollback step when a non-finite loss can recover, raises when
+        it cannot."""
+        nonlocal loss, recoveries
+        s0, k, ldev, t0 = pending.popleft()
+        vals = np.atleast_1d(np.asarray(jax.device_get(ldev)))
+        # dispatch -> drained wall time: covers device execution (the
+        # fetch above completes it), so the logged per-step ms keeps
+        # the old loop's meaning; under overlap it also absorbs the
+        # next block's host build, which ran concurrently
+        ms = (time.perf_counter() - t0) * 1e3 / k
+        for j in range(k):
+            s = s0 + j
+            lv = float(vals[j])
+            if s in inject_fault and s not in fired_faults:
+                # fault injection (SURVEY.md section 5.3 names this as
+                # the aux capability the reference lacks): fake a
+                # transient non-finite loss ONCE per listed step — a
+                # replayed step after rollback sees the real loss,
+                # modeling a hardware transient rather than a
+                # deterministic data poison
+                fired_faults.add(s)
+                log(f"[fault] injected non-finite loss at step {s}")
+                lv = float("nan")
+            if not np.isfinite(lv):
+                can_recover = (
+                    recover > 0 and recoveries < recover
+                    and manager is not None
+                    and manager.latest_step() is not None
+                )
+                if not can_recover:
+                    # fail fast — the CSC-macro analog
+                    raise FloatingPointError(
+                        f"non-finite loss {lv} at step {s}")
+                recoveries += 1
+                manager.wait_until_finished()  # an in-flight async save
+                rollback = manager.latest_step()
+                log(f"[recover] non-finite loss at step {s}: "
+                    f"rolling back to snapshot {rollback} "
+                    f"({recoveries}/{recover})")
+                return rollback
+            loss = lv
+            if s % log_every == 0:
+                log(f"[train] step {s} loss {lv:.4f} ({ms:.1f} ms)")
+        return None
+
     try:
         with maybe_trace(trace_dir):
             step = start_step
             while step < steps:
-                data = batch_at(step)
                 t0 = time.perf_counter()
-                params, opt_state, loss = do_step(params, opt_state, data)
-                loss = float(loss)
-                dt = (time.perf_counter() - t0) * 1e3
-                if step in inject_fault and step not in fired_faults:
-                    # fault injection (SURVEY.md section 5.3 names this
-                    # as the aux capability the reference lacks): fake a
-                    # transient non-finite loss ONCE per listed step — a
-                    # replayed step after rollback sees the real loss,
-                    # modeling a hardware transient rather than a
-                    # deterministic data poison
-                    fired_faults.add(step)
-                    log(f"[fault] injected non-finite loss at step {step}")
-                    loss = float("nan")
-                if not np.isfinite(loss):
-                    can_recover = (
-                        recover > 0 and recoveries < recover
-                        and manager is not None
-                        and manager.latest_step() is not None
-                    )
-                    if not can_recover:
-                        # fail fast — the CSC-macro analog
-                        raise FloatingPointError(
-                            f"non-finite loss {loss} at step {step}")
-                    recoveries += 1
-                    manager.wait_until_finished()  # an in-flight async save
-                    rollback = manager.latest_step()
-                    log(f"[recover] non-finite loss at step {step}: "
-                        f"rolling back to snapshot {rollback} "
-                        f"({recoveries}/{recover})")
+                k = _block_len(step)
+                if k == 1:
+                    data = put(batch_at(step))
+                    params, opt_state, ldev = do_step(params, opt_state, data)
+                else:
+                    block = put(np.stack(
+                        [batch_at(step + j) for j in range(k)]))
+                    params, opt_state, ldev = do_step.step_k(
+                        params, opt_state, block)
+                    counters["fused_calls"] += 1
+                counters["dispatches"] += 1
+                pending.append((step, k, ldev, t0))
+                step += k
+                at_eval = bool(eval_every and step % eval_every == 0)
+                at_save = bool(manager is not None
+                               and step % save_every == 0)
+                barrier = at_eval or at_save or step >= steps
+                if barrier and overlap and pending:
+                    counters["host_syncs"] += 1  # window closed early
+                rollback = None
+                while pending and (barrier or len(pending) > overlap):
+                    rollback = _drain_oldest()
+                    if rollback is not None:
+                        break
+                if rollback is not None:
+                    # discard every in-flight block past the fault (at
+                    # most `overlap` of them) and replay from the
+                    # snapshot — late NaN detection rolls back exactly
+                    # like the synchronous loop because the restore is
+                    # total
+                    pending.clear()
                     params, opt_state = _restore_latest(
                         manager, rollback, params, opt_state)
+                    if donate:
+                        # restored leaves ride jnp.asarray/device_put of
+                        # host copies — re-materialize before the next
+                        # donating dispatch (see device_resident)
+                        params = device_resident(params)
+                        opt_state = device_resident(opt_state)
                     step = rollback
+                    if "l" in _box:
+                        # the native stream's cursor is strictly
+                        # sequential: reopen at the rollback step so the
+                        # replay consumes the SAME windows
+                        _box.pop("l").close()
                     continue
-                log(f"[train] step {step} loss {loss:.4f} ({dt:.1f} ms)")
-                if eval_every and (step + 1) % eval_every == 0:
-                    val = eval_loss(params, step)
-                    log(f"[eval] step {step} val_loss {val:.4f}")
-                if manager and (step + 1) % save_every == 0:
+                if at_eval:
+                    val = eval_loss(params, step - 1)
+                    log(f"[eval] step {step - 1} val_loss {val:.4f}")
+                if at_save:
                     import orbax.checkpoint as ocp
 
                     manager.save(
-                        step + 1,
+                        step,
                         args=ocp.args.Composite(
                             state=ocp.args.StandardSave(
                                 {"params": params, "opt_state": opt_state}
                             )
                         ),
                     )
-                step += 1
+                    if donate:
+                        # donation makes waiting mandatory: the very
+                        # next dispatch aliases these buffers in place,
+                        # and an async serializer still reading them
+                        # would see the overwrite.  Undonated runs
+                        # (--sanitize) keep the old async-save overlap.
+                        manager.wait_until_finished()
     finally:
         for _ld in _box.values():
             # IO failures during streaming degrade rows to token 0; the
@@ -659,6 +841,11 @@ def train(
                 log(f"[train] WARNING: {n_short} rows zero-padded by "
                     f"short reads (IO errors) during streaming")
             _ld.close()
+    if counters["dispatches"]:
+        log(f"[train] counters dispatches={counters['dispatches']} "
+            f"fused_calls={counters['fused_calls']} "
+            f"host_syncs={counters['host_syncs']} "
+            f"steps_per_call={steps_per_call} overlap={overlap}")
     if manager:
         manager.wait_until_finished()
         manager.close()
@@ -745,6 +932,20 @@ def main(argv=None) -> int:
                     help="BPE tokenizer (tpulab tokenizer train ...): "
                          "model vocab = merge table, batches sample the "
                          "encoded --data-dir corpus")
+    ap.add_argument("--steps-per-call", type=int, default=1, metavar="K",
+                    help="fuse K optimizer steps into ONE jitted dispatch "
+                         "(lax.scan over a stacked (K,batch,seq+1) token "
+                         "block; checkpoint/eval/fault boundaries force "
+                         "K=1 remainder calls, so accounting and resume "
+                         "replay stay bit-identical)")
+    ap.add_argument("--overlap", type=int, default=1, choices=(0, 1),
+                    help="dispatched blocks kept in flight: 1 (default) "
+                         "builds+uploads the next batch while the device "
+                         "runs the current one (loss checked one block "
+                         "late); 0 restores the synchronous drain")
+    ap.add_argument("--log-every", type=int, default=1, metavar="N",
+                    help="emit [train] lines every N steps (every loss "
+                         "is still finiteness-checked; pairing exact)")
     args = ap.parse_args(argv)
     step, loss = train(
         model=args.model,
@@ -780,6 +981,9 @@ def main(argv=None) -> int:
         init_from=args.init_from,
         tokenizer=args.tokenizer,
         opt_name=args.optimizer,
+        steps_per_call=args.steps_per_call,
+        overlap=args.overlap,
+        log_every=args.log_every,
     )
     print(json.dumps({"final_step": step, "loss": loss}))
     return 0

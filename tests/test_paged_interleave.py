@@ -1,0 +1,391 @@
+"""Stall-free admission: interleaved chunked prefill
+(tpulab.models.paged, ``PagedEngine(interleave=...)``).
+
+Headline properties:
+  * greedy output is BIT-IDENTICAL with interleaved admission on vs
+    off, for chunked (``prefill_chunk=16``) and whole-tail/dense
+    (``prefill_chunk=0``) admission, across prefix-hit, sampled,
+    penalized, stop-byte, and speculative-lookup requests — only the
+    tick a request's first token appears on moves;
+  * ZERO stalls: while one slot's multi-chunk prefill is in flight,
+    every decoding slot emits a token on every engine tick
+    (``stall_ticks == 0``; the synchronous path charges its inline
+    chunk loop), and admission never drains the async overlap window
+    (``host_syncs == 0``);
+  * ``ticks == tokens`` still holds for decoding slots — prefilling
+    slots consume no decode dispatch;
+  * the steady-state transfer-guard zero-upload window still passes
+    after an interleaved admission (h2d settles back to flat);
+  * cancel-mid-prefill releases the admitted blocks exactly, without
+    emitting, and without perturbing the other slots' streams;
+  * the dense-prefill compile-bucket census warns once past 4 buckets.
+"""
+
+import numpy as np
+import pytest
+
+import tpulab.models.paged as paged_mod
+from tpulab.models.generate import generate
+from tpulab.models.labformer import LabformerConfig
+from tpulab.models.paged import PagedEngine
+
+CFG = LabformerConfig(d_model=32, n_heads=4, n_layers=2, d_ff=64, max_seq=128)
+
+
+@pytest.fixture(scope="module")
+def trained(trained_small, trained_small_cfg):
+    assert CFG == trained_small_cfg  # shared-model drift fails loudly
+    return trained_small
+
+
+def _cycle_prompt(p):
+    return (np.arange(p) % 7).astype(np.int32)
+
+
+REP = np.tile(np.arange(7, dtype=np.int32), 4)  # lookup-friendly period-7
+SYS = (np.arange(16) % 7).astype(np.int32)      # 2 full blocks at BS=8
+
+
+@pytest.mark.parametrize("chunk", [16, 0])
+def test_bit_equality_interleave_on_off(trained, chunk):
+    """The satellite matrix: interleave on/off x chunk {16, 0} over
+    prefix-hit, sampled, penalized, stop-byte, and spec-lookup
+    requests — every request's stream bit-equal across modes, and the
+    deterministic ones equal the dense ``generate`` goldens."""
+    ref = generate(trained, _cycle_prompt(4)[None, :], CFG, steps=10,
+                   temperature=0.0)[0].tolist()
+    stop = ref[3]
+    jobs = [
+        dict(prompt=np.concatenate([SYS, [1, 2]]).astype(np.int32),
+             max_new=10),                                # prefix miss, long
+        dict(prompt=np.concatenate([SYS, [3]]).astype(np.int32),
+             max_new=8),                                 # prefix HIT
+        dict(prompt=_cycle_prompt(40), max_new=8),       # multi-chunk
+        dict(prompt=_cycle_prompt(5), max_new=10,
+             temperature=1.5, seed=3),                   # sampled slot
+        dict(prompt=_cycle_prompt(4), max_new=10,
+             stop_byte=int(stop)),                       # stop byte
+        dict(prompt=_cycle_prompt(6), max_new=8,
+             repetition_penalty=4.0),                    # penalized
+        dict(prompt=REP, max_new=12, spec="lookup"),     # speculative
+    ]
+
+    def run(interleave):
+        eng = PagedEngine(trained, CFG, slots=3, n_blocks=48, block_size=8,
+                          max_seq=64, prefill_chunk=chunk, spec_k=4,
+                          interleave=interleave)
+        rids = [eng.submit(j["prompt"], max_new=j["max_new"],
+                           temperature=j.get("temperature", 0.0),
+                           seed=j.get("seed", 0),
+                           repetition_penalty=j.get(
+                               "repetition_penalty", 1.0),
+                           stop_byte=j.get("stop_byte", -1),
+                           spec=j.get("spec", "off"))
+                for j in jobs]
+        out = eng.run()
+        return [out[r] for r in rids], eng.stats()
+
+    on, st_on = run(True)
+    off, st_off = run(False)
+    for i, (a, b) in enumerate(zip(on, off)):
+        assert np.array_equal(a, b), (i, a, b)
+    # deterministic goldens (the dense path never saw a paged pool)
+    assert np.array_equal(on[2], generate(
+        trained, _cycle_prompt(40)[None, :], CFG, steps=8,
+        temperature=0.0)[0])
+    assert np.array_equal(on[6], generate(
+        trained, REP[None, :], CFG, steps=12, temperature=0.0)[0])
+    assert st_on["stall_ticks"] == 0, st_on
+    assert st_on["prefix_hits"] >= 1 and st_on["spec_rounds"] > 0
+    assert st_on["admissions"] == st_off["admissions"] == len(jobs)
+
+
+def test_zero_stall_twelve_chunk_admission(trained):
+    """ISSUE acceptance: while a 12-chunk prompt admits against 3
+    decoding slots, every decoding slot emits a token on EVERY engine
+    tick (stall_ticks == 0, one chunk rides each tick) and admission
+    never drains the overlap window.  The synchronous path, by
+    contrast, charges its 12 serialized inline chunks."""
+    prompt96 = _cycle_prompt(97)  # 96 prefill positions = 12 chunks of 8
+
+    def run(interleave):
+        eng = PagedEngine(trained, CFG, slots=4, n_blocks=48, block_size=8,
+                          max_seq=128, prefill_chunk=8,
+                          interleave=interleave)
+        decs = [eng.submit(_cycle_prompt(4 + i), max_new=40)
+                for i in range(3)]
+        for _ in range(6):
+            eng.step()  # decoders mid-wave; window open
+        eng.submit(prompt96, max_new=4)
+        pre = {r: None for r in decs}
+        st0 = eng.stats()
+        # drive until the long prompt's prefill completes
+        steps = 0
+        while eng.stats()["prefill_inflight"] == 0:
+            eng.step()  # admission happens on the next step
+            steps += 1
+            assert steps < 4, "long prompt never entered prefill"
+        reqs = {r.req_id: r for r in eng.active if r is not None}
+        base = {rid: len(reqs[rid].out) for rid in decs}
+        t_base = eng.stats()["ticks"]
+        while eng.stats()["prefill_inflight"]:
+            eng.step()
+        st = eng.stats()
+        ticks_elapsed = st["ticks"] - t_base
+        assert ticks_elapsed >= 11  # 12 chunks, one per tick
+        for rid in decs:
+            # every tick emitted for every decoding slot (the one-tick
+            # overlap window may hold the newest token in flight)
+            got = len(reqs[rid].out)
+            assert got - base[rid] >= ticks_elapsed - 1, (
+                rid, got, base[rid], ticks_elapsed)
+        assert st["stall_ticks"] == 0, st
+        assert st["host_syncs"] == st0["host_syncs"], st  # no admission drain
+        out = eng.run()
+        return out, eng.stats()
+
+    out_on, st_on = run(True)
+    assert st_on["stall_ticks"] == 0, st_on
+    # the synchronous engine charges the inline chunk loop
+    eng = PagedEngine(trained, CFG, slots=4, n_blocks=48, block_size=8,
+                      max_seq=128, prefill_chunk=8, interleave=False)
+    for i in range(3):
+        eng.submit(_cycle_prompt(4 + i), max_new=40)
+    for _ in range(6):
+        eng.step()
+    eng.submit(prompt96, max_new=4)
+    eng.run()
+    assert eng.stats()["stall_ticks"] >= 11, eng.stats()
+    # and the long request's stream is identical in both modes
+    want = generate(trained, prompt96[None, :], CFG, steps=4,
+                    temperature=0.0)[0]
+    long_on = [v for v in out_on.values() if len(v) == 4]
+    assert any(np.array_equal(v, want) for v in long_on)
+
+
+def test_ticks_equal_tokens_excluding_prefill(trained):
+    """Counter economy under interleave: a solo request spends exactly
+    max_new decode ticks regardless of how many prefill chunks its
+    admission needed — prefill chunks are counted separately and never
+    consume a decode dispatch."""
+    eng = PagedEngine(trained, CFG, slots=1, n_blocks=16, block_size=8,
+                      max_seq=128, prefill_chunk=8)
+    rid = eng.submit(_cycle_prompt(40), max_new=10)  # 5 prefill chunks
+    out = eng.run()
+    st = eng.stats()
+    assert len(out[rid]) == 10
+    assert st["ticks"] == 10, st          # decode dispatches == tokens
+    assert st["tokens_out"] == 10
+    assert st["prefill_chunks"] == 5, st  # 39 positions in windows of 8
+    assert st["stall_ticks"] == 0, st     # no decoder was waiting
+
+
+def test_transfer_guard_window_after_interleaved_admission(trained):
+    """The PR-2 zero-upload contract survives: admission ticks upload
+    (chunks + activation scatter), but once the admitted slot is
+    decoding the steady-state window is flat again — enforced with
+    jax.transfer_guard, the jnp.asarray tripwire, and h2d_ticks."""
+    import jax
+
+    from tests.test_paged_overlap import _NoUpload
+
+    eng = PagedEngine(trained, CFG, slots=2, n_blocks=32, block_size=8,
+                      max_seq=128, prefill_chunk=8)
+    a = eng.submit(_cycle_prompt(4), max_new=30)
+    for _ in range(4):
+        eng.step()
+    b = eng.submit(_cycle_prompt(40), max_new=20)  # interleaved admission
+    while (eng.pending or eng.stats()["prefill_inflight"]
+           or any(r is not None and not r.out for r in eng.active)):
+        eng.step()  # admission window: h2d ticks expected here
+    before = eng.stats()
+    jnp_real = paged_mod.jnp
+    paged_mod.jnp = _NoUpload()
+    try:
+        with jax.transfer_guard("disallow"):
+            for _ in range(6):
+                eng.step()
+    finally:
+        paged_mod.jnp = jnp_real
+    st = eng.stats()
+    assert st["ticks"] == before["ticks"] + 6
+    assert st["h2d_ticks"] == before["h2d_ticks"], "steady tick uploaded"
+    assert st["host_syncs"] == before["host_syncs"], "steady tick synced"
+    out = eng.run()
+    assert np.array_equal(out[a], generate(
+        trained, _cycle_prompt(4)[None, :], CFG, steps=30,
+        temperature=0.0)[0])
+    assert np.array_equal(out[b], generate(
+        trained, _cycle_prompt(40)[None, :], CFG, steps=20,
+        temperature=0.0)[0])
+
+
+def test_cancel_mid_prefill_releases_blocks_exactly(trained):
+    """A request cancelled while its interleaved prefill is still in
+    flight releases every block admission claimed, emits nothing, and
+    leaves the neighbouring stream bit-identical."""
+    eng = PagedEngine(trained, CFG, slots=2, n_blocks=32, block_size=8,
+                      max_seq=128, prefill_chunk=8)
+    a = eng.submit(_cycle_prompt(5), max_new=20)
+    for _ in range(3):
+        eng.step()
+    free_mid = len(eng.free)
+    victim = eng.submit(_cycle_prompt(80), max_new=8)  # 10 chunks
+    for _ in range(3):
+        eng.step()  # admit + a few chunks
+    assert eng.stats()["prefill_inflight"] == 1
+    assert len(eng.free) < free_mid          # its blocks are claimed
+    assert eng.cancel(victim) == "active"
+    out = eng.run()
+    assert len(out[victim]) == 0             # no token was ever produced
+    assert np.array_equal(out[a], generate(
+        trained, _cycle_prompt(5)[None, :], CFG, steps=20,
+        temperature=0.0)[0])
+    # every non-cache block returned (request a finished too)
+    cached = sum(len(b) for b in eng.prefix_cache.values())
+    assert len(eng.free) == eng.n_usable_blocks - cached
+    assert int(eng.block_refs.sum()) == cached
+
+
+def test_prefix_registers_only_after_prefill_completes(trained):
+    """A same-prefix request submitted while the first is still
+    prefilling must MISS (sharing half-written blocks would attend
+    garbage) — and still decode correctly; once the first completes,
+    later requests hit."""
+    long_sys = _cycle_prompt(64)
+
+    def tail_prompt(t):
+        return np.concatenate([long_sys, [t]]).astype(np.int32)
+
+    eng = PagedEngine(trained, CFG, slots=2, n_blocks=48, block_size=8,
+                      max_seq=128, prefill_chunk=8)
+    r1 = eng.submit(tail_prompt(1), max_new=4)
+    eng.step()  # admit r1; prefill begins
+    assert eng.stats()["prefill_inflight"] == 1
+    r2 = eng.submit(tail_prompt(2), max_new=4)
+    for _ in range(2):
+        eng.step()  # r2 admits while r1 still owes chunks
+    out = eng.run()
+    st = eng.stats()
+    assert st["prefix_misses"] == 2, st  # no half-written share
+    for rid, t in ((r1, 1), (r2, 2)):
+        assert np.array_equal(out[rid], generate(
+            trained, tail_prompt(t)[None, :], CFG, steps=4,
+            temperature=0.0)[0]), rid
+    r3 = eng.submit(tail_prompt(3), max_new=4)
+    out3 = eng.run()
+    assert eng.stats()["prefix_hits"] == 1  # registered at completion
+    assert np.array_equal(out3[r3], generate(
+        trained, tail_prompt(3)[None, :], CFG, steps=4,
+        temperature=0.0)[0])
+
+
+def test_spec_draft_prefill_chunk_scheduled(trained):
+    """Dense-draft speculative slots chunk-schedule the DRAFT prefill
+    too: one draft-cache window per tick next to the target chunk, and
+    the stream stays lossless (bit-equal to plain greedy) while a
+    neighbour decodes."""
+    from tpulab.models.quant import quantize_decode_params
+
+    draft = quantize_decode_params(trained, CFG)
+
+    def run(interleave):
+        eng = PagedEngine(trained, CFG, slots=2, n_blocks=32, block_size=8,
+                          max_seq=128, prefill_chunk=8, spec_k=4,
+                          interleave=interleave)
+        eng.set_draft(draft, CFG)
+        a = eng.submit(_cycle_prompt(5), max_new=16)
+        for _ in range(3):
+            eng.step()
+        b = eng.submit(REP, max_new=12, spec="draft")
+        out = eng.run()
+        return out[a], out[b], eng.stats()
+
+    a_on, b_on, st_on = run(True)
+    a_off, b_off, _ = run(False)
+    assert np.array_equal(a_on, a_off)
+    assert np.array_equal(b_on, b_off)
+    assert np.array_equal(b_on, generate(
+        trained, REP[None, :], CFG, steps=12, temperature=0.0)[0])
+    assert st_on["stall_ticks"] == 0, st_on
+    # the draft windows were chunk-scheduled (target 3 chunks + draft
+    # 4 windows for the 27-position REP prompt, plus slot a's chunks)
+    assert st_on["spec_rounds"] > 0
+
+
+def test_dense_bucket_census_warns_past_four(trained):
+    """Satellite: chunk-0 engines warn ONCE when the dense prefill has
+    compiled more than 4 prompt-length buckets (the bound chunked
+    prefill exists to enforce)."""
+    rng = np.random.default_rng(5)
+
+    def fresh_prompt(p):  # no shared block-aligned prefixes: every
+        return rng.integers(0, 7, (p,)).astype(np.int32)  # admission is
+        # a genuine dense prefill, not a prefix-cache hit
+
+    eng = PagedEngine(trained, CFG, slots=1, n_blocks=40, block_size=8,
+                      max_seq=256, prefill_chunk=0)
+    lengths = (3, 18, 34, 66)  # buckets 16, 32, 64, 128
+    for p in lengths:
+        eng.submit(fresh_prompt(p), max_new=1)
+    eng.run()
+    assert not eng._dense_warned
+    with pytest.warns(RuntimeWarning, match="prompt-length buckets"):
+        eng.submit(fresh_prompt(10), max_new=1)   # bucket 16 is cached
+        eng.submit(fresh_prompt(130), max_new=1)  # bucket 256: the 5th
+        eng.run()
+    assert eng._dense_warned
+    # chunked engines never grow the census
+    eng2 = PagedEngine(trained, CFG, slots=1, n_blocks=16, block_size=8,
+                       max_seq=128, prefill_chunk=16)
+    for p in lengths:
+        eng2.submit(fresh_prompt(p), max_new=1)
+    eng2.run()
+    assert not eng2._dense_buckets
+
+
+def test_daemon_defaults_to_chunked_interleaved_engine():
+    """The daemon's serving default IS the stall-free path: engines
+    build with the module-wide PREFILL_CHUNK window and interleave on
+    (chunk 0 stays reachable per-request via config)."""
+    from tpulab import daemon
+
+    assert daemon.PREFILL_CHUNK > 0
+    # the argparse surface accepts the satellite's knob
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--prefill-chunk", type=int,
+                    default=daemon.PREFILL_CHUNK)
+    assert ap.parse_args([]).prefill_chunk == daemon.PREFILL_CHUNK
+
+
+def test_service_streams_through_interleaved_admission(trained):
+    """The daemon's generate service over an interleaved engine: a
+    long-prompt request admitted mid-wave streams every token exactly
+    once and matches the golden — the prefill phase just delays the
+    first increment."""
+    from tpulab.daemon import _GenerateService
+
+    svc = _GenerateService()
+    eng = PagedEngine(trained, CFG, slots=2, n_blocks=32, block_size=8,
+                      max_seq=128, prefill_chunk=8)
+    import threading
+
+    bg_out = {}
+    bg = threading.Thread(
+        target=lambda: bg_out.setdefault(
+            "a", svc.generate(eng, _cycle_prompt(4), 24)))
+    bg.start()
+    chunks = []
+    out = svc.generate(eng, _cycle_prompt(40), 8,
+                       on_progress=lambda inc: chunks.append(list(inc)))
+    bg.join()
+    want = generate(trained, _cycle_prompt(40)[None, :], CFG, steps=8,
+                    temperature=0.0)[0]
+    assert np.array_equal(out, want)
+    assert [t for c in chunks for t in c] == list(want)
+    assert np.array_equal(bg_out["a"], generate(
+        trained, _cycle_prompt(4)[None, :], CFG, steps=24,
+        temperature=0.0)[0])
+    assert eng.inflight_depth == 0

@@ -127,6 +127,16 @@ _SERVE_MAX_SEQ = 512
 #: host-orchestrated loop behind a global lock
 _SPEC_K = 4
 
+#: default prefill window for the daemon's engines: chunked prefill is
+#: the DEFAULT serving path (bounded compile buckets — one paged_extend
+#: program instead of a dense O(bucket^2) program per prompt-length
+#: bucket — and interleaved admission advances one such window per
+#: engine tick, so decoding slots never head-of-line-block behind a
+#: long prompt).  Per-request override via config {"prefill_chunk": N};
+#: 0 keeps the single-request dense oracle path.  ``--prefill-chunk``
+#: overrides the daemon-wide default at startup.
+PREFILL_CHUNK = 32
+
 #: serializes the remaining host-orchestrated single-stream strategy
 #: (beam search: many small dispatches; running two at once thrashes
 #: the device queue).  Speculative decoding no longer takes this lock —
@@ -166,7 +176,8 @@ class _StreamBroken(ConnectionError):
     must close without a terminal frame."""
 
 
-#: (realpath|None, attn, kv_dtype, tp) -> (loaded_step, engine, tok); LRU, max 4
+#: (realpath|None, attn, kv_dtype, tp, prefill_chunk) ->
+#: (loaded_step, engine, tok); LRU, max 4
 _ENGINES: "dict" = {}
 
 
@@ -309,9 +320,15 @@ class _GenerateService:
                         # clear INSIDE this locked region: after the
                         # lock drops, a submitter must either see the
                         # stepper alive (and it still is) or dead (and
-                        # spawn a fresh one) — never a dead flag-alive
+                        # spawn a fresh one) — never a dead flag-alive.
+                        # Capture the counters here too (cheap dict
+                        # build) — the PRINT happens outside the lock:
+                        # a blocked stdout pipe must not wedge every
+                        # submitter behind a dead-but-flag-consistent
+                        # stepper.
                         st.stepper_alive = False
-                        return
+                        row = engine.stats()
+                        break
                     for rid in engine.step():
                         out = engine._done.pop(rid)
                         if rid in st.cancelled:  # abandoned waiter
@@ -319,6 +336,20 @@ class _GenerateService:
                             continue
                         st.results[rid] = out
                     st.cond.notify_all()
+            # per-wave serving log: the interleaved-prefill counters
+            # next to the overlap ones, so stall-free admission is
+            # visible in production (cumulative engine counters, one
+            # line per wave the stepper drained)
+            print("[serve] wave done: "
+                  f"requests={row['requests_done']} "
+                  f"tokens={row['tokens_out']} "
+                  f"ticks={row['ticks']} "
+                  f"admissions={row['admissions']} "
+                  f"prefill_chunks={row['prefill_chunks']} "
+                  f"stall_ticks={row['stall_ticks']} "
+                  f"prefill_inflight={row['prefill_inflight']} "
+                  f"host_syncs={row['host_syncs']} "
+                  f"h2d_ticks={row['h2d_ticks']}", flush=True)
         except Exception as e:  # fail every request; never hang waiters
             with st.cond:
                 for req in list(engine.pending) + [
@@ -361,12 +392,13 @@ def _ckpt_stamp(ckpt_dir: str):
 
 
 def _engine_for(ckpt, attn: str = "gather", kv_dtype: str = "native",
-                tp: int = 1):
+                tp: int = 1, prefill_chunk: Optional[int] = None):
     """Warm (engine, tokenizer|None) for the demo model or a trainer
     snapshot, with the cache problems a naive dict would have handled:
-    keys are (realpath, attn, kv_dtype, tp) — ``ckpts`` and ``./ckpts``
-    alias, and engines built with different serving knobs (paged
-    kernel, int8 KV, tp mesh) never collide — a newer checkpoint step
+    keys are (realpath, attn, kv_dtype, tp, prefill_chunk) — ``ckpts``
+    and ``./ckpts`` alias, and engines built with different serving
+    knobs (paged kernel, int8 KV, tp mesh, prefill window) never
+    collide — a newer checkpoint step
     evicts the stale engine, and at most 4 engines stay resident (LRU;
     room for one checkpoint's knob variants plus a second checkpoint).
 
@@ -383,8 +415,10 @@ def _engine_for(ckpt, attn: str = "gather", kv_dtype: str = "native",
     from tpulab.models.generate import demo_config, load_params
     from tpulab.models.paged import PagedEngine
 
+    if prefill_chunk is None:
+        prefill_chunk = PREFILL_CHUNK
     path = os.path.realpath(ckpt) if ckpt else None
-    key = (path, attn, kv_dtype, tp)
+    key = (path, attn, kv_dtype, tp, prefill_chunk)
     stamp = _ckpt_stamp(path) if path else None
     with _GEN_SERVICE.lock:
         hit = _ENGINES.get(key)
@@ -409,6 +443,10 @@ def _engine_for(ckpt, attn: str = "gather", kv_dtype: str = "native",
     engine = PagedEngine(
         params, cfg, slots=4, n_blocks=128, block_size=16,
         max_seq=_SERVE_MAX_SEQ, attn=attn, kv_dtype=kv_dtype, mesh=mesh,
+        # chunked prefill by default: one bounded extend program per
+        # chunk bucket, and admission interleaves those windows with
+        # the running batch's decode ticks (stall-free admission)
+        prefill_chunk=prefill_chunk,
         # spec capability costs nothing until a speculative request
         # arrives (the verify program compiles lazily); the gather-only
         # constraint is the engine's own (no pallas verify kernel, tp
@@ -442,7 +480,10 @@ def _handle_generate(header: dict, payload: bytes,
     weights), ``temperature`` + ``seed`` (default greedy),
     ``repetition_penalty`` (HF convention; 1.0 = off), ``stop_byte``
     (finish right after emitting it; -1 = off), ``stream`` (status-2
-    chunk frames), ``attn``/``kv_dtype`` (engine knobs), and
+    chunk frames), ``attn``/``kv_dtype`` (engine knobs),
+    ``prefill_chunk`` (prefill window; default ``PREFILL_CHUNK`` —
+    chunked prefill interleaved with the running batch's decode ticks;
+    0 = the whole-prompt dense oracle path), and
     ``speculative`` + ``draft_k`` (lossless greedy speculative decode
     with a lazily-built int8 draft — same bytes as plain greedy;
     ``draft_k`` <= 4, the engine verify window), ``prompt_lookup`` +
@@ -484,6 +525,11 @@ def _handle_generate(header: dict, payload: bytes,
     tp = int(config.get("tp", 1))
     if tp < 1:
         raise ValueError(f"tp must be >= 1, got {tp}")
+    prefill_chunk = int(config.get("prefill_chunk", PREFILL_CHUNK))
+    if prefill_chunk < 0:
+        raise ValueError(
+            f"prefill_chunk must be >= 0 (0 = whole-prompt dense "
+            f"oracle path), got {prefill_chunk}")
     if tp > 1:
         # mirror the engine's own mesh-serving constraints BEFORE the
         # cold build (checkpoint restore) is paid
@@ -564,7 +610,8 @@ def _handle_generate(header: dict, payload: bytes,
         raise ValueError(
             "tp > 1 serves the engine decode path only: drop "
             "beams/speculative/prompt_lookup or tp")
-    engine, tok = _engine_for(config.get("ckpt_dir"), attn, kv_dtype, tp)
+    engine, tok = _engine_for(config.get("ckpt_dir"), attn, kv_dtype, tp,
+                              prefill_chunk)
     if tok is None:
         prompt = np.frombuffer(payload, np.uint8).astype(np.int32)
         eng_stop = stop_byte
@@ -676,7 +723,8 @@ def _handle_generate_stats(header: dict) -> bytes:
     key = (os.path.realpath(path) if path else None,
            str(config.get("attn", "gather")),
            str(config.get("kv_dtype", "native")),
-           int(config.get("tp", 1)))
+           int(config.get("tp", 1)),
+           int(config.get("prefill_chunk", PREFILL_CHUNK)))
     with _GEN_SERVICE.lock:  # registry lookup only — short-held
         hit = _ENGINES.get(key)
     # stats() reads flat counters/lengths; calling it OUTSIDE any lock
@@ -875,10 +923,18 @@ def serve(socket_path: str, *, max_requests: Optional[int] = None) -> None:
 
 
 def main(argv=None) -> int:
+    global PREFILL_CHUNK
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--socket", default=os.environ.get("TPULAB_DAEMON_SOCKET", "/tmp/tpulab.sock"))
     ap.add_argument("--max-requests", type=int, default=None, help="exit after N requests (tests)")
+    ap.add_argument("--prefill-chunk", type=int, default=PREFILL_CHUNK,
+                    help="default prefill window for the serving engines "
+                         "(chunked+interleaved admission; 0 = whole-prompt "
+                         "dense prefill, the single-request oracle path)")
     args = ap.parse_args(argv)
+    if args.prefill_chunk < 0:
+        ap.error("--prefill-chunk must be >= 0")
+    PREFILL_CHUNK = args.prefill_chunk
     serve(args.socket, max_requests=args.max_requests)
     return 0
 

@@ -53,6 +53,25 @@ for emit/stop/stream — admission and the speculative path force the
 one-tick-late stop detection safe (overshoot positions land in the
 slot's own tail blocks or TRASH and are length-masked on read).
 
+Admission is INTERLEAVED by default (``interleave=True``): a slot has a
+lifecycle phase (PREFILLING -> DECODING), and admitting a request does
+host bookkeeping only — prefix lookup, block claims/refcounts, sampling
+mirrors — while the prompt's prefill advances ONE ``paged_extend``
+chunk per engine tick through the same dispatch stream as
+``paged_tick``.  Decoding slots keep emitting a token every tick while
+another slot's multi-chunk prefill is in flight (``stall_ticks`` stays
+0), and admission no longer drains the one-tick overlap window at all:
+the only remaining admission sync is block reclamation, when the head
+request needs blocks held by a request finishing inside the window.
+The device slot stays inactive (TRASH table) until the final chunk
+lands, and every in-flight tick carries a per-slot request snapshot so
+a drain never emits a tick's token to a slot (re-)admitted after that
+tick was dispatched.  Prefix-hit slots start their chunk cursor past
+the shared region, and a speculative slot's dense-draft prefill is
+chunk-scheduled the same way (one draft-cache window per tick).
+Prefixes register in the cache only when their prefill COMPLETES, so a
+concurrent same-prefix admission can never attend half-written blocks.
+
 Prefix sharing: block-aligned prompt prefixes are cached (LRU, evicted
 under pool pressure) and their physical blocks reference-counted —
 requests repeating a system prompt share its KV blocks instead of
@@ -80,8 +99,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from tpulab.models.generate import (_attend_cached, _prefill,
-                                    apply_repetition_penalty)
+from tpulab.models.generate import (_attend_cached, _forward_window,
+                                    _prefill, apply_repetition_penalty)
 from tpulab.models.labformer import LabformerConfig, _mlp, _rmsnorm, _rope
 from tpulab.models.quant import embed_lookup, qmat, unembed
 from tpulab.models.speculative import (_draft_propose_slots, _lookup_propose,
@@ -414,6 +433,25 @@ def _scatter_prefill(kpool, vpool, k_seq, v_seq, table_row, start, p,
     return kpool, vpool
 
 
+@functools.partial(jax.jit, static_argnames=("cfg", "bucket"),
+                   donate_argnums=(2, 3))
+def _draft_extend(params, tokens, d_kc, d_vc, s, start,
+                  cfg: LabformerConfig, bucket: int):
+    """Advance ONE slot's dense draft cache by a prefill window:
+    ``tokens`` (1, bucket) at positions ``start``.. run through the
+    draft model's windowed forward (generate._forward_window — the
+    verify-window recipe), writing their K/V into slot ``s``'s cache
+    rows.  This is the draft-side chunked prefill: interior windows are
+    full, and the final window's padding garbage lands strictly past
+    the prompt frontier, where the propose scan rewrites every position
+    before any read (the invariant _draft_prefill_slot documents).
+    Caches DONATED, same discipline as the propose pass."""
+    kc_s = d_kc[:, s][:, None]          # (L, 1, C, kv, d)
+    vc_s = d_vc[:, s][:, None]
+    _, kc_s, vc_s = _forward_window(params, tokens, kc_s, vc_s, start, cfg)
+    return d_kc.at[:, s].set(kc_s[:, 0]), d_vc.at[:, s].set(vc_s[:, 0])
+
+
 def _sample_core(logits, temps, keys, penalties, seen):
     """Per-slot next token: greedy where temperature == 0, else a
     categorical draw from the slot's own PRNG stream.  Returns
@@ -545,6 +583,12 @@ class _Request:
     spec_ngram: int = 3         # lookup proposer n-gram length
     out: List[int] = field(default_factory=list)
     cancelled: bool = False     # finish at the next tick (client gone)
+    # interleaved-admission lifecycle: "prefill" while chunks are still
+    # owed (device slot inactive, no tokens yet), "decode" once live
+    phase: str = "decode"
+    pf_pos: int = 0             # next prompt position to paged_extend
+    pf_end: int = 0             # prefill frontier: len(prompt) - 1
+    d_pf_pos: int = 0           # draft-cache prefill cursor ("draft")
 
 
 class PagedEngine:
@@ -557,14 +601,23 @@ class PagedEngine:
     ``run()`` drains everything and returns {req_id: generated
     tokens}.  Greedy by default (outputs match ``generate`` greedy
     per-request); per-request temperature/seed opt into sampled
-    slots that coexist with greedy ones in the same batch."""
+    slots that coexist with greedy ones in the same batch.
+
+    ``interleave=True`` (default) makes admission STALL-FREE: a newly
+    admitted slot enters a PREFILLING phase and its prompt advances one
+    ``prefill_chunk`` window per tick while the other slots keep
+    decoding; ``interleave=False`` restores the synchronous
+    whole-prefill admission under a drained window (the bit-equality
+    oracle).  Per-request greedy streams are identical either way —
+    only the tick on which a request's FIRST token appears moves."""
 
     def __init__(self, params, cfg: LabformerConfig, *, slots: int = 4,
                  n_blocks: int = 64, block_size: int = 16,
                  max_seq: int = 256, prefill_chunk: int = 0, mesh=None,
                  attn: str = "gather", kv_dtype: str = "native",
                  spec_k: int = 0, spec_ngram: int = 3,
-                 draft_params=None, draft_cfg=None, overlap: int = 1):
+                 draft_params=None, draft_cfg=None, overlap: int = 1,
+                 interleave: bool = True):
         if max_seq % block_size:
             raise ValueError("max_seq must be a multiple of block_size")
         if prefill_chunk < 0:
@@ -682,6 +735,22 @@ class PagedEngine:
         # paged_extend instead of one whole-tail program — peak prefill
         # activation memory and compile-bucket count stay bounded
         self.prefill_chunk = prefill_chunk
+        # interleaved admission (default): prefill advances one chunk
+        # per TICK while decoding slots keep emitting; False restores
+        # the synchronous whole-prefill admission under a drain barrier
+        # (the bit-equality oracle the interleave tests compare against)
+        self.interleave = bool(interleave)
+        # dense-prefill compile-bucket census: each distinct power-of-two
+        # prompt bucket is one more compiled program — warn once past 4
+        # (prefill_chunk > 0 bounds this at the single chunk bucket)
+        self._dense_buckets: set = set()
+        self._dense_warned = False
+        # per-step stall accounting scratch (reset by step()):
+        # dispatches = prefill programs issued this step; credit = how
+        # many of them ride a decode tick by construction (1 per
+        # synchronous _prefill_slot call, 1 per interleaved window)
+        self._stall_prefill_dispatches = 0
+        self._stall_prefill_credit = 0
         self.counters = {
             "prefix_hits": 0, "prefix_misses": 0, "evictions": 0,
             "ticks": 0, "tokens_out": 0, "requests_done": 0,
@@ -699,6 +768,16 @@ class PagedEngine:
             # spec proposals, window retirement) — steady-state decode
             # keeps this flat while `ticks` climbs.
             "host_syncs": 0, "h2d_ticks": 0,
+            # interleaved-admission observability: admissions = real
+            # admits (hits + misses); prefill_chunks = prefill programs
+            # dispatched incrementally (target + draft windows);
+            # stall_ticks = tick-equivalents where >=1 decoding slot
+            # still owed tokens but prefill work dispatched without a
+            # decode dispatch riding along — 0 under interleave by
+            # construction (one chunk per slot rides each tick); the
+            # synchronous path charges its inline chunk loop, chunk
+            # count minus the one decode tick the step still runs.
+            "admissions": 0, "prefill_chunks": 0, "stall_ticks": 0,
         }
         # device-resident decode state: the authoritative per-slot
         # arrays every paged_tick donates through (the numpy fields
@@ -787,11 +866,16 @@ class PagedEngine:
         self.draft_params = jax.device_put(draft_params)  # as for params
         # dense per-slot caches: propose writes k+1 positions past any
         # committed frontier (< max_seq), and admission prefill pads to
-        # a power-of-two bucket — the cache must hold both
+        # a power-of-two bucket — the cache must hold both.  Chunked
+        # draft prefill (interleaved admission) additionally writes a
+        # full chunk bucket starting anywhere below the frontier, so
+        # the tail needs one chunk bucket of headroom or the
+        # dynamic_update_slice would CLAMP the window start and
+        # misplace real K/V over earlier positions.
         self._draft_cache_len = max(
             self.max_blocks * self.block_size + self.spec_k + 2,
             _bucket(self.max_blocks * self.block_size),
-        )
+        ) + (_bucket(self.prefill_chunk) if self.prefill_chunk else 0)
         shape = (cfg.n_layers, self.slots, self._draft_cache_len,
                  cfg.kv_heads, cfg.head_dim)
         self.d_kc = jnp.zeros(shape, cfg.dtype)
@@ -940,16 +1024,13 @@ class PagedEngine:
             # count only REAL admissions: a stalled retry re-looks-up
             # the prefix every tick and would inflate the hit rate
             self.counters["prefix_hits" if shared else "prefix_misses"] += 1
+            self.counters["admissions"] += 1
             fresh = [self.free.pop() for _ in range(need_new)]
             for b in fresh:
                 self.block_refs[b] += 1
             row = np.zeros(self.max_blocks, np.int32)
             row[:need_total] = shared + fresh
             self.tables[s] = row
-            self._prefill_slot(s, req, row, shared_pos)
-            if req.spec == "draft":
-                self._draft_prefill_slot(s, req)
-            self._register_prefix(req.prompt, row)
             self.temps[s] = req.temperature
             self.keys[s] = np.asarray(
                 jax.random.PRNGKey(req.seed), np.uint32
@@ -961,7 +1042,38 @@ class PagedEngine:
             self.seen[s] = False
             self.seen[s, req.prompt] = True
             self.active[s] = req
-            self._push_slot(s, True)
+            p = len(req.prompt) - 1
+            req.pf_end = p
+            if (self.interleave and p > shared_pos
+                    and (shared_pos > 0 or self.prefill_chunk)):
+                # incremental admission: bookkeeping is done; the
+                # prefill itself advances one paged_extend chunk per
+                # engine tick (_prefill_tick) while the other slots
+                # keep decoding.  The device slot stays INACTIVE
+                # (TRASH table) until the final chunk lands, and the
+                # prefix registers only at completion — a concurrent
+                # same-prefix admission must never attend blocks whose
+                # K/V is still being written.
+                req.phase = "prefill"
+                req.pf_pos = shared_pos
+                self.lengths[s] = 0
+                self.last_tok[s] = 0
+                if req.spec == "draft":
+                    if self.prefill_chunk:
+                        req.d_pf_pos = 0  # chunk-scheduled draft windows
+                    else:
+                        self._draft_prefill_slot(s, req)
+                        req.d_pf_pos = p
+            else:
+                # synchronous path (interleave=False, or the dense
+                # single-program / fully-shared admissions where there
+                # is nothing to spread across ticks)
+                self._prefill_slot(s, req, row, shared_pos)
+                if req.spec == "draft":
+                    self._draft_prefill_slot(s, req)
+                self._register_prefix(req.prompt, row)
+                req.phase = "decode"
+                self._push_slot(s, True)
 
     def _register_prefix(self, prompt: np.ndarray, row: np.ndarray):
         """Cache this request's full prefill blocks for future sharing
@@ -998,18 +1110,12 @@ class PagedEngine:
                 start = shared_pos
                 chunk = self.prefill_chunk or (p - shared_pos)
                 while start < p:
-                    tail = req.prompt[start:min(start + chunk, p)]
-                    bucket = _bucket(len(tail))
-                    padded = np.zeros((1, bucket), np.int32)
-                    padded[0, :len(tail)] = tail
-                    self.kpool, self.vpool = paged_extend(
-                        self.params, jnp.asarray(padded), self.kpool,
-                        self.vpool, jnp.asarray(row), start, len(tail),
-                        self.cfg, self.block_size, bucket,
-                    )
-                    start += len(tail)
+                    start = self._extend_window(s, req.prompt, start,
+                                                chunk, p)
+                self._stall_prefill_credit += 1
             else:
                 bucket = _bucket(p)
+                self._note_dense_bucket(bucket)
                 padded = np.zeros((1, bucket), np.int32)
                 padded[0, :p] = req.prompt[:-1]
                 _, kc, vc = _prefill(
@@ -1020,6 +1126,9 @@ class PagedEngine:
                     jnp.asarray(row), shared_pos, p, bucket,
                     self.block_size,
                 )
+                self.counters["prefill_chunks"] += 1
+                self._stall_prefill_dispatches += 1
+                self._stall_prefill_credit += 1
         self.lengths[s] = p
         self.last_tok[s] = req.prompt[-1]
 
@@ -1041,6 +1150,152 @@ class PagedEngine:
                                  self.draft_cfg, self._draft_cache_len)
         self.d_kc = self.d_kc.at[:, s].set(kc[:, 0])
         self.d_vc = self.d_vc.at[:, s].set(vc[:, 0])
+        # one prefill program, same accounting as the dense target
+        # branch (the stats() contract counts target + draft programs)
+        self.counters["prefill_chunks"] += 1
+        self._stall_prefill_dispatches += 1
+        self._stall_prefill_credit += 1
+
+    def _extend_window(self, s: int, prompt: np.ndarray, start: int,
+                       chunk: int, end: int) -> int:
+        """Dispatch ONE ``paged_extend`` window for slot ``s``
+        (positions ``start .. min(start + chunk, end)``) — the shared
+        chunk body of the synchronous loop and the interleaved per-tick
+        advance, so the two paths cannot drift.  Buckets by the CHUNK,
+        not the tail: a short final window must reuse the one compiled
+        extend program, not trigger a fresh XLA compile mid-wave (a
+        multi-second stall of every decoding slot — the head-of-line
+        blocking this path removes); padding rows route to TRASH via
+        ``n_valid``.  Returns the new cursor."""
+        tail = prompt[start:min(start + chunk, end)]
+        bucket = _bucket(chunk)
+        if not self.prefill_chunk:
+            # chunk-0 whole-tail windows (prefix-hit admissions on an
+            # unchunked engine) bucket by the variable tail length —
+            # one compiled extend program per distinct bucket, the same
+            # unbounded-compile concern as dense prefill: census them
+            self._note_dense_bucket(bucket)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :len(tail)] = tail
+        self.kpool, self.vpool = paged_extend(
+            self.params, jnp.asarray(padded), self.kpool, self.vpool,
+            jnp.asarray(self.tables[s]), start, len(tail),
+            self.cfg, self.block_size, bucket,
+        )
+        self.counters["prefill_chunks"] += 1
+        self._stall_prefill_dispatches += 1
+        return start + len(tail)
+
+    def _note_dense_bucket(self, bucket: int):
+        """Census of the unchunked engine's prefill compile buckets —
+        dense whole-prompt programs AND chunk-0 whole-tail extend
+        windows: every distinct power-of-two bucket is one more
+        compiled program, and a fresh compile mid-wave stalls every
+        decoding slot.  One-line warning past 4 — the serving surfaces
+        (daemon/CLI) default ``prefill_chunk`` to a fixed window
+        exactly so this set stays at one extend program."""
+        self._dense_buckets.add(bucket)
+        if len(self._dense_buckets) > 4 and not self._dense_warned:
+            self._dense_warned = True
+            import warnings
+
+            warnings.warn(
+                f"unchunked prefill has compiled "
+                f"{len(self._dense_buckets)} prompt-length buckets "
+                f"{sorted(self._dense_buckets)}; set prefill_chunk > 0 "
+                f"to bound the program count",
+                RuntimeWarning, stacklevel=3)
+
+    # ----------------------------------------------- interleaved prefill
+    def _advance_prefill(self, s: int, req: _Request):
+        """Advance one PREFILLING slot by one ``paged_extend`` chunk
+        (and, for dense-draft speculative slots, one draft-cache
+        window) — the per-tick admission work the interleaved path
+        spreads across engine ticks.  Dispatches ride the same async
+        stream as ``paged_tick``; the pools' donation chain orders them
+        after any in-flight decode tick."""
+        p = req.pf_end
+        if req.pf_pos < p:
+            chunk = self.prefill_chunk or (p - req.pf_pos)
+            req.pf_pos = self._extend_window(s, req.prompt, req.pf_pos,
+                                             chunk, p)
+            self._stall_prefill_credit += 1
+            self._h2d = True
+        if req.spec == "draft" and req.d_pf_pos < p:
+            # chunk-scheduled draft prefill (prefill_chunk > 0 by
+            # construction: the chunk-0 paths run the dense draft
+            # prefill inline at admission)
+            n = min(self.prefill_chunk, p - req.d_pf_pos)
+            bucket = _bucket(self.prefill_chunk)
+            padded = np.zeros((1, bucket), np.int32)
+            padded[0, :n] = req.prompt[req.d_pf_pos:req.d_pf_pos + n]
+            self.d_kc, self.d_vc = _draft_extend(
+                self.draft_params, jnp.asarray(padded), self.d_kc,
+                self.d_vc, s, req.d_pf_pos, self.draft_cfg, bucket,
+            )
+            req.d_pf_pos += n
+            self.counters["prefill_chunks"] += 1
+            self._stall_prefill_dispatches += 1
+            self._stall_prefill_credit += 1
+            self._h2d = True
+        if req.pf_pos >= p and (req.spec != "draft" or req.d_pf_pos >= p):
+            self._finish_prefill(s, req)
+
+    def _finish_prefill(self, s: int, req: _Request):
+        """Interleaved admission completes: commit the host mirrors the
+        synchronous path would have set at admit time, register the
+        prefix (only NOW — a concurrent same-prefix admission must not
+        share blocks whose K/V is still being written), and activate
+        the device slot.  The slot joins the NEXT dispatched tick's
+        snapshot; _push_slot reseeds its key row exactly as the
+        synchronous admission does."""
+        self.lengths[s] = req.pf_end
+        self.last_tok[s] = req.prompt[-1]
+        self._register_prefix(req.prompt, self.tables[s])
+        req.phase = "decode"
+        self._push_slot(s, True)
+
+    def _prefill_tick(self) -> List[int]:
+        """One admission tick for every PREFILLING slot: cancelled
+        requests release immediately (no tokens were produced — the
+        blocks admission claimed return in full), live ones advance one
+        chunk.  Returns req_ids finished (cancel-mid-prefill only)."""
+        finished: List[int] = []
+        for s, req in enumerate(self.active):
+            if req is None or req.phase != "prefill":
+                continue
+            if req.cancelled:
+                self._release_slot(s, req)
+                finished.append(req.req_id)
+                continue
+            self._advance_prefill(s, req)
+        return finished
+
+    def _drain_could_free(self) -> bool:
+        """Whether draining the async window is KNOWN to release
+        blocks: some decoding slot's request deterministically finishes
+        inside the in-flight ticks (budget exhausted or cancelled).
+        This is the ONE remaining admission sync — a stop-byte finish
+        is discovered at drain time and releases one tick later through
+        the normal pops, not worth a forced barrier every tick."""
+        n = len(self._inflight)
+        return any(
+            r is not None and r.phase == "decode"
+            and (r.cancelled or len(r.out) + n >= r.max_new)
+            for r in self.active)
+
+    def _count_stalls(self, decode_waiting: bool, decode_dispatched: bool):
+        """stall_ticks accounting (see counters comment): prefill
+        dispatches that did not ride a decode dispatch while >=1
+        decoding slot still owed tokens.  Interleaved windows earn one
+        credit each (they ride the tick by construction — a draft
+        slot's target + draft pair both count), a synchronous
+        _prefill_slot call earns one credit total, so the sync inline
+        loop charges its serialized chunks while interleave stays 0."""
+        if self._stall_prefill_dispatches and decode_waiting:
+            credit = self._stall_prefill_credit if decode_dispatched else 0
+            self.counters["stall_ticks"] += max(
+                0, self._stall_prefill_dispatches - credit)
 
     # ---------------------------------------------------------------- decode
     def _emit(self, s: int, req: _Request, tok: int) -> bool:
@@ -1122,10 +1377,15 @@ class PagedEngine:
         bookkeeping for it: emit / stop / release / window retirement.
         Slots whose request already finished in an earlier drained tick
         skip their (overshoot) token — the pool writes it made are
-        length-masked or in blocks release just reclaimed."""
-        nxt = jax.device_get(self._inflight.pop(0))
+        length-masked or in blocks release just reclaimed.  The tick's
+        dispatch-time snapshot additionally skips slots whose request
+        was admitted (or activated from prefill) AFTER the tick was
+        dispatched: interleaved admission no longer drains the window,
+        so a drained tick can predate the slot's current occupant."""
+        toks, snap = self._inflight.pop(0)
+        nxt = jax.device_get(toks)
         for s, req in enumerate(self.active):
-            if req is None:
+            if req is None or snap[s] is not req:
                 continue
             if self._emit(s, req, int(nxt[s])):
                 self._release_slot(s, req)
@@ -1143,26 +1403,53 @@ class PagedEngine:
             self._drain_one(finished)
 
     def _spec_wanted(self) -> bool:
+        # prefilling slots don't speculate yet: their first verify
+        # round comes the tick after _finish_prefill activates them
         return bool(self.spec_k) and any(
-            r is not None and self._spec_budget(r) > 0 for r in self.active)
+            r is not None and r.phase == "decode"
+            and self._spec_budget(r) > 0 for r in self.active)
 
     def step(self) -> List[int]:
         """One engine tick; returns req_ids finished this tick (under
         ``overlap=1`` a request finishes the tick AFTER its final token
-        was computed — the host runs one tick behind the device)."""
+        was computed — the host runs one tick behind the device).
+
+        Interleaved admission (``interleave=True``, the default):
+        admission does bookkeeping only and never drains the async
+        window — the prompt's prefill then advances one chunk per tick
+        through :meth:`_prefill_tick`, riding the same dispatch stream
+        as ``paged_tick``, so decoding slots keep emitting a token
+        every tick while another slot prefills.  The one remaining
+        admission sync is block reclamation: the head request needs
+        blocks held by a request finishing inside the window."""
         finished: List[int] = []
         self._h2d = False
-        if (self.pending and any(r is None for r in self.active)
-                and self._head_admittable()):
-            # admission needs current slot/block occupancy and rewrites
-            # slot state: the one structural sync barrier.  Gated on a
-            # FREE slot and on the head request actually FITTING (free
-            # + evictable blocks) — a backed-up queue behind fully-busy
-            # slots, or a block-starved head behind a long request,
-            # must not drain the async window every tick for an
-            # admission that cannot happen anyway.
-            self._drain_all(finished)
-            self._admit()
+        self._stall_prefill_dispatches = 0
+        self._stall_prefill_credit = 0
+        decode_dispatched = False
+        decode_waiting = any(
+            r is not None and r.phase == "decode" and not r.cancelled
+            and len(r.out) + len(self._inflight) < r.max_new
+            for r in self.active)
+        if self.pending and any(r is None for r in self.active):
+            # admission is gated on a FREE slot and on the head request
+            # actually FITTING (free + evictable blocks) — a backed-up
+            # queue behind fully-busy slots, or a block-starved head
+            # behind a long request, must not drain the async window
+            # every tick for an admission that cannot happen anyway.
+            if self._head_admittable():
+                if not self.interleave:
+                    # synchronous admission rewrites slot state under a
+                    # drained window: the pre-interleave barrier
+                    self._drain_all(finished)
+                self._admit()
+            elif (self.interleave and self._inflight
+                    and self._drain_could_free()):
+                # block reclamation: a finishing request's blocks are
+                # the head's only way in — the one admission sync left
+                self._drain_all(finished)
+                if self._head_admittable():
+                    self._admit()
         spec = self._spec_wanted()
         if spec and self._inflight:
             # the verify path is host-orchestrated (proposals +
@@ -1172,36 +1459,52 @@ class PagedEngine:
             spec = self._spec_wanted()
         if not any(r is not None for r in self.active):
             self._drain_all(finished)
+            self._count_stalls(decode_waiting, decode_dispatched)
             self._count_h2d()
             return finished
         if spec:
             finished.extend(self._step_spec())
             self._h2d = True
+            # prefill chunks ride the verify tick exactly as they ride
+            # plain decode ticks
+            finished.extend(self._prefill_tick())
+            self._count_stalls(decode_waiting, True)
             self._count_h2d()
             return finished
-        if self._inflight and all(
-            r is None or r.cancelled
-            or len(r.out) + len(self._inflight) >= r.max_new
-            for r in self.active
-        ):
-            # every active slot's final token is already in flight —
-            # drain instead of dispatching a tick whose output no
-            # request could consume (keeps `ticks` == tokens for plain
-            # greedy runs, bit-matching the synchronous loop's counter)
-            self._drain_one(finished)
-        else:
-            toks, self._dev, self.kpool, self.vpool = paged_tick(
-                self.params, self._dev, self.kpool, self.vpool,
-                self.cfg, self.block_size, self.attn,
-            )
-            self._inflight.append(toks)
-            self.counters["ticks"] += 1
-            while len(self._inflight) > self.overlap:
+        if any(r is not None and r.phase == "decode" for r in self.active):
+            if self._inflight and all(
+                r is None or r.phase != "decode" or r.cancelled
+                or len(r.out) + len(self._inflight) >= r.max_new
+                for r in self.active
+            ):
+                # every decoding slot's final token is already in
+                # flight — drain instead of dispatching a tick whose
+                # output no request could consume (keeps `ticks` ==
+                # tokens for plain greedy runs, bit-matching the
+                # synchronous loop's counter; prefilling slots are
+                # excluded — they consume no decode output)
                 self._drain_one(finished)
+            else:
+                # per-tick snapshot: which request each slot was
+                # DECODING for at dispatch — the drain must never emit
+                # this tick's token to a slot (re-)admitted afterwards
+                snap = [r if (r is not None and r.phase == "decode")
+                        else None for r in self.active]
+                toks, self._dev, self.kpool, self.vpool = paged_tick(
+                    self.params, self._dev, self.kpool, self.vpool,
+                    self.cfg, self.block_size, self.attn,
+                )
+                self._inflight.append((toks, snap))
+                self.counters["ticks"] += 1
+                decode_dispatched = True
+                while len(self._inflight) > self.overlap:
+                    self._drain_one(finished)
+        finished.extend(self._prefill_tick())
         if not any(r is not None for r in self.active):
             # the wave just ended: drain stragglers so the engine never
             # parks fetched-but-unprocessed ticks across idle periods
             self._drain_all(finished)
+        self._count_stalls(decode_waiting, decode_dispatched)
         self._count_h2d()
         return finished
 
@@ -1230,20 +1533,29 @@ class PagedEngine:
         n_draft = np.zeros(S, np.int32)
         want_draft = [s for s, r in enumerate(self.active)
                       if r is not None and r.spec == "draft"
+                      and r.phase == "decode"
                       and self._spec_budget(r) > 0]
         if want_draft:
             # ONE vmapped draft pass proposes for every slot (per-slot
             # positions, straight from the device-resident state); non-
-            # draft slots' rows are scratch proposals into scratch cache
-            # lines, simply ignored below
+            # draft slots' rows are scratch proposals into scratch
+            # cache lines, simply ignored below.  Device-INACTIVE slots
+            # (idle, or mid-interleaved-prefill) get their scratch
+            # writes routed to the cache TAIL (position max_seq, dead
+            # by the position mask): a prefilling draft slot's
+            # freshly-extended cache rows must not be clobbered by
+            # another slot's verify round.
+            safe_pos = jnp.where(
+                self._dev["active"], self._dev["lengths"],
+                jnp.int32(self.max_blocks * self.block_size))
             drafts_all, self.d_kc, self.d_vc = _draft_propose_slots(
                 self.draft_params, self._dev["last_tok"],
-                self.d_kc, self.d_vc, self._dev["lengths"],
+                self.d_kc, self.d_vc, safe_pos,
                 self.draft_cfg, k,
             )
             drafts_all = jax.device_get(drafts_all)
         for s, req in enumerate(self.active):
-            if req is None:
+            if req is None or req.phase != "decode":
                 continue
             k_eff = self._spec_budget(req)
             if k_eff < 1:
@@ -1289,7 +1601,9 @@ class PagedEngine:
         marks = np.zeros((S, W), np.int32)
         to_release = []
         for s, req in enumerate(self.active):
-            if req is None:
+            if req is None or req.phase != "decode":
+                # prefilling slots rode the verify pass inert: TRASH
+                # device table (writes masked), n_draft 0, no emit
                 continue
             if n_draft[s] == 0:
                 committed = [int(nxt0[s])]
@@ -1431,6 +1745,11 @@ class PagedEngine:
             "blocks_total": self.n_usable_blocks,
             "cache_entries": len(self.prefix_cache),
             "inflight_depth": self.inflight_depth,
+            # gauge: slots whose interleaved admission still owes
+            # prefill chunks (0 in steady state and for sync engines)
+            "prefill_inflight": sum(
+                1 for r in self.active
+                if r is not None and r.phase == "prefill"),
         }
 
     def run(self) -> Dict[int, np.ndarray]:
@@ -1448,16 +1767,21 @@ class PagedEngine:
         guard = 0
         while (self.pending or self._inflight
                or any(r is not None for r in self.active)):
-            before = (self.counters["ticks"], self.counters["tokens_out"],
+            before = (self.counters["ticks"],
+                      self.counters["prefill_chunks"],
+                      self.counters["tokens_out"],
                       self.counters["requests_done"], len(self.pending),
                       len(self._inflight))
             self.step()
-            if self.counters["ticks"] != before[0]:
-                guard += 1  # real device work: keep the old 100k bound
+            if (self.counters["ticks"] != before[0]
+                    or self.counters["prefill_chunks"] != before[1]):
+                # real device work (decode tick OR an interleaved
+                # prefill chunk): keep the old 100k bound
+                guard += 1
                 if guard > 100_000:
                     raise RuntimeError("engine did not converge")
             elif (self.counters["tokens_out"], self.counters["requests_done"],
-                  len(self.pending), len(self._inflight)) == before[1:]:
+                  len(self.pending), len(self._inflight)) == before[2:]:
                 raise RuntimeError(
                     "engine cannot make progress: pending request not "
                     "admittable and nothing active or in flight")

@@ -1,0 +1,485 @@
+"""Observability layer (tpulab.obs): registry, tracer, and the wiring.
+
+Covers the round-10 ISSUE checklist:
+  * histogram bucket math and percentile estimation (the shared
+    interpolation rule);
+  * Prometheus text exposition, parseable line-by-line;
+  * Chrome trace JSON validity + monotonic ordering; ring-buffer
+    wraparound; disabled-tracer no-ops;
+  * copy-on-read snapshots — a scrape racing ``observe`` can never see
+    a torn histogram (the daemon used to read stats outside any lock);
+  * engine wiring: latency histograms populate from a live run, stats
+    and outputs are BIT-IDENTICAL with observability on vs off, and the
+    ``overlap=1`` transfer-guard / flat-``h2d_ticks`` contract of the
+    PR 2–4 tests holds with observability enabled;
+  * daemon surfaces: the ``metrics`` request returns valid Prometheus
+    text with ttft/itl/e2e populated by a live generate, ``trace_dump``
+    returns loadable Chrome trace JSON, and the wave-line/stats lint —
+    every ``engine.stats()`` key has a registered ``engine_*`` metric
+    AND a docs entry, and every wave-log key exists in stats().
+"""
+
+import json
+import re
+import threading
+
+import jax
+import numpy as np
+import pytest
+
+from tpulab import obs
+from tpulab.models.generate import generate
+from tpulab.models.labformer import LabformerConfig
+from tpulab.models.paged import PagedEngine
+from tpulab.obs.registry import Registry, percentile_from_buckets
+from tpulab.obs.tracer import Tracer
+
+CFG = LabformerConfig(d_model=32, n_heads=4, n_layers=2, d_ff=64, max_seq=128)
+
+
+@pytest.fixture(scope="module")
+def trained(trained_small, trained_small_cfg):
+    assert CFG == trained_small_cfg  # shared-model drift fails loudly
+    return trained_small
+
+
+def _cycle_prompt(p):
+    return (np.arange(p) % 7).astype(np.int32)
+
+
+# ------------------------------------------------------------- registry
+def test_histogram_bucket_math():
+    r = Registry()
+    h = r.histogram("h_seconds", buckets=(0.001, 0.01, 0.1))
+    for v in (0.0005, 0.001, 0.005, 0.05, 0.5, 5.0):
+        h.observe(v)
+    snap = h.snapshot()
+    # le is inclusive (0.001 lands in the 0.001 bucket), overflow last
+    assert snap["counts"] == [2, 1, 1, 2]
+    assert snap["count"] == 6
+    assert snap["sum"] == pytest.approx(5.5565)
+    assert h.count == 6
+
+
+def test_histogram_rejects_bad_buckets():
+    r = Registry()
+    with pytest.raises(ValueError, match="increasing"):
+        r.histogram("bad", buckets=(0.1, 0.1))
+    with pytest.raises(ValueError, match="invalid metric name"):
+        r.counter("0bad")
+    r.counter("ok_total")
+    with pytest.raises(ValueError, match="already registered"):
+        r.gauge("ok_total")
+    # get-or-create must not silently hand back DIFFERENT buckets
+    r.histogram("lat_seconds", buckets=(0.1, 1.0))
+    with pytest.raises(ValueError, match="conflicting"):
+        r.histogram("lat_seconds", buckets=(0.001, 0.01))
+    # same buckets (or unspecified) re-fetch the same instance
+    assert (r.histogram("lat_seconds", buckets=(0.1, 1.0))
+            is r.histogram("lat_seconds"))
+    # a one-shot iterator registers cleanly (normalized once up front)
+    h = r.histogram("iter_seconds", buckets=iter((0.1, 1.0)))
+    assert h.bounds == (0.1, 1.0)
+
+
+def test_percentile_estimation_interpolates():
+    # 10 observations uniformly inside (1, 2]: p50 interpolates to 1.5
+    assert percentile_from_buckets((1.0, 2.0, 4.0), (0, 10, 0, 0),
+                                   0.5) == pytest.approx(1.5)
+    # first bucket interpolates from 0
+    assert percentile_from_buckets((1.0, 2.0), (10, 0, 0),
+                                   0.5) == pytest.approx(0.5)
+    # overflow ranks clamp to the last finite bound
+    assert percentile_from_buckets((1.0, 2.0), (0, 0, 5), 0.99) == 2.0
+    # empty histogram reports 0
+    assert percentile_from_buckets((1.0,), (0, 0), 0.5) == 0.0
+    with pytest.raises(ValueError, match="counts"):
+        percentile_from_buckets((1.0,), (0,), 0.5)
+    with pytest.raises(ValueError, match="q must be"):
+        percentile_from_buckets((1.0,), (0, 0), 1.5)
+
+
+def test_histogram_percentile_method():
+    r = Registry()
+    h = r.histogram("p_seconds", buckets=tuple(float(i) for i in
+                                               range(1, 101)))
+    for v in range(1, 101):
+        h.observe(v - 0.5)  # one observation per unit bucket
+    assert h.percentile(0.5) == pytest.approx(50.0, rel=0.03)
+    assert h.percentile(0.99) == pytest.approx(99.0, rel=0.03)
+
+
+def test_counter_and_gauge():
+    r = Registry()
+    c = r.counter("reqs_total", "help text")
+    c.inc()
+    c.inc(4)
+    assert c.value == 5
+    with pytest.raises(ValueError, match="cannot decrease"):
+        c.inc(-1)
+    g = r.gauge("depth")
+    g.set(3.5)
+    assert g.value == 3.5
+    # get-or-create returns the SAME instance
+    assert r.counter("reqs_total") is c
+
+
+_PROM_LINE = re.compile(
+    r'^(# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]*( .*)?'
+    r'|[a-zA-Z_:][a-zA-Z0-9_:]*(_bucket\{le="[^"]+"\})? -?[0-9.e+\-inf]+)$')
+
+
+def test_prometheus_exposition_parses_line_by_line():
+    r = Registry()
+    r.counter("c_total", "a counter").inc(7)
+    r.gauge("g_now").set(-1.25)
+    h = r.histogram("lat_seconds", "latency", buckets=(0.001, 1.0))
+    h.observe(0.0001)
+    h.observe(0.5)
+    h.observe(50.0)
+    text = r.render()
+    assert text.endswith("\n")
+    for line in text.splitlines():
+        assert _PROM_LINE.match(line), line
+    # histogram exposition: cumulative buckets, +Inf == count
+    assert 'lat_seconds_bucket{le="0.001"} 1' in text
+    assert 'lat_seconds_bucket{le="1"} 2' in text
+    assert 'lat_seconds_bucket{le="+Inf"} 3' in text
+    assert "lat_seconds_count 3" in text
+    assert "c_total 7" in text
+    assert "g_now -1.25" in text
+
+
+def test_snapshot_is_copy_on_read_never_torn():
+    """The round-10 small fix: a scrape racing observe() must see a
+    CONSISTENT histogram — count equals the bucket total, and (all
+    observations being the same value) sum equals count * value
+    exactly.  A torn read (count advanced, sum or a bucket not) fails
+    one of the equalities."""
+    r = Registry()
+    h = r.histogram("torn_seconds", buckets=(1.0,))
+    stop = threading.Event()
+
+    def hammer():
+        while not stop.is_set():
+            h.observe(0.5)  # exactly representable: sum stays exact
+
+    t = threading.Thread(target=hammer, daemon=True)
+    t.start()
+    try:
+        for _ in range(300):
+            snap = h.snapshot()
+            assert sum(snap["counts"]) == snap["count"]
+            assert snap["sum"] == snap["count"] * 0.5
+    finally:
+        stop.set()
+        t.join()
+
+
+# --------------------------------------------------------------- tracer
+def test_chrome_trace_valid_and_monotonic():
+    tr = Tracer(64)
+    with tr.span("outer"):
+        tr.event("mark", 7)
+        with tr.span("inner"):
+            pass
+    dump = tr.chrome_trace()
+    json.loads(json.dumps(dump))  # round-trips as strict JSON
+    ev = dump["traceEvents"]
+    assert [e["ph"] for e in ev] == ["B", "i", "B", "E", "E"]
+    assert [e["name"] for e in ev] == ["outer", "mark", "inner", "inner",
+                                       "outer"]
+    ts = [e["ts"] for e in ev]
+    assert ts == sorted(ts) and ts[0] == 0.0
+    instant = ev[1]
+    assert instant["s"] == "t" and instant["args"] == {"arg": 7}
+    assert all({"pid", "tid", "ts", "ph", "name"} <= set(e) for e in ev)
+    assert dump["otherData"] == {"recorded": 5, "dropped": 0}
+
+
+def test_tracer_kwargs_event_and_span_reuse():
+    tr = Tracer(16)
+    tr.event("rich", rid=3, why="test")
+    ev = tr.chrome_trace()["traceEvents"]
+    assert ev[0]["args"] == {"rid": 3, "why": "test"}
+    # span handles are cached per name (zero-allocation steady state)
+    assert tr.span("s") is tr.span("s")
+
+
+def test_ring_buffer_wraparound():
+    tr = Tracer(8)
+    for i in range(20):
+        tr.event("e", i)
+    dump = tr.chrome_trace()
+    ev = dump["traceEvents"]
+    assert len(ev) == 8
+    # the RETAINED window is the most recent 8, still in order
+    assert [e["args"]["arg"] for e in ev] == list(range(12, 20))
+    assert dump["otherData"] == {"recorded": 20, "dropped": 12}
+    # export does not disturb recording: the next event still lands
+    tr.event("e", 20)
+    assert tr.chrome_trace()["otherData"]["recorded"] == 21
+
+
+def test_disabled_tracer_noops():
+    tr = Tracer(0)
+    assert not tr.enabled
+    with tr.span("x"):
+        tr.event("y", 1)
+    assert tr.chrome_trace()["traceEvents"] == []
+    with pytest.raises(ValueError, match="capacity"):
+        Tracer(-1)
+
+
+def test_configure_tracer_resizes_global():
+    prior = obs.TRACER.capacity
+    try:
+        obs.configure_tracer(4)
+        assert obs.TRACER.capacity == 4 and obs.TRACER.enabled
+        obs.configure_tracer(0)
+        assert not obs.TRACER.enabled
+    finally:
+        obs.configure_tracer(prior)
+
+
+# -------------------------------------------------------- engine wiring
+def _run_wave(params, obs_on):
+    eng = PagedEngine(params, CFG, slots=2, n_blocks=32, block_size=8,
+                      max_seq=64, obs=obs_on)
+    r1 = eng.submit(_cycle_prompt(4), max_new=10)
+    r2 = eng.submit(_cycle_prompt(6), max_new=8, temperature=1.5, seed=3)
+    out = eng.run()
+    return (out[r1], out[r2]), eng.stats()
+
+
+def test_engine_histograms_populate(trained):
+    before = {n: obs.REGISTRY.get(n).count
+              for n in ("queue_wait_seconds", "prefill_seconds",
+                        "ttft_seconds", "itl_seconds", "e2e_seconds")}
+    (_, _), st = _run_wave(trained, True)
+    reg = obs.REGISTRY
+    for name in ("queue_wait_seconds", "prefill_seconds", "ttft_seconds",
+                 "e2e_seconds"):
+        assert reg.get(name).count == before[name] + 2, name
+    # ITL: one observation per token after the first, per request
+    assert (reg.get("itl_seconds").count
+            == before["itl_seconds"] + st["tokens_out"] - 2)
+
+
+def test_engine_obs_off_records_nothing(trained):
+    names = ("queue_wait_seconds", "prefill_seconds", "ttft_seconds",
+             "itl_seconds", "e2e_seconds")
+    before = {n: obs.REGISTRY.get(n).count for n in names}
+    _run_wave(trained, False)
+    for n in names:
+        assert obs.REGISTRY.get(n).count == before[n], n
+
+
+def test_engine_stats_and_stream_bit_identical_obs_on_off(trained):
+    """Observability must be a pure observer: the token streams AND
+    every engine counter — including the transfer-guard contract pair
+    ``host_syncs``/``h2d_ticks`` — are bit-identical with obs on vs off
+    under the default ``overlap=1``."""
+    (a1, a2), st_on = _run_wave(trained, True)
+    (b1, b2), st_off = _run_wave(trained, False)
+    assert np.array_equal(a1, b1) and np.array_equal(a2, b2)
+    assert st_on == st_off
+    assert np.array_equal(a1, generate(
+        trained, _cycle_prompt(4)[None, :], CFG, steps=10,
+        temperature=0.0)[0])
+
+
+def test_steady_state_zero_transfers_with_obs_on(trained):
+    """The PR 2 acceptance test, re-run with observability ENABLED and
+    the global tracer recording: a steady-state tick still moves
+    nothing host<->device implicitly, and ``h2d_ticks``/``host_syncs``
+    stay flat — timestamps and ring appends are host-only by
+    construction."""
+    eng = PagedEngine(trained, CFG, slots=2, n_blocks=32, block_size=8,
+                      max_seq=64, obs=True)
+    eng.submit(_cycle_prompt(4), max_new=30)
+    eng.submit(_cycle_prompt(5), max_new=30, repetition_penalty=4.0)
+    for _ in range(4):  # admission + compile happen OUTSIDE the guard
+        eng.step()
+    before = eng.stats()
+    assert before["inflight_depth"] == 1  # the async window is open
+    with jax.transfer_guard("disallow"):
+        for _ in range(8):
+            eng.step()
+    st = eng.stats()
+    assert st["ticks"] == before["ticks"] + 8
+    assert st["h2d_ticks"] == before["h2d_ticks"], "obs tick uploaded"
+    assert st["host_syncs"] == before["host_syncs"], "obs tick synced"
+    out = eng.run()
+    want = generate(trained, _cycle_prompt(4)[None, :], CFG, steps=30,
+                    temperature=0.0)[0]
+    assert np.array_equal(out[0], want)
+
+
+def test_engine_trace_events_recorded(trained):
+    prior = obs.TRACER.capacity
+    try:
+        obs.configure_tracer(1 << 12)  # fresh, private window
+        eng = PagedEngine(trained, CFG, slots=2, n_blocks=32, block_size=8,
+                          max_seq=64, prefill_chunk=8)
+        rid = eng.submit(_cycle_prompt(20), max_new=4)
+        eng.run()
+        names = {e["name"] for e in obs.TRACER.chrome_trace()["traceEvents"]}
+        assert {"engine.admit", "engine.prefill_chunk",
+                "engine.first_token", "engine.retire"} <= names
+    finally:
+        obs.configure_tracer(prior)
+
+
+# -------------------------------------------------------- daemon wiring
+def test_daemon_metrics_and_trace_dump(trained):
+    """Acceptance: the ``metrics`` request returns valid Prometheus text
+    including ttft/itl/e2e histograms populated by a live generate, and
+    ``trace_dump`` returns Chrome-trace JSON with monotonic
+    timestamps."""
+    from tpulab import daemon
+    from tpulab.daemon import _GenerateService, handle_request
+
+    svc = _GenerateService()
+    eng = PagedEngine(trained, CFG, slots=2, n_blocks=32, block_size=8,
+                      max_seq=64)
+    out = svc.generate(eng, _cycle_prompt(4), 8)
+    assert len(out) == 8
+    key = (None, "gather", "native", 1, 0)
+    daemon._ENGINES[key] = (None, eng, None)
+    try:
+        text = handle_request({"lab": "metrics"}, b"").decode("utf-8")
+    finally:
+        daemon._ENGINES.pop(key, None)
+    for line in text.splitlines():
+        assert _PROM_LINE.match(line), line
+    for name in ("ttft_seconds", "itl_seconds", "e2e_seconds"):
+        m = re.search(rf"^{name}_count (\d+)$", text, re.M)
+        assert m and int(m.group(1)) > 0, name
+    # the warm engine's stats ride along as engine_* gauges
+    assert re.search(r"^engine_tokens_out \d+$", text, re.M)
+    dump = json.loads(handle_request({"lab": "trace_dump"}, b""))
+    ts = [e["ts"] for e in dump["traceEvents"]]
+    assert ts == sorted(ts)
+
+
+def test_daemon_metrics_aggregates_across_engines(trained):
+    """With SEVERAL warm engines the unlabeled engine_* gauges must
+    report the key-wise SUM (process totals), not whichever engine
+    published last."""
+    from tpulab import daemon
+    from tpulab.daemon import handle_request
+
+    engines = []
+    for i in range(2):
+        eng = PagedEngine(trained, CFG, slots=1, n_blocks=16, block_size=8,
+                          max_seq=64)
+        eng.submit(_cycle_prompt(4), max_new=2 + i)
+        eng.run()
+        engines.append(eng)
+    keys = [(None, "gather", "native", 1, i) for i in range(2)]
+    for key, eng in zip(keys, engines):
+        daemon._ENGINES[key] = (None, eng, None)
+    try:
+        text = handle_request({"lab": "metrics"}, b"").decode("utf-8")
+    finally:
+        for key in keys:
+            daemon._ENGINES.pop(key, None)
+    want = sum(e.stats()["tokens_out"] for e in engines)
+    m = re.search(r"^engine_tokens_out (\d+)$", text, re.M)
+    assert m and int(m.group(1)) == want, (m, want)
+    # once the engines are gone, a scrape must ZERO the mirror rather
+    # than freeze the dead engines' final values forever
+    text = handle_request({"lab": "metrics"}, b"").decode("utf-8")
+    m = re.search(r"^engine_tokens_out (\d+)$", text, re.M)
+    assert m and int(m.group(1)) == 0, m
+
+
+def test_wave_line_helper_and_stats_lint(trained):
+    """The dedup satellite + the registry/docs lint: the wave-log
+    formatter reads the same stats() snapshot as generate_stats, every
+    wave key exists in stats(), and every stats() key has BOTH a
+    registered ``engine_<key>`` metric (after publish_metrics) and a
+    docs entry in docs/ARCHITECTURE.md."""
+    import pathlib
+
+    from tpulab.daemon import _WAVE_KEYS, _counters_line, _engine_stats
+
+    eng = PagedEngine(trained, CFG, slots=1, n_blocks=16, block_size=8,
+                      max_seq=64)
+    eng.submit(_cycle_prompt(4), max_new=2)
+    eng.run()
+    assert _engine_stats(eng) == eng.stats()  # the one snapshot source
+    row = eng.publish_metrics()
+    assert set(_WAVE_KEYS) <= set(row), "wave line names a missing key"
+    line = _counters_line(row)
+    for k in _WAVE_KEYS:
+        assert f"{k}={row[k]}" in line
+    docs = (pathlib.Path(__file__).resolve().parent.parent
+            / "docs" / "ARCHITECTURE.md").read_text()
+    for k in row:
+        assert obs.REGISTRY.get(f"engine_{k}") is not None, (
+            f"stats() key {k!r} has no registered engine_ metric")
+        assert f"engine_{k}" in docs, (
+            f"stats() key {k!r} has no docs/ARCHITECTURE.md entry")
+
+
+def test_trainer_metrics_line():
+    """train.py records dispatch/loss-lag histograms and emits the
+    periodic [train] metrics line (here: the end-of-run emission)."""
+    from tpulab.train import train
+
+    before = obs.REGISTRY.get("train_dispatch_seconds")
+    n0 = before.count if before else 0
+    lines = []
+    train(steps=3, batch=2, seq=16, log=lines.append)
+    h = obs.REGISTRY.get("train_dispatch_seconds")
+    assert h is not None and h.count == n0 + 3
+    metrics_lines = [ln for ln in lines if ln.startswith("[train] metrics ")]
+    assert metrics_lines, lines
+    assert re.search(r"dispatch_ms_p50=[\d.]+ dispatch_ms_p99=[\d.]+ "
+                     r"loss_lag_ms_p50=[\d.]+ loss_lag_ms_p99=[\d.]+ "
+                     r"blocks=\d+", metrics_lines[-1])
+
+
+# ------------------------------------------------------- report tooling
+def test_obs_report_parses_and_summarizes():
+    import importlib.util
+    import pathlib
+
+    spec = importlib.util.spec_from_file_location(
+        "obs_report", pathlib.Path(__file__).resolve().parent.parent
+        / "tools" / "obs_report.py")
+    rep = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(rep)
+    r = Registry()
+    h = r.histogram("ttft_seconds", buckets=(0.001, 0.01, 0.1))
+    for v in (0.0005, 0.005, 0.05, 0.05):
+        h.observe(v)
+    r.counter("engine_ticks").inc(3)
+    parsed = rep.parse_prometheus(r.render())
+    assert parsed["engine_ticks"]["value"] == 3
+    assert parsed["ttft_seconds"]["count"] == 4
+    p50 = rep.histogram_percentile(parsed["ttft_seconds"], 0.5)
+    assert 0.001 < p50 <= 0.1
+    rows = rep.summarize(parsed)
+    assert rows and rows[0]["metric"] == "ttft_seconds"
+    assert rows[0]["count"] == 4
+    with pytest.raises(ValueError, match="unparseable"):
+        rep.parse_prometheus("!! not prometheus")
+
+
+@pytest.mark.slow
+def test_obs_overhead_bench_under_budget():
+    """The obs_overhead microbench: runs the real A/B windows and
+    asserts the <3% budget internally (wall-clock sensitive — slow
+    tier; the committed baselines.json row gates the CPU-proxy number
+    round over round)."""
+    from tpulab.bench import bench_obs_overhead
+
+    # default window size on purpose: shorter windows amplify
+    # scheduler noise past the retry-merge's ability to absorb it
+    row = bench_obs_overhead(reps=2)
+    assert row["metric"] == "obs_overhead_4slots_ticks_per_s"
+    assert row["value"] > 0 and row["off_ticks_per_s"] > 0
+    assert "overhead_pct_best" in row

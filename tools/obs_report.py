@@ -1,0 +1,215 @@
+#!/usr/bin/env python
+"""Scrape a running tpulab daemon and render a latency-percentile summary.
+
+Speaks the daemon's wire protocol (tpulab/daemon.py) over its unix
+socket and issues the observability requests this layer added:
+
+  * ``metrics``    — Prometheus text exposition of the process-global
+    registry (per-request ttft/itl/e2e/queue-wait/prefill histograms,
+    ``engine_*`` gauges for every warm engine);
+  * ``trace_dump`` — the ring-buffer tracer's retained window as Chrome
+    trace-event JSON (``--trace-out FILE``; open the file directly in
+    https://ui.perfetto.dev).
+
+The summary table is the serving-metrics view production TPU serving
+comparisons report (PAPERS.md, arXiv:2605.25645): p50/p90/p99 TTFT,
+inter-token latency, and end-to-end time, estimated from the scraped
+histogram buckets with the same interpolation rule the registry itself
+uses (``tpulab.obs.percentile_from_buckets`` — one copy of the math).
+
+``--drive N`` optionally sends N small ``generate`` requests first, so
+a freshly started daemon has populated histograms to report — the
+on-chip evidence queue (tools/onchip_queue_r10.sh) uses this to capture
+a real trace + scrape in one shot.
+
+Usage:
+    python tools/obs_report.py [--socket /tmp/tpulab.sock]
+                               [--drive N] [--steps M]
+                               [--trace-out results/obs_trace.json]
+                               [--raw] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import pathlib
+import re
+import socket
+import struct
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+from tpulab.obs.registry import percentile_from_buckets  # noqa: E402
+
+#: histograms the summary table reports, in display order
+_LATENCY_METRICS = ("ttft_seconds", "itl_seconds", "e2e_seconds",
+                    "queue_wait_seconds", "prefill_seconds")
+
+_BUCKET_RE = re.compile(
+    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)_bucket\{le="(?P<le>[^"]+)"\}'
+    r"\s+(?P<v>\S+)$")
+_PLAIN_RE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)\s+(?P<v>\S+)$")
+
+
+def request(sock_path: str, lab: str, config: dict | None = None,
+            payload: bytes = b"") -> bytes:
+    """One daemon round-trip; raises on an error frame.  Chunk frames
+    (status 2, streaming generates) are drained — the terminal frame
+    carries the full output either way."""
+    header = json.dumps({"lab": lab, "config": config or {}}).encode()
+    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    s.connect(sock_path)
+    try:
+        s.sendall(struct.pack("<I", len(header)) + header)
+        s.sendall(struct.pack("<Q", len(payload)) + payload)
+
+        def read_exact(n: int) -> bytes:
+            buf = b""
+            while len(buf) < n:
+                r = s.recv(n - len(buf))
+                if not r:
+                    raise ConnectionError("daemon closed mid-frame")
+                buf += r
+            return buf
+
+        while True:
+            status = read_exact(1)[0]
+            (n,) = struct.unpack("<Q", read_exact(8))
+            out = read_exact(n)
+            if status == 2:      # streamed chunk: keep reading
+                continue
+            if status != 0:
+                raise RuntimeError(
+                    f"daemon error for {lab!r}: "
+                    f"{out.decode('utf-8', 'replace')[-500:]}")
+            return out
+    finally:
+        s.close()
+
+
+def parse_prometheus(text: str) -> dict:
+    """Prometheus text -> {name: {"type", "value"|"buckets"/"sum"/
+    "count"}}.  ``buckets`` are (upper_bound, CUMULATIVE count) pairs in
+    exposition order, +Inf last — exactly what the text carries."""
+    out: dict = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# TYPE "):
+            _, _, name, mtype = line.split(None, 3)
+            out.setdefault(name, {"type": mtype})
+            continue
+        if line.startswith("#"):
+            continue
+        m = _BUCKET_RE.match(line)
+        if m:
+            h = out.setdefault(m["name"], {"type": "histogram"})
+            le = float("inf") if m["le"] == "+Inf" else float(m["le"])
+            h.setdefault("buckets", []).append((le, int(float(m["v"]))))
+            continue
+        m = _PLAIN_RE.match(line)
+        if not m:
+            raise ValueError(f"unparseable exposition line: {line!r}")
+        name, v = m["name"], float(m["v"])
+        if name.endswith("_sum"):
+            out.setdefault(name[:-4], {"type": "histogram"})["sum"] = v
+        elif name.endswith("_count"):
+            out.setdefault(name[:-6], {"type": "histogram"})["count"] = int(v)
+        else:
+            out.setdefault(name, {"type": "untyped"})["value"] = v
+    return out
+
+
+def histogram_percentile(metric: dict, q: float) -> float:
+    """Quantile estimate from scraped CUMULATIVE buckets (converts to
+    per-bucket counts and defers to the registry's shared rule)."""
+    pairs = metric.get("buckets") or []
+    if not pairs or pairs[-1][0] != float("inf"):
+        raise ValueError("histogram is missing its +Inf bucket")
+    bounds = tuple(le for le, _ in pairs[:-1])
+    cums = [c for _, c in pairs]
+    counts = [cums[0]] + [b - a for a, b in zip(cums, cums[1:])]
+    return percentile_from_buckets(bounds, counts, q)
+
+
+def summarize(metrics: dict) -> list:
+    rows = []
+    for name in _LATENCY_METRICS:
+        m = metrics.get(name)
+        if not m or m.get("type") != "histogram":
+            continue
+        rows.append({
+            "metric": name,
+            "count": m.get("count", 0),
+            "p50_ms": round(histogram_percentile(m, 0.50) * 1e3, 3),
+            "p90_ms": round(histogram_percentile(m, 0.90) * 1e3, 3),
+            "p99_ms": round(histogram_percentile(m, 0.99) * 1e3, 3),
+        })
+    return rows
+
+
+def drive(sock_path: str, n: int, steps: int) -> None:
+    """Send ``n`` small generate requests (shared system-prompt prefix,
+    so the scrape also exercises prefix hits) to populate the
+    histograms on a fresh daemon."""
+    prompt = (b"observability scrape warmup: " * 3)[:64]
+    for i in range(n):
+        request(sock_path, "generate", {"steps": steps},
+                prompt + str(i).encode())
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--socket", default="/tmp/tpulab.sock")
+    ap.add_argument("--drive", type=int, default=0, metavar="N",
+                    help="send N generate requests first (populates the "
+                         "histograms on a fresh daemon)")
+    ap.add_argument("--steps", type=int, default=32,
+                    help="tokens per --drive request")
+    ap.add_argument("--trace-out", default=None, metavar="FILE",
+                    help="also request trace_dump and write the Chrome "
+                         "trace JSON here (open in ui.perfetto.dev)")
+    ap.add_argument("--raw", action="store_true",
+                    help="print the raw Prometheus text instead of the "
+                         "summary table")
+    ap.add_argument("--json", action="store_true",
+                    help="print the summary as one JSON object")
+    args = ap.parse_args(argv)
+
+    if args.drive:
+        drive(args.socket, args.drive, args.steps)
+    text = request(args.socket, "metrics").decode("utf-8")
+    if args.raw:
+        print(text, end="")
+        return 0
+    metrics = parse_prometheus(text)
+    rows = summarize(metrics)
+    if args.trace_out:
+        trace = request(args.socket, "trace_dump")
+        json.loads(trace)  # refuse to write a corrupt dump
+        pathlib.Path(args.trace_out).write_bytes(trace)
+        print(f"[obs_report] trace written to {args.trace_out} "
+              f"(open in ui.perfetto.dev)", file=sys.stderr)
+    if args.json:
+        print(json.dumps({"latency": rows}))
+        return 0
+    if not rows:
+        print("no latency histograms populated yet "
+              "(drive some generate traffic, or --drive N)")
+        return 0
+    w = max(len(r["metric"]) for r in rows)
+    print(f"{'metric':<{w}}  {'count':>7}  {'p50_ms':>9}  "
+          f"{'p90_ms':>9}  {'p99_ms':>9}")
+    for r in rows:
+        print(f"{r['metric']:<{w}}  {r['count']:>7}  {r['p50_ms']:>9.3f}  "
+              f"{r['p90_ms']:>9.3f}  {r['p99_ms']:>9.3f}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

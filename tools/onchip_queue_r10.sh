@@ -1,0 +1,121 @@
+#!/bin/bash
+# Round-10 sequential on-chip evidence queue (single chip -- no contention).
+#
+# Claim discipline (docs/tpu_runs.md + .claude/skills/verify): TPU-claiming
+# processes are WAITED on, never killed -- a killed claim wedges the relay
+# for every later process.  Each stage is gated on a live compiled-matmul
+# probe.  If a previous round's queue left a probe pending (its PID in
+# $PRIOR_PROBE_PID, output at /tmp/queue_probe.out), that claim is REUSED
+# as the relay sentinel instead of stacking a second claim behind it.
+#
+# Round-10 ordering: the OBSERVABILITY evidence lands FIRST and is sized
+# to complete-and-commit inside a ~3-minute relay window:
+#   * obs_fast: bench.py obs_overhead (steady-state ticks/s with the
+#     tpulab.obs layer on vs off; the bench itself asserts the <3%
+#     budget) -- committed + ratcheted immediately;
+#   * obs_capture: a REAL on-chip serving capture -- daemon with a
+#     sized trace buffer, generate traffic driven through the socket,
+#     then a metrics scrape (Prometheus text + percentile table) and a
+#     trace_dump (Chrome trace JSON, loads in ui.perfetto.dev) written
+#     under results/.  This is the acceptance artifact: ttft/itl/e2e
+#     histograms populated by live on-chip generates.
+# The regression pass ratchets the CPU-proxy obs_overhead baseline up to
+# the chip number, exactly like paged_tick (r7) / train_step (r8) /
+# prefill_interleave (r9).
+cd /root/repo || exit 1
+L=results/logs
+mkdir -p "$L"
+
+wait_relay() {
+  while true; do
+    if [ -n "$PRIOR_PROBE_PID" ] && kill -0 "$PRIOR_PROBE_PID" 2>/dev/null; then
+      sleep 60
+      continue
+    fi
+    if grep -q compile-ok /tmp/queue_probe.out 2>/dev/null; then
+      # consume the sentinel so every LATER stage re-probes (the relay
+      # can drop again between stages)
+      PRIOR_PROBE_PID=""
+      rm -f /tmp/queue_probe.out
+      return 0
+    fi
+    PRIOR_PROBE_PID=""
+    python -c "import jax, jax.numpy as jnp; x = jnp.ones((128, 128)); (x @ x).block_until_ready(); print('compile-ok')" \
+        > /tmp/queue_probe.out 2>&1
+    # loop re-checks the probe output; a failed probe (relay down but
+    # fast-failing) falls through to another attempt after the check
+    grep -q compile-ok /tmp/queue_probe.out 2>/dev/null || sleep 120
+  done
+}
+
+stage() {  # stage <name> <cmd...>
+  name=$1; shift
+  echo "== $name wait-relay $(date)" >> $L/queue.status
+  wait_relay
+  echo "== $name start $(date)" >> $L/queue.status
+  "$@" > "$L/$name.log" 2>&1
+  echo "== $name rc=$? $(date)" >> $L/queue.status
+}
+
+obs_capture() {
+  # on-chip serving observability capture: daemon (bounded lifetime via
+  # --max-requests; NEVER killed -- it holds the chip claim) + driven
+  # generate traffic + metrics scrape + Perfetto trace dump.  The drive
+  # sends 6 generates, then obs_report issues metrics + trace_dump +
+  # metrics = 9 requests total, so the daemon exits on its own.
+  SOCK=/tmp/tpulab_obs_r10.sock
+  python -m tpulab.daemon --socket "$SOCK" --trace-buffer 65536 \
+      --max-requests 9 &
+  DPID=$!
+  # wait for the socket (daemon warms the backend first -- on-chip that
+  # is the compile wait; bounded so a dead daemon doesn't park the queue)
+  for _ in $(seq 120); do [ -S "$SOCK" ] && break; sleep 5; done
+  python tools/obs_report.py --socket "$SOCK" --drive 6 --steps 48 \
+      --trace-out results/obs_trace_r10.json \
+      > results/logs/obs_report_r10.txt 2>&1
+  python tools/obs_report.py --socket "$SOCK" --raw \
+      > results/obs_metrics_r10.prom 2>>results/logs/obs_report_r10.txt
+  wait $DPID
+}
+
+date > $L/queue.status
+# -- the ~3-minute observability window: the obs_overhead row, committed
+#    (jsonl fallback + ratchet) IMMEDIATELY so a relay drop after this
+#    point still leaves the round-10 obs evidence on disk
+stage obs_fast        python bench.py --skip-probe --only obs_overhead --reps 5
+grep '"metric"' $L/obs_fast.log > results/bench_r10.jsonl 2>/dev/null || true
+python tools/check_regression.py results/bench_r10.jsonl --update \
+    --date "round 10 (onchip_queue_r10, obs window)" > "$L/regression_obs.log" 2>&1
+echo "== obs-window regression+ratchet rc=$? $(date)" >> $L/queue.status
+stage obs_capture     obs_capture
+stage serving_int     python tools/serving_tpu.py
+# -- the long tail, round-9 ordering preserved
+stage bench_r10       python bench.py --skip-probe
+# committed fallback for the driver's round-end bench (see
+# bench.py::_last_good_headline): the freshest on-chip lines, MERGED
+# with the obs-window rows (a bare overwrite here would clobber the
+# already-committed obs evidence if the relay dropped mid-registry)
+grep -h '"metric"' $L/bench_r10.log $L/obs_fast.log \
+    2>/dev/null | awk '!seen[$0]++' > results/bench_r10.jsonl || true
+stage parity          python tools/pallas_tpu_parity.py
+stage flash_train     python tools/flash_train_proof.py
+stage ref_harness2    python tools/run_reference_harness.py --backend tpu --lab lab2 --k-times 5
+stage ref_harness3    python tools/run_reference_harness.py --backend tpu --lab lab3 --k-times 5
+stage tune_flash      python tools/tune_flash.py
+# mechanical regression verdict + ratchet in ONE pass, ungated like the
+# re-sign below (host-only JSON diff -- a relay gate here could hang the
+# queue after the chip stages already rewrote artifacts).  --update
+# refuses to move any baseline in the worse direction without an
+# explicit --accept-regression note (VERDICT r5 #6 guard); on a clean
+# improving run it ratchets with round-10 provenance -- including the
+# obs_overhead CPU-proxy baseline up to its chip value.
+python tools/check_regression.py results/bench_r10.jsonl --update \
+    --date "round 10 (onchip_queue_r10)" > "$L/regression.log" 2>&1
+echo "== regression+ratchet rc=$? $(date)" >> $L/queue.status
+# re-sign: the stages above rewrite signed artifacts (pallas_tpu_parity
+# .json; baselines.json under the --update) -- signatures must track
+# them or tests/test_signing.py::test_committed_signatures_verify reds.
+# No relay gate: signing is host-only.
+python tools/sign_artifacts.py sign > "$L/resign.log" 2>&1
+echo "== resign rc=$? $(date)" >> $L/queue.status
+echo "QUEUE DONE $(date)" >> $L/queue.status

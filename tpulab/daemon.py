@@ -21,6 +21,15 @@ Wire protocol (all integers little-endian):
               output bytes (the FULL output, chunks included, so
               non-streaming consumers read one frame as before)
 
+Observability requests (same frame format, empty payload): ``metrics``
+returns the Prometheus text exposition of the process-global registry
+(per-request ttft/itl/e2e/queue-wait/prefill histograms + ``engine_*``
+gauges summed across the warm engines — scrape with
+``tools/obs_report.py``);
+``trace_dump`` returns the ring-buffer tracer's retained window as
+Chrome trace-event JSON (loads in Perfetto; size with
+``--trace-buffer N``).
+
 Run: ``python -m tpulab.daemon --socket /tmp/tpulab.sock``
 Stop: SIGTERM/SIGINT, or an empty header (client disconnect is fine too).
 """
@@ -327,7 +336,7 @@ class _GenerateService:
                         # submitter behind a dead-but-flag-consistent
                         # stepper.
                         st.stepper_alive = False
-                        row = engine.stats()
+                        row = _engine_stats(engine)
                         break
                     for rid in engine.step():
                         out = engine._done.pop(rid)
@@ -339,17 +348,11 @@ class _GenerateService:
             # per-wave serving log: the interleaved-prefill counters
             # next to the overlap ones, so stall-free admission is
             # visible in production (cumulative engine counters, one
-            # line per wave the stepper drained)
-            print("[serve] wave done: "
-                  f"requests={row['requests_done']} "
-                  f"tokens={row['tokens_out']} "
-                  f"ticks={row['ticks']} "
-                  f"admissions={row['admissions']} "
-                  f"prefill_chunks={row['prefill_chunks']} "
-                  f"stall_ticks={row['stall_ticks']} "
-                  f"prefill_inflight={row['prefill_inflight']} "
-                  f"host_syncs={row['host_syncs']} "
-                  f"h2d_ticks={row['h2d_ticks']}", flush=True)
+            # line per wave the stepper drained).  _counters_line is
+            # the ONE formatter (shared key list _WAVE_KEYS, lint-
+            # checked against stats()) so this line and the
+            # generate_stats/metrics surfaces cannot drift.
+            print("[serve] wave done: " + _counters_line(row), flush=True)
         except Exception as e:  # fail every request; never hang waiters
             with st.cond:
                 for req in list(engine.pending) + [
@@ -373,6 +376,32 @@ class _GenerateService:
 
 
 _GEN_SERVICE = _GenerateService()
+
+
+#: the counter subset the per-wave serving log line prints, in order —
+#: ONE place, shared with the lint in tests/test_obs.py (every key must
+#: exist in engine.stats()), so the log line and the stats/metrics
+#: surfaces cannot drift when a counter is added
+_WAVE_KEYS = ("requests_done", "tokens_out", "ticks", "admissions",
+              "prefill_chunks", "stall_ticks", "prefill_inflight",
+              "host_syncs", "h2d_ticks")
+
+
+def _engine_stats(engine) -> dict:
+    """THE one snapshot every observability surface reads (the wave
+    log line, ``generate_stats``, and the ``metrics`` aggregation all
+    come through here — the dedup the round-10 satellite asked for).
+    Deliberately does NOT write the ``engine_*`` gauge mirror: the
+    gauges are unlabeled, so the only correct writer in a
+    several-engines process is the ``metrics`` handler's summed
+    publish below."""
+    return engine.stats()
+
+
+def _counters_line(row: dict) -> str:
+    """Render the wave-log counter subset (``k=v`` pairs) from a stats
+    snapshot — used by the stepper's "[serve] wave done:" line."""
+    return " ".join(f"{k}={row[k]}" for k in _WAVE_KEYS if k in row)
 
 
 def _ckpt_stamp(ckpt_dir: str):
@@ -727,10 +756,58 @@ def _handle_generate_stats(header: dict) -> bytes:
            int(config.get("prefill_chunk", PREFILL_CHUNK)))
     with _GEN_SERVICE.lock:  # registry lookup only — short-held
         hit = _ENGINES.get(key)
-    # stats() reads flat counters/lengths; calling it OUTSIDE any lock
-    # keeps observability from queueing behind a decode tick
-    stats = hit[1].stats() if hit else {}
+    # the snapshot runs OUTSIDE any lock so observability never queues
+    # behind a decode tick: engine counters are flat ints, consistent
+    # under the GIL.  (The engine_* gauge mirror is published by the
+    # `metrics` handler only; the registry's copy-on-read snapshots —
+    # tpulab.obs.registry, the round-10 satellite fix — cover the
+    # histogram surfaces a tick races against.)
+    stats = _engine_stats(hit[1]) if hit else {}
     return json.dumps(stats).encode("utf-8")
+
+
+def _handle_metrics(header: dict) -> bytes:
+    """``metrics`` request: Prometheus text exposition of the process-
+    global registry (tpulab.obs) — the serving latency histograms
+    (ttft_seconds / itl_seconds / e2e_seconds / queue_wait_seconds /
+    prefill_seconds), the trainer's histograms when this process also
+    trains, and a fresh ``engine_*`` gauge mirror of the warm engines'
+    stats() — SUMMED across engines (process-wide totals; identical to
+    the single engine's stats in the common case), published through
+    the one gauge-writing site so two warm engines can never overwrite
+    each other into a mixed exposition.  Scrape with
+    ``tools/obs_report.py`` or any Prometheus-format consumer."""
+    from tpulab import obs
+    from tpulab.models.paged import publish_engine_stats
+
+    with _GEN_SERVICE.lock:  # registry lookup only — short-held
+        engines = [v[1] for v in _ENGINES.values()]
+    total: dict = {}
+    for eng in engines:
+        # stats math OUTSIDE the service lock: a scrape must never
+        # block a submit; the registry's own per-metric locks make the
+        # render below copy-on-read (no torn histograms)
+        for k, v in _engine_stats(eng).items():
+            total[k] = total.get(k, 0) + v
+    if total:
+        publish_engine_stats(total)
+    else:
+        # no warm engines (none built yet, or the last one was evicted
+        # after a stepper failure): zero the mirror instead of freezing
+        # the dead engine's final values into every future scrape
+        for name in obs.REGISTRY.names():
+            if name.startswith("engine_"):
+                obs.REGISTRY.get(name).set(0)
+    return obs.render_prometheus().encode("utf-8")
+
+
+def _handle_trace_dump(header: dict) -> bytes:
+    """``trace_dump`` request: the ring-buffer tracer's retained window
+    as Chrome trace-event JSON — load the bytes directly in
+    https://ui.perfetto.dev.  Size the window with ``--trace-buffer``."""
+    from tpulab import obs
+
+    return json.dumps(obs.TRACER.chrome_trace()).encode("utf-8")
 
 
 # Lab runs are SERIALIZED even though connections are threaded: their
@@ -747,6 +824,10 @@ def handle_request(header: dict, payload: bytes,
         return _handle_generate(header, payload, send_chunk)
     if header.get("lab") == "generate_stats":
         return _handle_generate_stats(header)
+    if header.get("lab") == "metrics":
+        return _handle_metrics(header)
+    if header.get("lab") == "trace_dump":
+        return _handle_trace_dump(header)
     if header.get("lab") == "platform":
         # observability: which backend this daemon actually computes on
         # (tools/run_reference_harness.py --backend tpu refuses to write
@@ -931,10 +1012,21 @@ def main(argv=None) -> int:
                     help="default prefill window for the serving engines "
                          "(chunked+interleaved admission; 0 = whole-prompt "
                          "dense prefill, the single-request oracle path)")
+    ap.add_argument("--trace-buffer", type=int, default=None, metavar="N",
+                    help="ring-buffer tracer capacity in events (default "
+                         "32768; 0 disables tracing).  Dump the retained "
+                         "window with a 'trace_dump' request — the JSON "
+                         "loads directly in Perfetto")
     args = ap.parse_args(argv)
     if args.prefill_chunk < 0:
         ap.error("--prefill-chunk must be >= 0")
+    if args.trace_buffer is not None and args.trace_buffer < 0:
+        ap.error("--trace-buffer must be >= 0")
     PREFILL_CHUNK = args.prefill_chunk
+    if args.trace_buffer is not None:
+        from tpulab import obs
+
+        obs.configure_tracer(args.trace_buffer)
     serve(args.socket, max_requests=args.max_requests)
     return 0
 

@@ -91,6 +91,7 @@ vLLM's PagedAttention, built on XLA gathers instead of custom CUDA.
 from __future__ import annotations
 
 import functools
+import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
@@ -99,6 +100,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tpulab.obs import tracer as _obs_tracer
+from tpulab.obs.registry import gauge as _obs_gauge
+from tpulab.obs.registry import histogram as _obs_histogram
 from tpulab.models.generate import (_attend_cached, _forward_window,
                                     _prefill, apply_repetition_penalty)
 from tpulab.models.labformer import LabformerConfig, _mlp, _rmsnorm, _rope
@@ -108,6 +112,26 @@ from tpulab.models.speculative import (_draft_propose_slots, _lookup_propose,
 from tpulab.parallel.ring import NEG_INF
 
 TRASH = 0  # physical block 0 swallows must-not-land writes
+
+# Per-request serving latency histograms (tpulab.obs process-global
+# registry; the daemon's ``metrics`` request renders them as Prometheus
+# text).  Every observation happens at a host-side boundary where the
+# engine ALREADY touches the request (admission bookkeeping, the drain's
+# emit, release) — a time.monotonic() read plus an O(1) bucket add, no
+# device sync — so the one-dispatch steady state and the
+# transfer-guard/h2d_ticks contracts of the overlap tests are untouched.
+# Recording is gated per engine by ``PagedEngine(obs=...)``; the
+# ``obs_overhead`` bench holds the combined cost under 3% of ticks/s.
+_H_QUEUE_WAIT = _obs_histogram(
+    "queue_wait_seconds", "submit -> admission wait per request")
+_H_PREFILL = _obs_histogram(
+    "prefill_seconds", "admission -> prefill complete per request")
+_H_TTFT = _obs_histogram(
+    "ttft_seconds", "submit -> first generated token drained (TTFT)")
+_H_ITL = _obs_histogram(
+    "itl_seconds", "inter-token latency between drained tokens (ITL)")
+_H_E2E = _obs_histogram(
+    "e2e_seconds", "submit -> request retired, end to end")
 
 
 def init_pools(cfg: LabformerConfig, n_blocks: int, block_size: int,
@@ -562,6 +586,20 @@ def _spec_commit(state, adv, last_tok, new_keys, marks):
     )
 
 
+def publish_engine_stats(st: Dict[str, int]) -> None:
+    """THE one site that writes the ``engine_<key>`` gauge mirror into
+    the process-global registry (tests/test_obs.py lints that every
+    stats() key has a registered metric and a docs entry, so a new
+    counter cannot silently miss the scrape surface).  ``st`` is one
+    engine's :meth:`PagedEngine.stats` dict, or a key-wise SUM across
+    engines — the daemon's ``metrics`` handler publishes the sum, so
+    the exposition reports process-wide totals (identical to the
+    engine's own stats in the common one-engine case) instead of
+    whichever engine happened to publish last."""
+    for k, v in st.items():
+        _obs_gauge("engine_" + k).set(int(v))
+
+
 def _bucket(n: int) -> int:
     b = 16
     while b < n:
@@ -589,6 +627,11 @@ class _Request:
     pf_pos: int = 0             # next prompt position to paged_extend
     pf_end: int = 0             # prefill frontier: len(prompt) - 1
     d_pf_pos: int = 0           # draft-cache prefill cursor ("draft")
+    # latency-histogram timestamps (time.monotonic seconds): set at
+    # submit / admission / each drained token — host-side only
+    t_submit: float = field(default_factory=time.monotonic)
+    t_admit: float = 0.0
+    t_last: float = 0.0         # previous drained-token time (ITL)
 
 
 class PagedEngine:
@@ -609,7 +652,13 @@ class PagedEngine:
     decoding; ``interleave=False`` restores the synchronous
     whole-prefill admission under a drained window (the bit-equality
     oracle).  Per-request greedy streams are identical either way —
-    only the tick on which a request's FIRST token appears moves."""
+    only the tick on which a request's FIRST token appears moves.
+
+    ``obs=True`` (default) records per-request latency histograms
+    (queue_wait / prefill / ttft / itl / e2e — tpulab.obs registry) and
+    ring-buffer trace events at the host-side boundaries; pure host
+    timestamps, so every device-transfer contract above is unchanged.
+    ``obs=False`` silences both (the ``obs_overhead`` bench's A/B)."""
 
     def __init__(self, params, cfg: LabformerConfig, *, slots: int = 4,
                  n_blocks: int = 64, block_size: int = 16,
@@ -617,7 +666,7 @@ class PagedEngine:
                  attn: str = "gather", kv_dtype: str = "native",
                  spec_k: int = 0, spec_ngram: int = 3,
                  draft_params=None, draft_cfg=None, overlap: int = 1,
-                 interleave: bool = True):
+                 interleave: bool = True, obs: bool = True):
         if max_seq % block_size:
             raise ValueError("max_seq must be a multiple of block_size")
         if prefill_chunk < 0:
@@ -806,6 +855,12 @@ class PagedEngine:
         # so each tick checks only the 0-or-1 newly dead block instead
         # of rescanning every already-TRASHed entry
         self._retire_from = [0] * slots
+        # observability (tpulab.obs): ``obs=False`` silences BOTH the
+        # latency histograms and this engine's trace events (the
+        # obs_overhead bench's A/B knob); the trace handle is bound
+        # once here so the hot paths never branch on the flag for spans
+        self.obs = bool(obs)
+        self._trace = _obs_tracer.TRACER if self.obs else _obs_tracer.NULL
 
     def _init_dev_state(self):
         # DEVICE-allocated (jnp.zeros/ones, never jnp.asarray of a
@@ -976,6 +1031,7 @@ class PagedEngine:
         while len(self.free) < want_free and self.prefix_cache:
             _, blocks = self.prefix_cache.popitem(last=False)
             self.counters["evictions"] += 1
+            self._trace.event("engine.evict", len(blocks))
             for b in blocks:
                 self._deref(b)
 
@@ -1025,6 +1081,10 @@ class PagedEngine:
             # the prefix every tick and would inflate the hit rate
             self.counters["prefix_hits" if shared else "prefix_misses"] += 1
             self.counters["admissions"] += 1
+            req.t_admit = time.monotonic()
+            if self.obs:
+                _H_QUEUE_WAIT.observe(req.t_admit - req.t_submit)
+                self._trace.event("engine.admit", req.req_id)
             fresh = [self.free.pop() for _ in range(need_new)]
             for b in fresh:
                 self.block_refs[b] += 1
@@ -1073,6 +1133,10 @@ class PagedEngine:
                     self._draft_prefill_slot(s, req)
                 self._register_prefix(req.prompt, row)
                 req.phase = "decode"
+                if self.obs:
+                    # dispatch-side prefill wall time (the synchronous
+                    # path runs every chunk inline right here)
+                    _H_PREFILL.observe(time.monotonic() - req.t_admit)
                 self._push_slot(s, True)
 
     def _register_prefix(self, prompt: np.ndarray, row: np.ndarray):
@@ -1177,11 +1241,12 @@ class PagedEngine:
             self._note_dense_bucket(bucket)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :len(tail)] = tail
-        self.kpool, self.vpool = paged_extend(
-            self.params, jnp.asarray(padded), self.kpool, self.vpool,
-            jnp.asarray(self.tables[s]), start, len(tail),
-            self.cfg, self.block_size, bucket,
-        )
+        with self._trace.span("engine.prefill_chunk"):
+            self.kpool, self.vpool = paged_extend(
+                self.params, jnp.asarray(padded), self.kpool, self.vpool,
+                jnp.asarray(self.tables[s]), start, len(tail),
+                self.cfg, self.block_size, bucket,
+            )
         self.counters["prefill_chunks"] += 1
         self._stall_prefill_dispatches += 1
         return start + len(tail)
@@ -1229,10 +1294,11 @@ class PagedEngine:
             bucket = _bucket(self.prefill_chunk)
             padded = np.zeros((1, bucket), np.int32)
             padded[0, :n] = req.prompt[req.d_pf_pos:req.d_pf_pos + n]
-            self.d_kc, self.d_vc = _draft_extend(
-                self.draft_params, jnp.asarray(padded), self.d_kc,
-                self.d_vc, s, req.d_pf_pos, self.draft_cfg, bucket,
-            )
+            with self._trace.span("engine.prefill_chunk"):
+                self.d_kc, self.d_vc = _draft_extend(
+                    self.draft_params, jnp.asarray(padded), self.d_kc,
+                    self.d_vc, s, req.d_pf_pos, self.draft_cfg, bucket,
+                )
             req.d_pf_pos += n
             self.counters["prefill_chunks"] += 1
             self._stall_prefill_dispatches += 1
@@ -1253,6 +1319,11 @@ class PagedEngine:
         self.last_tok[s] = req.prompt[-1]
         self._register_prefix(req.prompt, self.tables[s])
         req.phase = "decode"
+        if self.obs:
+            # admission -> final chunk dispatched (host-side span of the
+            # interleaved prefill; the chunks themselves ride the async
+            # dispatch stream)
+            _H_PREFILL.observe(time.monotonic() - req.t_admit)
         self._push_slot(s, True)
 
     def _prefill_tick(self) -> List[int]:
@@ -1302,6 +1373,17 @@ class PagedEngine:
         """Append ONE committed token to slot ``s``; returns True when
         the request is done (stop byte / cancel / budget)."""
         tok = int(tok)
+        if self.obs:
+            now = time.monotonic()
+            if not req.out:
+                # first drained token: TTFT is host-observed — under
+                # overlap=1 it includes the one-tick drain delay, which
+                # is exactly what a streaming client experiences
+                _H_TTFT.observe(now - req.t_submit)
+                self._trace.event("engine.first_token", req.req_id)
+            elif req.t_last:
+                _H_ITL.observe(now - req.t_last)
+            req.t_last = now
         self.counters["tokens_out"] += 1
         req.out.append(tok)
         self.lengths[s] += 1
@@ -1317,6 +1399,9 @@ class PagedEngine:
         request instead of shrinking it, or this count would leak
         blocks).  TRASH entries are blocks the sliding-window retirement
         already released mid-decode."""
+        if self.obs:
+            _H_E2E.observe(time.monotonic() - req.t_submit)
+            self._trace.event("engine.retire", req.req_id)
         used = self._blocks_needed(len(req.prompt) + req.max_new)
         for b in self.tables[s, :used]:
             if int(b) != TRASH:
@@ -1397,10 +1482,12 @@ class PagedEngine:
         """Sync barrier: empty the async window (admission, the
         speculative path, and going idle all require host state to be
         CURRENT before proceeding)."""
-        if self._inflight:
-            self.counters["host_syncs"] += 1
-        while self._inflight:
-            self._drain_one(finished)
+        if not self._inflight:
+            return
+        self.counters["host_syncs"] += 1
+        with self._trace.span("engine.host_sync"):
+            while self._inflight:
+                self._drain_one(finished)
 
     def _spec_wanted(self) -> bool:
         # prefilling slots don't speculate yet: their first verify
@@ -1751,6 +1838,18 @@ class PagedEngine:
                 1 for r in self.active
                 if r is not None and r.phase == "prefill"),
         }
+
+    def publish_metrics(self) -> Dict[str, int]:
+        """Mirror :meth:`stats` into the process-global registry as
+        ``engine_<key>`` gauges and return the snapshot.  Scrape-path
+        only — never called per tick.  A process serving SEVERAL warm
+        engines must aggregate before publishing (the daemon's
+        ``metrics`` handler sums stats() across engines and calls
+        :func:`publish_engine_stats` once) — the gauges are unlabeled,
+        so concurrent per-engine publishes would overwrite each other."""
+        st = self.stats()
+        publish_engine_stats(st)
+        return st
 
     def run(self) -> Dict[int, np.ndarray]:
         """Drain queue + active slots; {req_id: generated tokens} for

@@ -1,0 +1,32 @@
+"""tpulab.obs — dependency-free observability: metrics + tracing.
+
+Two stdlib-only primitives the whole stack records into:
+
+* :mod:`tpulab.obs.registry` — process-global ``Counter`` / ``Gauge`` /
+  fixed-bucket ``Histogram`` registry with Prometheus text exposition
+  and copy-on-read snapshots.
+* :mod:`tpulab.obs.tracer` — preallocated ring-buffer timeline tracer
+  (``span``/``event``) with Chrome-trace JSON export for Perfetto.
+
+Both are safe on the serving/training hot paths by construction (O(1),
+allocation-free, no device syncs); the ``obs_overhead`` bench holds the
+combined cost under 3% of steady-state engine ticks/s.  Consumers:
+``tpulab.models.paged`` (per-request latency histograms + engine trace
+events), ``tpulab.daemon`` (``metrics``/``trace_dump`` requests),
+``tpulab.train`` (dispatch/loss-lag histograms), ``tools/obs_report.py``
+(percentile summaries from a scrape).
+"""
+
+from tpulab.obs.registry import (DEFAULT_BUCKETS, REGISTRY, Counter, Gauge,
+                                 Histogram, Registry, counter, gauge,
+                                 histogram, percentile_from_buckets,
+                                 render_prometheus)
+from tpulab.obs.tracer import (DEFAULT_CAPACITY, NULL, TRACER, Tracer,
+                               configure_tracer, event, span)
+
+__all__ = [
+    "DEFAULT_BUCKETS", "DEFAULT_CAPACITY", "REGISTRY", "Counter", "Gauge",
+    "Histogram", "NULL", "Registry", "TRACER", "Tracer", "configure_tracer",
+    "counter", "event", "gauge", "histogram", "percentile_from_buckets",
+    "render_prometheus", "span",
+]

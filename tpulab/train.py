@@ -290,6 +290,12 @@ def train(
     * ``log_every`` emits ``[train]`` lines every N steps (every step's
       loss is still finiteness-checked); the delayed drain preserves
       exact step/loss pairing in the emitted lines.
+
+    Observability: per-block dispatch-time and drained-loss-lag
+    histograms record into the process-global ``tpulab.obs`` registry
+    (``train_dispatch_seconds`` / ``train_loss_lag_seconds``), and a
+    ``[train] metrics`` percentile line emits at every eval/save
+    barrier and at the end of the run.
     """
     import jax
 
@@ -670,6 +676,32 @@ def train(
     # training analog of the paged engine's stats()
     pending: deque = deque()  # (first_step, k, device_losses, ms_per_step)
     counters = {"dispatches": 0, "fused_calls": 0, "host_syncs": 0}
+    # observability (tpulab.obs, same process-global registry the
+    # serving engine records into): per-block host dispatch time
+    # (batch build + jit dispatch — the cost the K-step fusion and the
+    # async window exist to hide) and drained-loss lag (dispatch ->
+    # finiteness check; under overlap=1 this is the staleness of every
+    # NaN detection).  A "[train] metrics" percentile line emits at
+    # each eval/save barrier and at the end of the run.
+    from tpulab.obs import TRACER as _trace
+    from tpulab.obs import histogram as _histogram
+
+    _h_dispatch = _histogram(
+        "train_dispatch_seconds",
+        "host time to build + dispatch one fused train block")
+    _h_loss_lag = _histogram(
+        "train_loss_lag_seconds",
+        "dispatch -> drained loss finiteness check, per block")
+
+    def _metrics_line() -> str:
+        # cumulative over the process (the registry is global by
+        # design — a daemon-hosted trainer scrapes the same way)
+        return ("[train] metrics "
+                f"dispatch_ms_p50={_h_dispatch.percentile(0.5) * 1e3:.2f} "
+                f"dispatch_ms_p99={_h_dispatch.percentile(0.99) * 1e3:.2f} "
+                f"loss_lag_ms_p50={_h_loss_lag.percentile(0.5) * 1e3:.2f} "
+                f"loss_lag_ms_p99={_h_loss_lag.percentile(0.99) * 1e3:.2f} "
+                f"blocks={_h_dispatch.count}")
     if donate:
         # materialize the state trees as device-OWNED buffers ONCE: the
         # donated step aliases them in place forever after.  Host numpy
@@ -715,12 +747,15 @@ def train(
         it cannot."""
         nonlocal loss, recoveries
         s0, k, ldev, t0 = pending.popleft()
-        vals = np.atleast_1d(np.asarray(jax.device_get(ldev)))
+        with _trace.span("train.drain"):
+            vals = np.atleast_1d(np.asarray(jax.device_get(ldev)))
         # dispatch -> drained wall time: covers device execution (the
         # fetch above completes it), so the logged per-step ms keeps
         # the old loop's meaning; under overlap it also absorbs the
         # next block's host build, which ran concurrently
-        ms = (time.perf_counter() - t0) * 1e3 / k
+        lag = time.perf_counter() - t0
+        _h_loss_lag.observe(lag)
+        ms = lag * 1e3 / k
         for j in range(k):
             s = s0 + j
             lv = float(vals[j])
@@ -762,16 +797,19 @@ def train(
             while step < steps:
                 t0 = time.perf_counter()
                 k = _block_len(step)
-                if k == 1:
-                    data = put(batch_at(step))
-                    params, opt_state, ldev = do_step(params, opt_state, data)
-                else:
-                    block = put(np.stack(
-                        [batch_at(step + j) for j in range(k)]))
-                    params, opt_state, ldev = do_step.step_k(
-                        params, opt_state, block)
-                    counters["fused_calls"] += 1
+                with _trace.span("train.dispatch"):
+                    if k == 1:
+                        data = put(batch_at(step))
+                        params, opt_state, ldev = do_step(
+                            params, opt_state, data)
+                    else:
+                        block = put(np.stack(
+                            [batch_at(step + j) for j in range(k)]))
+                        params, opt_state, ldev = do_step.step_k(
+                            params, opt_state, block)
+                        counters["fused_calls"] += 1
                 counters["dispatches"] += 1
+                _h_dispatch.observe(time.perf_counter() - t0)
                 pending.append((step, k, ldev, t0))
                 step += k
                 at_eval = bool(eval_every and step % eval_every == 0)
@@ -828,6 +866,10 @@ def train(
                         # would see the overwrite.  Undonated runs
                         # (--sanitize) keep the old async-save overlap.
                         manager.wait_until_finished()
+                if (at_eval or at_save) and counters["dispatches"]:
+                    # periodic observability line (eval/save cadence):
+                    # dispatch/loss-lag percentiles from the registry
+                    log(_metrics_line())
     finally:
         for _ld in _box.values():
             # IO failures during streaming degrade rows to token 0; the
@@ -846,6 +888,7 @@ def train(
             f"fused_calls={counters['fused_calls']} "
             f"host_syncs={counters['host_syncs']} "
             f"steps_per_call={steps_per_call} overlap={overlap}")
+        log(_metrics_line())
     if manager:
         manager.wait_until_finished()
         manager.close()

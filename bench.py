@@ -38,7 +38,7 @@ import sys
 HEADLINE_METRIC = "lab2_roberts_1024x1024_median_ms"
 
 
-def _backend_alive_with_retry() -> str | None:
+def _backend_alive_with_retry() -> dict | None:
     """Probe jax backend init across a relay-wedge-sized window.
 
     An orphaned chip claim wedges the relay for ~30 min (observed twice:
@@ -50,9 +50,18 @@ def _backend_alive_with_retry() -> str | None:
     hung probe is polled until ``TPULAB_BENCH_PROBE_WINDOW_S`` (default
     900s) and then ABANDONED (it exits by itself once the relay
     resolves); a probe that exits with an error (fail-fast UNAVAILABLE)
-    is retried with a fresh subprocess.  Progress lines go to stderr so
-    the stdout JSON contract is intact.
+    is retried with a fresh subprocess on a JITTERED backoff (several
+    bench/queue processes must not re-claim in lockstep the instant the
+    relay recovers).  Progress lines go to stderr so the stdout JSON
+    contract is intact.
+
+    Returns ``None`` when the backend is alive, else a CLEAN
+    relay-unreachable record (``error`` / ``attempts`` / ``elapsed_s``
+    / ``probe``) the caller embeds in the headline row — BENCH
+    artifacts then carry a diagnosable reason instead of bare nulls
+    (BENCH_r02–r05 regression).
     """
+    import random
     import subprocess
     import tempfile
     import time
@@ -96,13 +105,26 @@ def _backend_alive_with_retry() -> str | None:
             proc = None
             if (elapsed >= window_s
                     or not any(s in last_err for s in transient)):
-                return f"{last_err} (retried {attempt}x over {elapsed:.0f}s)"
-            time.sleep(min(30.0, max(1.0, window_s - elapsed)))
+                return {"error": f"{last_err} (retried {attempt}x over "
+                                 f"{elapsed:.0f}s)",
+                        "attempts": attempt, "elapsed_s": round(elapsed, 1),
+                        "probe": "exited"}
+            # bounded retries, exponential-ish growth with FULL JITTER:
+            # base doubles per attempt (capped at 30 s), the actual
+            # sleep draws uniformly below it so concurrent processes
+            # de-synchronize instead of re-dogpiling the relay
+            base = min(30.0, 2.0 ** min(attempt, 5))
+            time.sleep(min(max(1.0, random.uniform(base / 2, base)),
+                           max(1.0, window_s - elapsed)))
             # re-check the window BEFORE respawning: a probe spawned at
             # expiry would be abandoned milliseconds later and its real
             # error replaced by a bogus "relay wedged" diagnosis
-            if time.monotonic() - t0 >= window_s:
-                return f"{last_err} (retried {attempt}x, window exhausted)"
+            elapsed = time.monotonic() - t0  # the backoff sleep counts
+            if elapsed >= window_s:
+                return {"error": f"{last_err} (retried {attempt}x, window "
+                                 f"exhausted)",
+                        "attempts": attempt, "elapsed_s": round(elapsed, 1),
+                        "probe": "exited"}
         elif elapsed >= window_s:
             # still hanging at the claim: leave it running (never kill a
             # pending claim) — it exits on its own when the relay grants
@@ -110,8 +132,11 @@ def _backend_alive_with_retry() -> str | None:
             print(f"[bench] probe still pending after {elapsed:.0f}s — "
                   f"abandoned unkilled (claim discipline)",
                   file=sys.stderr, flush=True)
-            return (f"backend init still pending after {elapsed:.0f}s "
-                    f"(TPU relay wedged?); probe left to finish, not killed")
+            return {"error": f"backend init still pending after "
+                             f"{elapsed:.0f}s (TPU relay wedged?); probe "
+                             f"left to finish, not killed",
+                    "attempts": attempt, "elapsed_s": round(elapsed, 1),
+                    "probe": "abandoned-pending"}
         else:
             time.sleep(5.0)
 
@@ -342,14 +367,24 @@ def main(argv=None) -> int:
         return 0
 
     if not args.skip_probe:
-        err = _backend_alive_with_retry()
-        if err:
+        relay = _backend_alive_with_retry()
+        if relay is not None:
+            # a CLEAN relay-unreachable record, not a bare null: its
+            # own `relay_status` row (machine-greppable in the BENCH
+            # json tail) plus the headline row carrying the structured
+            # reason + the last committed measurement for context
+            print(json.dumps({
+                "metric": "relay_status", "value": "unreachable",
+                **relay}), flush=True)
             row = {
                 "metric": HEADLINE_METRIC,
                 "value": None,
                 "unit": "ms",
                 "vs_baseline": None,
-                "error": err,
+                "error": relay["error"],
+                "relay": {"status": "unreachable",
+                          "attempts": relay["attempts"],
+                          "elapsed_s": relay["elapsed_s"]},
             }
             last = _last_good_headline()
             if last is not None:

@@ -44,6 +44,11 @@ sys.path.insert(0, str(ROOT))
 
 from tpulab.obs.registry import percentile_from_buckets  # noqa: E402
 
+#: shed response contract (tpulab.daemon.ShedError): an error frame
+#: whose body starts with this line is BACKPRESSURE, not a failure —
+#: honor the retry-after and try again inside the caller's deadline
+_SHED_RE = re.compile(r"shed retry_after_ms=(\d+)")
+
 #: histograms the summary table reports, in display order
 _LATENCY_METRICS = ("ttft_seconds", "itl_seconds", "e2e_seconds",
                     "queue_wait_seconds", "prefill_seconds")
@@ -89,6 +94,52 @@ def request(sock_path: str, lab: str, config: dict | None = None,
             return out
     finally:
         s.close()
+
+
+class ShedResponse(RuntimeError):
+    """The daemon rejected the request with retry-after (load
+    shedding).  ``retry_after_ms`` is the daemon's backoff hint."""
+
+    def __init__(self, retry_after_ms: int, body: str):
+        self.retry_after_ms = retry_after_ms
+        super().__init__(body)
+
+
+def request_with_retry(sock_path: str, lab: str, config: dict | None = None,
+                       payload: bytes = b"", *, deadline_s: float = 30.0,
+                       base_backoff_s: float = 0.05,
+                       rng: "random.Random | None" = None) -> bytes:
+    """:func:`request` with client-side resilience: connect/send
+    failures retry on exponential backoff with full jitter, and a shed
+    response (``shed retry_after_ms=N``) honors the daemon's
+    retry-after hint — all bounded by an absolute ``deadline_s``.  The
+    last error is re-raised once the deadline is spent, so a genuinely
+    dead daemon still fails loudly instead of looping forever."""
+    import random
+    import time
+
+    rng = rng or random.Random()
+    t0 = time.monotonic()
+    attempt = 0
+    while True:
+        try:
+            return request(sock_path, lab, config, payload)
+        except (ConnectionError, OSError, RuntimeError) as e:
+            shed = _SHED_RE.search(str(e))
+            if shed is None and not isinstance(e, (ConnectionError, OSError)):
+                raise  # a real daemon-side error: retrying cannot help
+            attempt += 1
+            if shed is not None:
+                wait = int(shed.group(1)) / 1e3
+            else:
+                # exponential backoff, full jitter: concurrent clients
+                # must not re-dogpile a recovering daemon in lockstep
+                wait = rng.uniform(0, base_backoff_s * (2 ** min(attempt, 6)))
+            if time.monotonic() + wait - t0 > deadline_s:
+                if shed is not None:
+                    raise ShedResponse(int(shed.group(1)), str(e)) from e
+                raise
+            time.sleep(wait)
 
 
 def parse_prometheus(text: str) -> dict:
@@ -153,14 +204,17 @@ def summarize(metrics: dict) -> list:
     return rows
 
 
-def drive(sock_path: str, n: int, steps: int) -> None:
+def drive(sock_path: str, n: int, steps: int,
+          deadline_s: float = 120.0) -> None:
     """Send ``n`` small generate requests (shared system-prompt prefix,
     so the scrape also exercises prefix hits) to populate the
-    histograms on a fresh daemon."""
+    histograms on a fresh daemon.  Each request rides
+    :func:`request_with_retry`, so transient connect failures and shed
+    responses back off and retry instead of killing the capture."""
     prompt = (b"observability scrape warmup: " * 3)[:64]
     for i in range(n):
-        request(sock_path, "generate", {"steps": steps},
-                prompt + str(i).encode())
+        request_with_retry(sock_path, "generate", {"steps": steps},
+                           prompt + str(i).encode(), deadline_s=deadline_s)
 
 
 def main(argv=None) -> int:

@@ -25,32 +25,19 @@ cd /root/repo || exit 1
 L=results/logs
 mkdir -p "$L"
 
-wait_relay() {
-  while true; do
-    if [ -n "$PRIOR_PROBE_PID" ] && kill -0 "$PRIOR_PROBE_PID" 2>/dev/null; then
-      sleep 60
-      continue
-    fi
-    if grep -q compile-ok /tmp/queue_probe.out 2>/dev/null; then
-      # consume the sentinel so every LATER stage re-probes (the relay
-      # can drop again between stages)
-      PRIOR_PROBE_PID=""
-      rm -f /tmp/queue_probe.out
-      return 0
-    fi
-    PRIOR_PROBE_PID=""
-    python -c "import jax, jax.numpy as jnp; x = jnp.ones((128, 128)); (x @ x).block_until_ready(); print('compile-ok')" \
-        > /tmp/queue_probe.out 2>&1
-    # loop re-checks the probe output; a failed probe (relay down but
-    # fast-failing) falls through to another attempt after the check
-    grep -q compile-ok /tmp/queue_probe.out 2>/dev/null || sleep 120
-  done
-}
+# wait_relay comes from the shared relay library (bounded/jittered probe
+# loop, claim discipline) — one copy instead of a per-round paste
+. "$(dirname "$0")/relay_lib.sh"
 
 stage() {  # stage <name> <cmd...>
   name=$1; shift
   echo "== $name wait-relay $(date)" >> $L/queue.status
-  wait_relay
+  if ! wait_relay; then
+    # bounded mode (WAIT_RELAY_MAX_S) gave up: skip the stage instead
+    # of launching a TPU claim against a known-down relay
+    echo "== $name SKIPPED (relay unreachable) $(date)" >> $L/queue.status
+    return 1
+  fi
   echo "== $name start $(date)" >> $L/queue.status
   "$@" > "$L/$name.log" 2>&1
   echo "== $name rc=$? $(date)" >> $L/queue.status

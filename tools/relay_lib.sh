@@ -1,0 +1,68 @@
+#!/bin/bash
+# relay_lib.sh — THE one wait_relay used by every on-chip evidence queue
+# (tools/onchip_queue*.sh source this; the copy-pasted per-round
+# variants drifted for five rounds before being factored here).
+#
+# Claim discipline (docs/tpu_runs.md): TPU-claiming processes are
+# WAITED on, never killed — a killed claim wedges the relay for every
+# later process.  If a previous round's queue left a probe pending (its
+# PID in $PRIOR_PROBE_PID, output at /tmp/queue_probe.out), that claim
+# is REUSED as the relay sentinel instead of stacking a second claim
+# behind it.
+#
+# wait_relay blocks until a compiled-matmul probe succeeds.  Retries
+# back off with JITTER (base sleep +/- up to 25%) so several queues or
+# a queue racing the bench probe don't re-claim in lockstep the moment
+# the relay recovers.  Optionally bounded: set WAIT_RELAY_MAX_S > 0 and
+# wait_relay returns 1 after that many seconds, appending a clean
+# "RELAY UNREACHABLE" record to $RELAY_STATUS_LOG (default
+# results/logs/queue.status) instead of parking the queue forever —
+# the caller decides whether to skip the stage or abort.
+#
+# Usage:   . "$(dirname "$0")/relay_lib.sh"   # then: wait_relay || ...
+
+_relay_jitter_sleep() {  # _relay_jitter_sleep BASE_SECONDS REMAINING_S
+  local base=$1 remaining=${2:-0}
+  # +/- up to 25% of base, from $RANDOM (0..32767)
+  local span=$((base / 2)) off=0
+  [ "$span" -gt 0 ] && off=$((RANDOM % (span + 1)))
+  local s=$((base - span / 2 + off))
+  # a bounded wait never oversleeps its own deadline (the bound is
+  # re-checked at the top of the loop, so cap at remaining + 1)
+  if [ "$remaining" -gt 0 ] && [ "$s" -gt $((remaining + 1)) ]; then
+    s=$((remaining + 1))
+  fi
+  sleep "$s"
+}
+
+wait_relay() {
+  local t0=$(date +%s) max="${WAIT_RELAY_MAX_S:-0}" status_log remaining=0
+  status_log="${RELAY_STATUS_LOG:-results/logs/queue.status}"
+  while true; do
+    if [ "$max" -gt 0 ]; then
+      remaining=$((max - ($(date +%s) - t0)))
+      if [ "$remaining" -le 0 ]; then
+        echo "== RELAY UNREACHABLE after ${max}s $(date)" >> "$status_log"
+        return 1
+      fi
+    fi
+    if [ -n "$PRIOR_PROBE_PID" ] && kill -0 "$PRIOR_PROBE_PID" 2>/dev/null; then
+      _relay_jitter_sleep 60 "$remaining"
+      continue
+    fi
+    if grep -q compile-ok /tmp/queue_probe.out 2>/dev/null; then
+      # consume the sentinel so every LATER stage re-probes (the relay
+      # can drop again between stages)
+      PRIOR_PROBE_PID=""
+      rm -f /tmp/queue_probe.out
+      return 0
+    fi
+    PRIOR_PROBE_PID=""
+    python -c "import jax, jax.numpy as jnp; x = jnp.ones((128, 128)); (x @ x).block_until_ready(); print('compile-ok')" \
+        > /tmp/queue_probe.out 2>&1
+    # loop re-checks the probe output; a failed probe (relay down but
+    # fast-failing) falls through to another attempt after the check
+    grep -q compile-ok /tmp/queue_probe.out 2>/dev/null \
+        || _relay_jitter_sleep 120 "$remaining"
+  done
+}

@@ -612,6 +612,96 @@ def bench_obs_overhead(
     }
 
 
+def bench_fault_overhead(
+    slots: int = 4, steps: int = 96, reps: int = 5
+) -> Dict[str, Any]:
+    """Fault-injection tax on the serving hot path: steady-state engine
+    ticks/s with the injector DISABLED (the production default — one
+    module-global read and branch per site) vs ENABLED with a schedule
+    that never matches (per-site locked hit counting, the injector's
+    full bookkeeping).  Same mid-generation window as
+    ``bench_obs_overhead``.
+
+    The ISSUE budget is <1% for the DISABLED path; the enabled-idle
+    configuration measured here is a strict UPPER bound on it (it runs
+    everything the disabled path runs plus the per-site counting), so
+    the assert below — best-of-reps, as in ``bench_obs_overhead``, to
+    isolate intrinsic cost from scheduler noise — gates the stronger
+    claim.  The reported value is the disabled-injector ticks/s (the
+    production configuration), gated in baselines.json like
+    ``obs_overhead``."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpulab import faults
+    from tpulab.models.labformer import LabformerConfig, init_params
+    from tpulab.models.paged import PagedEngine
+    from tpulab.runtime.device import default_device
+
+    cfg = LabformerConfig(d_model=64, n_heads=4, n_layers=2, d_ff=128,
+                          max_seq=256, dtype=jnp.float32)
+    device = default_device()
+    params = jax.device_put(init_params(cfg, seed=0), device)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab, (8,)).astype(np.int32)
+               for _ in range(slots)]
+    warm = 6
+    #: a rule that can never fire: the injector still pays its full
+    #: per-site hit accounting on every engine site
+    idle_schedule = [{"site": "bench.never", "kind": "raise", "at": 1}]
+
+    def window(inject_on: bool):
+        if inject_on:
+            faults.configure(idle_schedule)
+        else:
+            faults.disable()
+        eng = PagedEngine(params, cfg, slots=slots, n_blocks=64,
+                          block_size=16, max_seq=256, obs=False)
+        for p in prompts:  # budget outlives warm + timed window
+            eng.submit(p, max_new=warm + steps + 4)
+        for _ in range(warm):  # admission + compile outside the window
+            eng.step()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            eng.step()
+        return time.perf_counter() - t0
+
+    try:
+        for on in (False, True):
+            window(on)  # compile prefill bucket + paged_tick
+        times = {False: [], True: []}
+        for attempt in range(3):
+            for _ in range(max(reps, 3)):
+                for on in (False, True):
+                    times[on].append(window(on))
+            best_overhead = min(times[True]) / min(times[False]) - 1.0
+            if best_overhead < 0.01:
+                break  # as in bench_obs_overhead: extra attempts only
+                # merge more samples into both mins, so a transient
+                # load spike cannot fail a budget a quiet window passes
+    finally:
+        faults.disable()
+    t_on = float(np.median(times[True]))
+    t_off = float(np.median(times[False]))
+    assert best_overhead < 0.01, (
+        f"fault-injection overhead {best_overhead * 100:.2f}% exceeds the "
+        f"1% budget (enabled-idle={min(times[True]):.4f}s "
+        f"disabled={min(times[False]):.4f}s)")
+    return {
+        "metric": f"fault_overhead_{slots}slots_ticks_per_s",
+        "value": round(steps / t_off, 1),
+        "unit": "ticks/s",
+        "vs_baseline": None,
+        "enabled_idle_ticks_per_s": round(steps / t_on, 1),
+        "overhead_pct_median": round((t_on / t_off - 1.0) * 100, 2),
+        "overhead_pct_best": round(best_overhead * 100, 2),
+        "device": device.platform,
+        **variance_fields([t * 1e3 for t in times[False]]),
+    }
+
+
 def bench_train_step(
     steps: int = 48, k: int = 8, reps: int = 5, b: int = 1, s: int = 16
 ) -> Dict[str, Any]:
@@ -875,6 +965,7 @@ def run_benchmarks(only: Optional[str] = None, yield_markers: bool = False,
         "paged_tick_overhead": bench_paged_tick,
         "prefill_interleave": bench_prefill_interleave,
         "obs_overhead": bench_obs_overhead,
+        "fault_overhead": bench_fault_overhead,
         "train_step_overhead": bench_train_step,
         "labvision_train": bench_labvision_train,
         "hw2_sort": bench_sort,

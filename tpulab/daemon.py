@@ -30,6 +30,22 @@ gauges summed across the warm engines — scrape with
 Chrome trace-event JSON (loads in Perfetto; size with
 ``--trace-buffer N``).
 
+Fault tolerance (round 11): a generate request may carry
+``deadline_ms`` (queue-wait-based load shedding: once a queue exists
+and the observed ``queue_wait`` p99 blows the budget, the daemon
+answers an error frame whose body is the parseable line ``shed
+retry_after_ms=<int> (...)`` — backpressure, not failure; the engines'
+pending queues are bounded the same way via
+``TPULAB_DAEMON_MAX_PENDING``) and ``priority`` (KV-pressure
+preemption rank — a strictly-higher-priority request may evict a
+lower-priority slot, which later resumes from its committed prefix).
+A crashed engine step loop is SUPERVISED: quarantine, rebuild from the
+engine's build recipe, and replay of the in-flight requests from their
+snapshots (greedy streams bit-identical to an uninterrupted run;
+``TPULAB_DAEMON_REPLAY_BUDGET`` rebuilds per request before the
+failure surfaces).  ``daemon_engine_restarts`` / ``daemon_replays`` /
+``daemon_shed_requests`` count it all in the ``metrics`` scrape.
+
 Run: ``python -m tpulab.daemon --socket /tmp/tpulab.sock``
 Stop: SIGTERM/SIGINT, or an empty header (client disconnect is fine too).
 """
@@ -47,6 +63,9 @@ import threading
 import time
 import traceback
 from typing import Optional
+
+from tpulab import faults as _faults
+from tpulab import obs as _obs
 
 
 # Wire-size ceilings: the length prefixes are attacker-controlled (any
@@ -146,6 +165,48 @@ _SPEC_K = 4
 #: overrides the daemon-wide default at startup.
 PREFILL_CHUNK = 32
 
+#: bounded admission: each serving engine's pending queue caps here and
+#: submit-past-the-bound sheds with retry-after instead of growing an
+#: unbounded backlog no request in it could meet a deadline through
+MAX_PENDING = int(os.environ.get("TPULAB_DAEMON_MAX_PENDING", "64"))
+
+#: supervisor replay budget: how many engine rebuilds a single request
+#: may ride through before its failure is surfaced to the waiter
+REPLAY_BUDGET = int(os.environ.get("TPULAB_DAEMON_REPLAY_BUDGET", "2"))
+
+#: shedding looks at the queue-wait p99 over (roughly) the last window,
+#: not the process-lifetime histogram: a congestion spell an hour ago
+#: must not shed deadline traffic against an idle daemon forever
+QUEUE_WAIT_WINDOW_S = float(
+    os.environ.get("TPULAB_DAEMON_QUEUE_WAIT_WINDOW_S", "60"))
+
+#: fault-tolerance counters (process-global registry, in every
+#: ``metrics`` scrape): engine step loops quarantined+rebuilt, requests
+#: replayed into a rebuilt engine, and requests shed with retry-after
+_C_RESTARTS = _obs.counter(
+    "daemon_engine_restarts",
+    "engine step loops quarantined and rebuilt by the supervisor")
+_C_REPLAYS = _obs.counter(
+    "daemon_replays", "in-flight requests replayed into a rebuilt engine")
+_C_SHED = _obs.counter(
+    "daemon_shed_requests",
+    "requests rejected with retry-after (deadline/backpressure shedding)")
+
+
+class ShedError(RuntimeError):
+    """Load shedding: the request was REJECTED before admission (queue
+    at its bound, or the observed queue-wait p99 already blows the
+    request's ``deadline_ms``).  The daemon renders it as an error
+    frame whose body starts with ``shed retry_after_ms=<int>`` — a
+    stable, parseable contract clients (tools/obs_report.py) retry on
+    with backoff instead of treating as a hard failure."""
+
+    def __init__(self, retry_after_ms: int, why: str):
+        self.retry_after_ms = int(retry_after_ms)
+        super().__init__(
+            f"shed retry_after_ms={self.retry_after_ms} ({why})")
+
+
 #: serializes the remaining host-orchestrated single-stream strategy
 #: (beam search: many small dispatches; running two at once thrashes
 #: the device queue).  Speculative decoding no longer takes this lock —
@@ -198,13 +259,26 @@ class _EngineState:
 
     ``cancelled`` holds rids whose waiter gave up (streaming client
     died): the stepper discards their finished output instead of
-    parking it in ``results`` forever."""
+    parking it in ``results`` forever.
 
-    def __init__(self):
+    ``engine`` is the CURRENT engine this state's requests live in —
+    the supervisor swaps it on a quarantine+rebuild, and every cancel
+    path routes through it (a rid cancelled against the quarantined
+    engine object would otherwise miss the replayed copy and leak into
+    the rebuilt engine's replay set).  ``retries`` is the per-request
+    replay budget the supervisor charges on each rebuild."""
+
+    def __init__(self, engine=None):
         self.cond = threading.Condition()
         self.results: dict = {}
         self.cancelled: set = set()
+        self.retries: dict = {}
         self.stepper_alive = False
+        self.engine = engine
+        # True while the supervisor is rebuilding this state's engine:
+        # submitters must park (a submit into the quarantined object
+        # would be stranded — its pending list was already harvested)
+        self.rebuilding = False
 
 
 class _GenerateService:
@@ -240,18 +314,82 @@ class _GenerateService:
         import weakref
 
         self._states: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+        # rolling queue-wait snapshot marks for the windowed shed p99
+        # [(t_monotonic, cumulative bucket counts)], at most two
+        self._qw_marks: list = []
 
     def _state_for(self, engine) -> _EngineState:
         with self.lock:
             st = self._states.get(engine)
             if st is None:
-                st = self._states[engine] = _EngineState()
+                st = self._states[engine] = _EngineState(engine)
+            # prime the queue-wait window baseline: the histogram
+            # exists once any engine does (paged registers it at
+            # import), and shedding wants deltas from HERE on
+            if not self._qw_marks:
+                h = _obs.REGISTRY.get("queue_wait_seconds")
+                if h is not None:
+                    self._qw_marks.append(
+                        (time.monotonic(), h.snapshot()["counts"]))
             return st
+
+    def _queue_wait_p99_ms(self) -> float:
+        """Queue-wait p99 over (roughly) the last
+        ``QUEUE_WAIT_WINDOW_S`` — computed by differencing the
+        cumulative histogram against a rolling snapshot mark, so the
+        estimate DECAYS: a congestion spell long past cannot shed
+        deadline traffic against an idle daemon forever (the
+        process-lifetime p99 never comes back down).  The base mark is
+        between one and two windows old; 0.0 when nothing was observed
+        inside it."""
+        from tpulab.obs.registry import percentile_from_buckets
+
+        h = _obs.REGISTRY.get("queue_wait_seconds")
+        if h is None:
+            return 0.0
+        snap = h.snapshot()
+        now = time.monotonic()
+        with self.lock:
+            # roll: keep at most two marks, one per window boundary
+            if not self._qw_marks or (
+                    now - self._qw_marks[-1][0] >= QUEUE_WAIT_WINDOW_S):
+                self._qw_marks.append((now, snap["counts"]))
+                self._qw_marks = self._qw_marks[-2:]
+            base = self._qw_marks[0][1]
+        delta = [c - b for c, b in zip(snap["counts"], base)]
+        if sum(delta) <= 0:
+            return 0.0
+        return percentile_from_buckets(h.bounds, delta, 0.99) * 1e3
+
+    def _retry_after_ms(self, p99_ms: Optional[float] = None) -> int:
+        """Retry-after hint for a shed response: the recent-window
+        queue-wait p99 (what a request would have waited anyway),
+        clamped to [50 ms, 5 s]."""
+        if p99_ms is None:
+            p99_ms = self._queue_wait_p99_ms()
+        return int(min(5000.0, max(50.0, p99_ms)))
+
+    def _shed_check(self, engine, deadline_ms) -> None:
+        """Deadline-aware admission control (caller holds st.cond):
+        once there IS a queue and the recent-window ``queue_wait`` p99
+        already exceeds the request's ``deadline_ms`` budget, admitting
+        it would only add a request that cannot meet its deadline to
+        everyone else's wait — reject with retry-after instead."""
+        if deadline_ms is None or not engine.pending:
+            return
+        p99_ms = self._queue_wait_p99_ms()
+        if p99_ms > float(deadline_ms):
+            _C_SHED.inc()
+            raise ShedError(
+                self._retry_after_ms(p99_ms),
+                f"queue_wait p99 {p99_ms:.0f}ms exceeds deadline_ms "
+                f"{deadline_ms:g}")
 
     def generate(self, engine, prompt, steps: int, *,
                  temperature: float = 0.0, seed: int = 0,
                  repetition_penalty: float = 1.0, stop_byte: int = -1,
                  spec: str = "off", spec_k: int = 0, spec_ngram: int = 0,
+                 deadline_ms=None, priority: int = 0,
                  on_progress=None):
         """Block until the request finishes; returns the full token
         array.  ``on_progress(new_tokens)``, if given, is called with
@@ -262,13 +400,26 @@ class _GenerateService:
         streamed stop byte already went out) and the call returns the
         tokens produced so far: the slot frees at the next tick instead
         of decoding the remaining ``steps`` budget into the void."""
+        from tpulab.models.paged import QueueFullError
+
         st = self._state_for(engine)
         with st.cond:
-            rid = engine.submit(prompt, max_new=steps,
-                                temperature=temperature, seed=seed,
-                                repetition_penalty=repetition_penalty,
-                                stop_byte=stop_byte, spec=spec,
-                                spec_k=spec_k, spec_ngram=spec_ngram)
+            while st.rebuilding:  # park until the supervisor swaps in
+                st.cond.wait()    # the replacement engine
+            engine = st.engine  # supervision may have swapped the object
+            self._shed_check(engine, deadline_ms)
+            try:
+                rid = engine.submit(prompt, max_new=steps,
+                                    temperature=temperature, seed=seed,
+                                    repetition_penalty=repetition_penalty,
+                                    stop_byte=stop_byte, spec=spec,
+                                    spec_k=spec_k, spec_ngram=spec_ngram,
+                                    priority=priority)
+            except QueueFullError as e:
+                # bounded admission queue: backpressure surfaces as a
+                # shed-with-retry-after, never unbounded growth
+                _C_SHED.inc()
+                raise ShedError(self._retry_after_ms(), str(e)) from e
             req = engine.pending[-1]  # just appended under this cond
             if not st.stepper_alive:
                 st.stepper_alive = True
@@ -289,6 +440,8 @@ class _GenerateService:
                     inc = list(req.out[sent:])
                     sent = len(req.out)
                     out = st.results.pop(rid) if done else None
+                    if done:
+                        st.retries.pop(rid, None)  # budget ends with it
                 if inc and on_progress is not None:
                     if on_progress(inc) and not done:
                         # early stop: finish through the NORMAL path
@@ -296,7 +449,7 @@ class _GenerateService:
                         # block count releases exactly) — NOT st.cancelled,
                         # because this waiter is alive and wants the output
                         with st.cond:
-                            engine.cancel(rid)
+                            st.engine.cancel(rid)
                 if done:
                     if isinstance(out, Exception):
                         raise RuntimeError(
@@ -308,21 +461,37 @@ class _GenerateService:
             # would finish anyway and its output would sit in
             # st.results forever — a per-aborted-stream leak.
             with st.cond:
+                st.retries.pop(rid, None)
                 if rid in st.results:
                     st.results.pop(rid)
-                elif engine.cancel(rid) == "active":
-                    # finishes through the NORMAL path next tick (so
-                    # admission's block count releases exactly); the
-                    # stepper discards the output via the cancelled
-                    # set.  "pending"/"gone" need no discard — nothing
-                    # of theirs will ever reach st.results.
-                    st.cancelled.add(rid)
+                else:
+                    # the cancel routes through st.engine, not the
+                    # submit-time object: after a supervisor rebuild
+                    # the request lives in the REPLACEMENT engine, and
+                    # cancelling the quarantined one would leak the
+                    # replayed copy.
+                    where = st.engine.cancel(rid)
+                    if where == "active" or (
+                            where == "gone" and st.rebuilding):
+                        # "active": finishes through the NORMAL path
+                        # next tick (so admission's block count
+                        # releases exactly); the stepper discards the
+                        # output via the cancelled set.  "gone" while
+                        # REBUILDING: the request sits in the
+                        # supervisor's replay set — flag it so the
+                        # resubmit loop drops it instead of replaying
+                        # for a dead waiter.  "pending"/plain-"gone"
+                        # need no discard — nothing of theirs will
+                        # ever reach st.results.
+                        st.cancelled.add(rid)
             raise
 
     def _step_loop(self, engine, st: _EngineState):
         try:
             while True:
                 with st.cond:
+                    if _faults.ACTIVE:
+                        _faults.fire("daemon.step")
                     if (not engine.pending and not engine.inflight_depth
                             and not any(
                                 r is not None for r in engine.active)):
@@ -353,26 +522,148 @@ class _GenerateService:
             # checked against stats()) so this line and the
             # generate_stats/metrics surfaces cannot drift.
             print("[serve] wave done: " + _counters_line(row), flush=True)
-        except Exception as e:  # fail every request; never hang waiters
-            with st.cond:
-                for req in list(engine.pending) + [
-                    r for r in engine.active if r is not None
-                ]:
-                    st.results[req.req_id] = e
-                engine.pending.clear()
-                engine.active = [None] * engine.slots
+        except Exception as e:
+            # SUPERVISOR: quarantine the engine, rebuild it from its
+            # build recipe, and replay the in-flight requests from
+            # their snapshots — a single step-loop fault no longer
+            # fails every rider.  Requests out of replay budget (and
+            # everyone, if no rebuild recipe exists or the rebuild
+            # itself fails) surface the error; waiters NEVER hang.
+            self._supervise(engine, st, e)
+
+    def _quarantine(self, engine):
+        """Drop a failed engine from the warm cache so no new request
+        can land in it (threads already holding it keep the one
+        state/Condition they submitted under — at most one stepper per
+        engine; the WeakKeyDictionary reclaims the state when the
+        engine itself is garbage-collected)."""
+        with self.lock:
+            for k, v in list(_ENGINES.items()):
+                if v[1] is engine:
+                    _ENGINES.pop(k)
+
+    def _supervise(self, engine, st: _EngineState, err: Exception):
+        """Engine step loop died: quarantine + rebuild + replay.
+
+        Under ``st.cond``, the in-flight set is stripped off the dead
+        engine: results the failed step already produced are published;
+        rids whose waiter is gone (``st.cancelled``) are DISCARDED —
+        the satellite fix: a rid cancelled after its engine was
+        quarantined must not leak into the rebuilt engine's replay set;
+        cancelled-but-waited requests (streamed stop byte already out)
+        complete with the tokens they have; everything else is charged
+        one replay (budget ``REPLAY_BUDGET``) and resubmitted into the
+        replacement engine — ``PagedEngine.resubmit`` resumes each from
+        its snapshot, so greedy streams stay bit-identical to an
+        uninterrupted run and sampled streams continue their per-slot
+        key chain.  The replacement is built OUTSIDE the condition
+        (cold build must not block waiters' wakeups) from the recipe
+        ``_engine_for`` left on the engine (``_rebuild``); an engine
+        built without one (direct construction) degrades to the old
+        fail-every-request behavior."""
+        import numpy as np
+
+        _C_RESTARTS.inc()
+        self._quarantine(engine)
+        rebuild = getattr(engine, "_rebuild", None)
+        with st.cond:
+            # results a partially-completed step already banked: these
+            # requests are DONE (released, blocks freed) — publish, do
+            # not replay.  engine._done is normally popped by the
+            # stepper per step() return; a mid-step fault strands them.
+            for rid, out in list(engine._done.items()):
+                if rid in st.cancelled:
+                    st.cancelled.discard(rid)
+                else:
+                    st.results[rid] = out
+            engine._done.clear()
+            survivors = list(engine.pending) + [
+                r for r in engine.active if r is not None]
+            engine.pending.clear()
+            engine.active = [None] * engine.slots
+            engine._inflight.clear()  # dead device buffers
+            replay, failed = [], []
+            for req in survivors:
+                rid = req.req_id
+                if rid in st.cancelled:
+                    # waiter abandoned this rid (possibly AFTER the
+                    # quarantine): drop it here, never replay it
+                    st.cancelled.discard(rid)
+                    continue
+                if req.cancelled:
+                    # waiter is alive but already satisfied (early
+                    # stop): complete with the tokens it has, exactly
+                    # what the next tick would have done
+                    st.results[rid] = np.asarray(req.out, np.int32)
+                    continue
+                st.retries[rid] = st.retries.get(rid, 0) + 1
+                if st.retries[rid] > REPLAY_BUDGET or rebuild is None:
+                    failed.append(req)
+                else:
+                    replay.append(req)
+            for req in failed:
+                st.results[req.req_id] = err
+                st.retries.pop(req.req_id, None)
+            if not replay:
                 st.stepper_alive = False
                 st.cond.notify_all()
-            # the engine leaves the cache (new requests get a fresh
-            # engine) but its state is NOT popped: a thread already
-            # holding this engine keeps the one state/Condition it
-            # submitted under, so at most one stepper can ever run per
-            # engine; the WeakKeyDictionary reclaims the state when the
-            # engine itself is garbage-collected
-            with self.lock:
-                for k, v in list(_ENGINES.items()):
-                    if v[1] is engine:
-                        _ENGINES.pop(k)
+                return
+            st.rebuilding = True  # park submitters off the dead object
+            st.cond.notify_all()  # wake waiters for published results
+        try:
+            new_engine, tok = rebuild()
+        except Exception as build_err:
+            with st.cond:
+                for req in replay:
+                    st.results[req.req_id] = build_err
+                st.stepper_alive = False
+                st.rebuilding = False
+                st.cond.notify_all()
+            return
+        with self.lock:
+            # register the state BEFORE the engine becomes visible, so
+            # a racing submitter that finds it in the cache lands on
+            # THIS condition/stepper; if another thread already rebuilt
+            # the same key, ours stays private to the replayed requests
+            self._states[new_engine] = st
+            key = getattr(new_engine, "_build_key", None)
+            if key is not None and key not in _ENGINES:
+                _ENGINES[key] = (getattr(new_engine, "_build_stamp", None),
+                                 new_engine, tok)
+        if (any(r.spec == "draft" for r in replay)
+                and new_engine.draft_params is None
+                and new_engine.spec_k):
+            # a replayed dense-draft speculative request needs the
+            # rebuilt engine's int8 draft installed up front (the
+            # normal path builds it lazily per request); built OUTSIDE
+            # the condition like _handle_generate does.  A replacement
+            # without spec capability degrades those requests to plain
+            # ticks — greedy streams are identical either way.
+            new_engine.set_draft(_draft_for(new_engine), new_engine.cfg)
+        with st.cond:
+            st.engine = new_engine
+            st.rebuilding = False
+            for req in replay:
+                if req.req_id in st.cancelled:
+                    # the waiter abandoned during the rebuild window —
+                    # nothing to replay for, nothing to park
+                    st.cancelled.discard(req.req_id)
+                    continue
+                if req.cancelled:
+                    # cancelled mid-rebuild but the waiter is alive
+                    # (early stop): complete with the tokens it has
+                    st.results[req.req_id] = np.asarray(req.out, np.int32)
+                    continue
+                new_engine.resubmit(req)
+                _C_REPLAYS.inc()
+            st.stepper_alive = True
+            threading.Thread(
+                target=self._step_loop, args=(new_engine, st), daemon=True
+            ).start()
+            st.cond.notify_all()
+        print(f"[serve] engine restart: replayed {len(replay)} "
+              f"request(s), failed {len(failed)} "
+              f"({type(err).__name__}: {err})", flush=True)
 
 
 _GEN_SERVICE = _GenerateService()
@@ -441,9 +732,6 @@ def _engine_for(ckpt, attn: str = "gather", kv_dtype: str = "native",
     build (checkpoint restore + pool allocation) runs OUTSIDE it so
     in-flight decode ticks never stall behind a load; a lost build race
     reuses the winner's engine."""
-    from tpulab.models.generate import demo_config, load_params
-    from tpulab.models.paged import PagedEngine
-
     if prefill_chunk is None:
         prefill_chunk = PREFILL_CHUNK
     path = os.path.realpath(ckpt) if ckpt else None
@@ -454,7 +742,32 @@ def _engine_for(ckpt, attn: str = "gather", kv_dtype: str = "native",
         if hit is not None and hit[0] == stamp:
             _ENGINES[key] = _ENGINES.pop(key)  # LRU freshen
             return hit[1], hit[2]
-    from tpulab.models.generate import load_sidecar
+    engine, tok = _build_engine(path, attn, kv_dtype, tp, prefill_chunk)
+    with _GEN_SERVICE.lock:
+        hit = _ENGINES.get(key)
+        if hit is not None and hit[0] == stamp:
+            return hit[1], hit[2]  # concurrent build won; use theirs
+        _ENGINES.pop(key, None)
+        _ENGINES[key] = (stamp, engine, tok)
+        # 4 residents: the key now includes serving knobs, so one
+        # checkpoint's (native, int8, pallas) variants plus a second
+        # checkpoint fit without cold-rebuild thrash
+        while len(_ENGINES) > 4:
+            _ENGINES.pop(next(iter(_ENGINES)))
+    return engine, tok
+
+
+def _build_engine(path, attn: str, kv_dtype: str, tp: int,
+                  prefill_chunk: int):
+    """Cold-build one serving engine from its recipe (checkpoint
+    realpath + serving knobs) — the body ``_engine_for`` runs on a
+    cache miss, factored out so the SUPERVISOR can rebuild a
+    quarantined engine from the same recipe.  The recipe itself is
+    left on the engine (``_rebuild`` / ``_build_key`` /
+    ``_build_stamp``) for exactly that."""
+    from tpulab.models.generate import (demo_config, load_params,
+                                        load_sidecar)
+    from tpulab.models.paged import PagedEngine
 
     cfg, tok = load_sidecar(path)
     if cfg is None:
@@ -481,18 +794,14 @@ def _engine_for(ckpt, attn: str = "gather", kv_dtype: str = "native",
         # constraint is the engine's own (no pallas verify kernel, tp
         # uncertified)
         spec_k=_SPEC_K if (attn == "gather" and mesh is None) else 0,
+        # bounded admission queue: backpressure (shed-with-retry-after)
+        # instead of unbounded pending growth
+        max_pending=MAX_PENDING,
     )
-    with _GEN_SERVICE.lock:
-        hit = _ENGINES.get(key)
-        if hit is not None and hit[0] == stamp:
-            return hit[1], hit[2]  # concurrent build won; use theirs
-        _ENGINES.pop(key, None)
-        _ENGINES[key] = (stamp, engine, tok)
-        # 4 residents: the key now includes serving knobs, so one
-        # checkpoint's (native, int8, pallas) variants plus a second
-        # checkpoint fit without cold-rebuild thrash
-        while len(_ENGINES) > 4:
-            _ENGINES.pop(next(iter(_ENGINES)))
+    engine._build_key = (path, attn, kv_dtype, tp, prefill_chunk)
+    engine._build_stamp = _ckpt_stamp(path) if path else None
+    engine._rebuild = (lambda: _build_engine(path, attn, kv_dtype, tp,
+                                             prefill_chunk))
     return engine, tok
 
 
@@ -523,10 +832,14 @@ def _handle_generate(header: dict, payload: bytes,
     serializing behind a global lock, and compose with
     ``repetition_penalty``/``stream``/``stop_byte`` (sampling still
     refuses) —,
-    ``beams`` (beam search; beams=1 == greedy), and ``tp`` (serve the
+    ``beams`` (beam search; beams=1 == greedy), ``tp`` (serve the
     engine tensor-parallel over a ``{"tp": N}`` device mesh — the
     gather path's GSPMD partitioning; tokens stay bit-equal to the
-    single-device engine)."""
+    single-device engine), and the fault-tolerance fields
+    ``deadline_ms`` (opt into queue-wait-based shedding: a ``shed
+    retry_after_ms=N`` error frame instead of admission once the
+    observed queue-wait p99 blows the budget) + ``priority``
+    (KV-pressure preemption rank)."""
     import numpy as np
 
     config = header.get("config") or {}
@@ -554,6 +867,20 @@ def _handle_generate(header: dict, payload: bytes,
     tp = int(config.get("tp", 1))
     if tp < 1:
         raise ValueError(f"tp must be >= 1, got {tp}")
+    # deadline/priority: the fault-tolerance protocol fields.
+    # ``deadline_ms`` opts the request into queue-wait-based load
+    # shedding (a reject-with-retry-after error frame, body prefix
+    # "shed retry_after_ms=", when the observed queue_wait p99 already
+    # blows the budget); ``priority`` ranks it for KV-pressure
+    # preemption (a strictly-higher-priority request may evict a
+    # lower-priority slot, which resumes later from its prefix).
+    deadline_ms = config.get("deadline_ms")
+    if deadline_ms is not None:
+        deadline_ms = float(deadline_ms)
+        if not deadline_ms > 0:
+            raise ValueError(
+                f"deadline_ms must be > 0, got {deadline_ms}")
+    priority = int(config.get("priority", 0))
     prefill_chunk = int(config.get("prefill_chunk", PREFILL_CHUNK))
     if prefill_chunk < 0:
         raise ValueError(
@@ -727,6 +1054,7 @@ def _handle_generate(header: dict, payload: bytes,
         repetition_penalty=float(config.get("repetition_penalty", 1.0)),
         stop_byte=eng_stop,
         spec=spec_mode, spec_k=spec_k, spec_ngram=spec_ngram,
+        deadline_ms=deadline_ms, priority=priority,
         on_progress=on_progress,
     )
     if tok is None:
@@ -938,6 +1266,8 @@ def serve(socket_path: str, *, max_requests: Optional[int] = None) -> None:
             # request the same way (broken stream, no terminal frame).
             def send_chunk(data):
                 try:
+                    if _faults.ACTIVE:
+                        _faults.fire("daemon.send")  # wedged client
                     conn.settimeout(RECV_TIMEOUT_S)
                     conn.sendall(
                         struct.pack("<BQ", 2, len(data)) + bytes(data))
@@ -955,12 +1285,21 @@ def serve(socket_path: str, *, max_requests: Optional[int] = None) -> None:
                 frame = struct.pack("<BQ", 0, len(out)) + out
             except _StreamBroken:
                 raise
+            except ShedError as e:
+                # load shedding is a PROTOCOL outcome, not a crash: the
+                # error body is the bare parseable line ("shed
+                # retry_after_ms=<int> (...)"), no traceback — clients
+                # back off and retry (tools/obs_report.py)
+                err = str(e).encode("utf-8")
+                frame = struct.pack("<BQ", 1, len(err)) + err
             except Exception:
                 err = traceback.format_exc().encode("utf-8")
                 frame = struct.pack("<BQ", 1, len(err)) + err
             # explicit send bound: _recv_exact leaves whatever
             # remaining-time settimeout its last iteration computed
             # (possibly near zero) on the socket
+            if _faults.ACTIVE:
+                _faults.fire("daemon.send")  # wedged-client-socket site
             conn.settimeout(RECV_TIMEOUT_S)
             conn.sendall(frame)
         except (ConnectionError, TimeoutError):
@@ -1027,6 +1366,11 @@ def main(argv=None) -> int:
         from tpulab import obs
 
         obs.configure_tracer(args.trace_buffer)
+    if _faults.configure_from_env():
+        # chaos runs against a REAL daemon: arm the injector from
+        # TPULAB_FAULTS (JSON schedule) — absent means inert
+        print("[tpulab.daemon] fault injector ARMED from TPULAB_FAULTS",
+              flush=True)
     serve(args.socket, max_requests=args.max_requests)
     return 0
 

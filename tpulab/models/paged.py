@@ -83,6 +83,18 @@ Sharing dedups both MEMORY and COMPUTE: on a cache hit,
 region, attending the shared blocks straight from the pool — the dense
 prefill never executes (tested by counting its calls).
 
+Fault tolerance (round 11): requests are resumable SNAPSHOTS —
+:meth:`PagedEngine.resubmit` folds a request's emitted tokens into its
+prompt and requeues it, so decode resumes exactly where it stopped
+(greedy bit-identical; sampled slots re-seed at ``split^len(out)`` of
+their original key).  That one mechanism powers KV-pressure PREEMPTION
+(a strictly-higher-priority unadmittable head evicts the
+lowest-priority slot, whose blocks release through an
+integrity-checked path) and the daemon supervisor's crash REPLAY.
+``max_pending`` bounds the admission queue for backpressure, and the
+named ``tpulab.faults`` sites let chaos tests drive every one of these
+paths deterministically at zero cost when injection is off.
+
 Reference frame: the reference has no serving tier at all (SURVEY.md
 section 0); this is TPU-first serving infrastructure in the spirit of
 vLLM's PagedAttention, built on XLA gathers instead of custom CUDA.
@@ -100,6 +112,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from tpulab import faults as _faults
 from tpulab.obs import tracer as _obs_tracer
 from tpulab.obs.registry import gauge as _obs_gauge
 from tpulab.obs.registry import histogram as _obs_histogram
@@ -112,6 +125,20 @@ from tpulab.models.speculative import (_draft_propose_slots, _lookup_propose,
 from tpulab.parallel.ring import NEG_INF
 
 TRASH = 0  # physical block 0 swallows must-not-land writes
+
+
+class EngineIntegrityError(RuntimeError):
+    """Engine state failed an always-on invariant check (corrupt slot
+    table, out-of-vocab drained token — the NaN-logits signature).  The
+    daemon's supervisor treats it exactly like a dispatch exception:
+    quarantine the engine, rebuild, replay the in-flight requests."""
+
+
+class QueueFullError(RuntimeError):
+    """``submit`` refused: the engine's bounded admission queue is at
+    ``max_pending``.  Backpressure, not failure — the daemon maps this
+    to a reject-with-retry-after shedding response instead of letting
+    the pending list grow without bound."""
 
 # Per-request serving latency histograms (tpulab.obs process-global
 # registry; the daemon's ``metrics`` request renders them as Prometheus
@@ -507,6 +534,17 @@ def _sample_core(logits, temps, keys, penalties, seen):
 _sample_tokens = jax.jit(_sample_core)
 
 
+@jax.jit
+def _advance_key(key, n):
+    """Replay a slot key's per-tick advance ``n`` times: the carried
+    half of ``split(k, 2)`` per step, exactly the chain
+    ``_sample_core``/:func:`paged_tick` walk (split first, consume half
+    0, carry half 1).  One fori_loop dispatch on the rare
+    resume/replay path — never the hot tick."""
+    return jax.lax.fori_loop(
+        0, n, lambda i, k: jax.random.split(k, 2)[1], key)
+
+
 @functools.partial(jax.jit, static_argnames=("cfg", "block_size", "attn"),
                    donate_argnums=(1, 2, 3))
 def paged_tick(params, state, kpool, vpool, cfg: LabformerConfig,
@@ -619,8 +657,18 @@ class _Request:
     spec: str = "off"           # "off" | "lookup" | "draft" proposer
     spec_k: int = 0             # drafts per verify round (<= engine spec_k)
     spec_ngram: int = 3         # lookup proposer n-gram length
+    priority: int = 0           # KV-pressure preemption rank (higher wins)
     out: List[int] = field(default_factory=list)
     cancelled: bool = False     # finish at the next tick (client gone)
+    # resume-from-snapshot state (preemption requeue / supervisor
+    # replay): ``n_resumed`` = how many of ``out``'s tokens have been
+    # folded into ``prompt`` by :meth:`PagedEngine.resubmit`;
+    # ``resume_key`` = the PRNG key a sampled slot re-seeds with so the
+    # resumed stream continues the ORIGINAL seed's deterministic draw
+    # sequence (one split per emitted token — see resubmit)
+    n_resumed: int = 0
+    resume_key: Optional[np.ndarray] = None
+    preemptions: int = 0        # times this request was preempted
     # interleaved-admission lifecycle: "prefill" while chunks are still
     # owed (device slot inactive, no tokens yet), "decode" once live
     phase: str = "decode"
@@ -632,6 +680,15 @@ class _Request:
     t_submit: float = field(default_factory=time.monotonic)
     t_admit: float = 0.0
     t_last: float = 0.0         # previous drained-token time (ITL)
+
+    def total_positions(self) -> int:
+        """Positions this request can ever occupy: prompt + remaining
+        budget.  ``prompt`` absorbs already-emitted tokens on a resume
+        (resubmit) while ``out`` keeps them, so ``len(prompt) +
+        max_new`` would double-count the resumed region — every block
+        sizing site (submit validation, admission claim, release deref)
+        uses THIS so claims and releases can never disagree."""
+        return len(self.prompt) + self.max_new - self.n_resumed
 
 
 class PagedEngine:
@@ -658,7 +715,20 @@ class PagedEngine:
     (queue_wait / prefill / ttft / itl / e2e — tpulab.obs registry) and
     ring-buffer trace events at the host-side boundaries; pure host
     timestamps, so every device-transfer contract above is unchanged.
-    ``obs=False`` silences both (the ``obs_overhead`` bench's A/B)."""
+    ``obs=False`` silences both (the ``obs_overhead`` bench's A/B).
+
+    Fault tolerance (round 11): ``max_pending`` bounds the admission
+    queue (``submit`` raises :class:`QueueFullError` past it —
+    backpressure the daemon maps to shed-with-retry-after); a
+    ``priority`` above an active slot's lets an unadmittable head
+    PREEMPT that slot under KV pressure (blocks released through the
+    integrity-checked path, victim requeued and resumed from its
+    committed prefix via :meth:`resubmit` — greedy streams
+    bit-identical, sampled streams continue their key chain); drained
+    tokens and slot tables ride always-on integrity tripwires
+    (:class:`EngineIntegrityError`), and the named fault-injection
+    sites (``paged.step`` / ``paged.tick`` / ``paged.drain``,
+    tpulab.faults) cost one module-global read when injection is off."""
 
     def __init__(self, params, cfg: LabformerConfig, *, slots: int = 4,
                  n_blocks: int = 64, block_size: int = 16,
@@ -666,7 +736,8 @@ class PagedEngine:
                  attn: str = "gather", kv_dtype: str = "native",
                  spec_k: int = 0, spec_ngram: int = 3,
                  draft_params=None, draft_cfg=None, overlap: int = 1,
-                 interleave: bool = True, obs: bool = True):
+                 interleave: bool = True, obs: bool = True,
+                 max_pending: int = 0):
         if max_seq % block_size:
             raise ValueError("max_seq must be a multiple of block_size")
         if prefill_chunk < 0:
@@ -827,7 +898,17 @@ class PagedEngine:
             # synchronous path charges its inline chunk loop, chunk
             # count minus the one decode tick the step still runs.
             "admissions": 0, "prefill_chunks": 0, "stall_ticks": 0,
+            # fault-tolerance observability: preemptions = slots whose
+            # request was evicted under KV pressure (blocks released,
+            # request requeued to resume from its committed prefix)
+            "preemptions": 0,
         }
+        # bounded admission queue (0 = unbounded): submit raises
+        # QueueFullError past the bound — backpressure the daemon maps
+        # to a reject-with-retry-after shedding response
+        if max_pending < 0:
+            raise ValueError(f"max_pending must be >= 0, got {max_pending}")
+        self.max_pending = max_pending
         # device-resident decode state: the authoritative per-slot
         # arrays every paged_tick donates through (the numpy fields
         # above stay as HOST MIRRORS for admission/refcount/proposer
@@ -940,7 +1021,7 @@ class PagedEngine:
     def submit(self, prompt, max_new: int, *, temperature: float = 0.0,
                seed: int = 0, repetition_penalty: float = 1.0,
                stop_byte: int = -1, spec: str = "off", spec_k: int = 0,
-               spec_ngram: int = 0) -> int:
+               spec_ngram: int = 0, priority: int = 0) -> int:
         """Queue a request.  ``temperature == 0`` decodes greedily;
         otherwise the slot samples from its own seeded PRNG stream —
         per-request sampling coexists with greedy slots in one batch.
@@ -959,6 +1040,10 @@ class PagedEngine:
         its spec flag but falls back to single-token ticks inside the
         same batch.  ``spec_ngram`` overrides the engine's lookup
         n-gram length (0 = engine default)."""
+        if self.max_pending and len(self.pending) >= self.max_pending:
+            raise QueueFullError(
+                f"admission queue at max_pending={self.max_pending}; "
+                f"retry later")
         if spec not in ("off", "lookup", "draft"):
             raise ValueError(
                 f"spec={spec!r}; expected 'off', 'lookup' or 'draft'")
@@ -1005,7 +1090,7 @@ class PagedEngine:
             _Request(rid, prompt, max_new, float(temperature), int(seed),
                      float(repetition_penalty), int(stop_byte), spec,
                      int(spec_k) or self.spec_k,
-                     int(spec_ngram) or self.spec_ngram)
+                     int(spec_ngram) or self.spec_ngram, int(priority))
         )
         return rid
 
@@ -1062,7 +1147,7 @@ class PagedEngine:
             # would land on the free list while also sitting in `shared`
             for b in shared:
                 self.block_refs[b] += 1
-            need_total = self._blocks_needed(len(req.prompt) + req.max_new)
+            need_total = self._blocks_needed(req.total_positions())
             need_new = need_total - len(shared)
             if need_new > len(self.free):
                 # evict ONLY when eviction can actually admit the head
@@ -1092,8 +1177,12 @@ class PagedEngine:
             row[:need_total] = shared + fresh
             self.tables[s] = row
             self.temps[s] = req.temperature
-            self.keys[s] = np.asarray(
-                jax.random.PRNGKey(req.seed), np.uint32
+            # a resumed request (preemption / supervisor replay)
+            # re-seeds at its snapshot key so the sampled stream
+            # CONTINUES the original seed's draw sequence
+            self.keys[s] = (
+                req.resume_key if req.resume_key is not None
+                else np.asarray(jax.random.PRNGKey(req.seed), np.uint32)
             )
             self.penalties[s] = req.repetition_penalty
             # unconditional: step() marks emitted tokens for every slot,
@@ -1402,11 +1491,39 @@ class PagedEngine:
         if self.obs:
             _H_E2E.observe(time.monotonic() - req.t_submit)
             self._trace.event("engine.retire", req.req_id)
-        used = self._blocks_needed(len(req.prompt) + req.max_new)
-        for b in self.tables[s, :used]:
-            if int(b) != TRASH:
-                self._deref(int(b))
+        self._release_blocks(s, req)
+        self._clear_slot(s)
+        self._done[req.req_id] = np.asarray(req.out, np.int32)
+        self.counters["requests_done"] += 1
+
+    def _release_blocks(self, s: int, req: _Request):
+        """Deref every block admission allocated for slot ``s`` and
+        point its table at TRASH — shared by retirement and preemption.
+
+        The loop is also the slot-table INTEGRITY TRIPWIRE: a corrupt
+        entry (out of range, or pointing at a block nobody holds a
+        reference on) raises :class:`EngineIntegrityError` BEFORE any
+        deref executes, so a corruption can never push a block onto the
+        free list twice (double-free) or index past the refcount array.
+        TRASH entries are blocks the sliding-window retirement already
+        released mid-decode."""
+        used = self._blocks_needed(req.total_positions())
+        row = [int(b) for b in self.tables[s, :used]]
+        for b in row:
+            if not 0 <= b < len(self.block_refs) or (
+                    b != TRASH and self.block_refs[b] <= 0):
+                raise EngineIntegrityError(
+                    f"slot {s} table corrupt: block {b} "
+                    f"(pool {len(self.block_refs)}, "
+                    f"refs {self.block_refs[b] if 0 <= b < len(self.block_refs) else 'oob'})")
+        for b in row:
+            if b != TRASH:
+                self._deref(b)
         self.tables[s] = TRASH
+
+    def _clear_slot(self, s: int):
+        """Reset slot ``s``'s host mirrors to idle and deactivate the
+        device slot (the tail of retirement and preemption)."""
         self.lengths[s] = 0
         self.last_tok[s] = 0
         self.temps[s] = 0.0
@@ -1416,8 +1533,95 @@ class PagedEngine:
         self._retire_from[s] = 0
         self.active[s] = None
         self._push_slot(s, False)
-        self._done[req.req_id] = np.asarray(req.out, np.int32)
-        self.counters["requests_done"] += 1
+
+    # ---------------------------------------------------- resume / preempt
+    def resubmit(self, req: _Request) -> int:
+        """Requeue a request from its snapshot so decode RESUMES where
+        it left off — the one mechanism behind both KV-pressure
+        preemption (this engine releases the slot, re-admits later) and
+        the daemon supervisor's replay (a rebuilt engine re-runs the
+        in-flight set).
+
+        Already-emitted tokens fold into the prompt (``out`` keeps
+        them, so the finished result is still the FULL stream and the
+        ``max_new`` budget check is unchanged); admission then prefills
+        ``prompt + emitted`` and the next decode tick produces exactly
+        the continuation — greedy streams are bit-identical to an
+        uninterrupted run because greedy decode is deterministic in its
+        committed prefix.  A sampled request additionally carries
+        ``resume_key``: the engine advances a slot's PRNG key once per
+        dispatched tick and emits exactly one token per dispatched tick
+        while the slot decodes, so the key after ``len(out)`` emitted
+        tokens is ``len(out)`` splits from the seed — the resumed slot
+        re-seeds there and continues the original draw sequence.
+
+        ``req.req_id`` is preserved (waiters keep their handle across a
+        supervisor replay); the id counter advances past it so later
+        submissions can never collide."""
+        if req.cancelled:
+            # the consumer is gone (or already satisfied): there is
+            # nobody to resume FOR — callers complete or drop instead
+            raise ValueError("resubmit of a cancelled request")
+        new = len(req.out) - req.n_resumed
+        if new:
+            req.prompt = np.concatenate(
+                [req.prompt, np.asarray(req.out[req.n_resumed:], np.int32)])
+            req.n_resumed = len(req.out)
+        if req.temperature > 0 and len(req.out):
+            key = jnp.asarray(
+                np.asarray(jax.random.PRNGKey(req.seed), np.uint32))
+            req.resume_key = np.asarray(
+                _advance_key(key, len(req.out)), np.uint32)
+        req.phase = "decode"
+        req.pf_pos = req.pf_end = req.d_pf_pos = 0
+        self._next_id = max(self._next_id, req.req_id + 1)
+        self.pending.append(req)
+        return req.req_id
+
+    def _preempt_for_head(self, finished: List[int]) -> bool:
+        """KV pressure: the head request cannot be admitted even after
+        cache eviction — preempt the lowest-priority active slot whose
+        priority is STRICTLY below the head's (never an equal: FIFO
+        arrivals must not evict each other), releasing its blocks and
+        requeueing it (right behind the head) to resume from its
+        committed prefix.  Ties break to the most recently admitted
+        slot — the least prefill compute thrown away.
+
+        Requires a sync barrier first: in-flight ticks still reference
+        the victim's blocks and carry its undrained tokens — the
+        snapshot must be COMPLETE (every emitted token in ``out``)
+        before the blocks are released.  Rare path by construction, so
+        the drain is acceptable; returns True if a slot was preempted
+        (the caller re-checks admissibility)."""
+        head = self.pending[0]
+        victims = [
+            (r.priority, -r.t_admit, s)
+            for s, r in enumerate(self.active)
+            if r is not None and not r.cancelled
+            and r.priority < head.priority
+        ]
+        if not victims:
+            return False
+        self._drain_all(finished)
+        if (any(r is None for r in self.active)
+                and self._head_admittable()):
+            # the drain itself released enough (a request finished
+            # inside the window): admit without evicting anyone
+            return True
+        _, _, s = min(victims)
+        req = self.active[s]
+        if req is None or req.cancelled:
+            return True  # the drain itself retired the victim
+        self.counters["preemptions"] += 1
+        req.preemptions += 1
+        self._trace.event("engine.preempt", req.req_id)
+        self._release_blocks(s, req)
+        self._clear_slot(s)
+        self.resubmit(req)
+        # resume right behind the preempting head, ahead of later
+        # arrivals: the victim already waited its turn once
+        self.pending.insert(1, self.pending.pop())
+        return True
 
     def _spec_budget(self, req: _Request) -> int:
         """Draft count this round for a speculating slot: capped by the
@@ -1440,7 +1644,7 @@ class PagedEngine:
         effect: the entry IS being matched, just not consumed yet."""
         req = self.pending[0]
         shared, _ = self._lookup_prefix(req.prompt)
-        need_new = (self._blocks_needed(len(req.prompt) + req.max_new)
+        need_new = (self._blocks_needed(req.total_positions())
                     - len(shared))
         if need_new <= len(self.free):
             return True
@@ -1468,7 +1672,18 @@ class PagedEngine:
         dispatched: interleaved admission no longer drains the window,
         so a drained tick can predate the slot's current occupant."""
         toks, snap = self._inflight.pop(0)
-        nxt = jax.device_get(toks)
+        nxt = np.asarray(jax.device_get(toks))
+        if _faults.ACTIVE:
+            rule = _faults.fire("paged.drain")
+            if rule is not None and rule.kind == "nan_tokens":
+                # the NaN-logits signature: sampling over non-finite
+                # logits cannot be trusted, so the injector substitutes
+                # an out-of-vocab id the validity check below trips on
+                nxt = np.full_like(nxt, -1)
+        if ((nxt < 0) | (nxt >= self.cfg.vocab)).any():
+            raise EngineIntegrityError(
+                f"drained tick carries out-of-vocab tokens {nxt.tolist()} "
+                f"(non-finite logits?)")
         for s, req in enumerate(self.active):
             if req is None or snap[s] is not req:
                 continue
@@ -1510,6 +1725,16 @@ class PagedEngine:
         admission sync is block reclamation: the head request needs
         blocks held by a request finishing inside the window."""
         finished: List[int] = []
+        if _faults.ACTIVE:
+            rule = _faults.fire("paged.step")
+            if rule is not None and rule.kind == "corrupt_table":
+                # damage the first occupied slot's host table — the
+                # release-time integrity tripwire must catch it before
+                # any deref corrupts the free list
+                for cs, cr in enumerate(self.active):
+                    if cr is not None:
+                        self.tables[cs, 0] = len(self.block_refs) + 7
+                        break
         self._h2d = False
         self._stall_prefill_dispatches = 0
         self._stall_prefill_credit = 0
@@ -1518,24 +1743,33 @@ class PagedEngine:
             r is not None and r.phase == "decode" and not r.cancelled
             and len(r.out) + len(self._inflight) < r.max_new
             for r in self.active)
-        if self.pending and any(r is None for r in self.active):
+        if self.pending:
             # admission is gated on a FREE slot and on the head request
             # actually FITTING (free + evictable blocks) — a backed-up
             # queue behind fully-busy slots, or a block-starved head
             # behind a long request, must not drain the async window
             # every tick for an admission that cannot happen anyway.
-            if self._head_admittable():
+            free_slot = any(r is None for r in self.active)
+            if free_slot and self._head_admittable():
                 if not self.interleave:
                     # synchronous admission rewrites slot state under a
                     # drained window: the pre-interleave barrier
                     self._drain_all(finished)
                 self._admit()
-            elif (self.interleave and self._inflight
+            elif (free_slot and self.interleave and self._inflight
                     and self._drain_could_free()):
                 # block reclamation: a finishing request's blocks are
                 # the head's only way in — the one admission sync left
                 self._drain_all(finished)
                 if self._head_admittable():
+                    self._admit()
+            elif self._preempt_for_head(finished):
+                # KV-pressure preemption: a strictly-higher-priority
+                # head evicted the lowest-priority slot (blocks
+                # released, victim requeued to resume from its prefix)
+                if self.pending and self._head_admittable():
+                    if not self.interleave:
+                        self._drain_all(finished)
                     self._admit()
         spec = self._spec_wanted()
         if spec and self._inflight:
@@ -1577,6 +1811,8 @@ class PagedEngine:
                 # this tick's token to a slot (re-)admitted afterwards
                 snap = [r if (r is not None and r.phase == "decode")
                         else None for r in self.active]
+                if _faults.ACTIVE:
+                    _faults.fire("paged.tick")  # dispatch-exception site
                 toks, self._dev, self.kpool, self.vpool = paged_tick(
                     self.params, self._dev, self.kpool, self.vpool,
                     self.cfg, self.block_size, self.attn,

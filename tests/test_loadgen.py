@@ -1,0 +1,330 @@
+"""Goodput layer (round 12): trace-driven load generator, per-request
+rid-linked tracing + slow log, and the goodput gate.
+
+Covers the round-12 ISSUE acceptance:
+  * a seeded trace build is BYTE-deterministic (same spec -> identical
+    JSON twice), round-trips exactly, and carries the workload features
+    (bursty on-off arrivals, heavy-tail sizes, multi-turn sessions that
+    extend their parent's prompt verbatim, per-class deadline/priority
+    mixes, scripted mid-stream cancellations);
+  * every request threads ONE process-unique ``rid`` through daemon ->
+    engine -> tracer, so its events form a linked span tree and its
+    slow-log entry (worst-N by e2e, with queue-wait / prefill-chunk /
+    TTFT / worst-ITL-gap-and-token summaries) keys straight into the
+    trace;
+  * the daemon answers a ``slowlog`` request with those entries;
+  * ``tools/goodput_gate.py`` replays a trace against a LIVE daemon
+    and reports per-class goodput-under-SLO plus the slowlog, emitting
+    the bench rows ``check_regression.py`` gates against the signed
+    baselines;
+  * the new surfaces are documented (catalog lint, the test_obs
+    pattern).
+"""
+
+import json
+import pathlib
+
+import numpy as np
+import pytest
+
+from tpulab import loadgen, obs
+from tpulab.models.labformer import LabformerConfig
+from tpulab.models.paged import PagedEngine
+from tpulab.obs.slowlog import SlowLog
+
+CFG = LabformerConfig(d_model=32, n_heads=4, n_layers=2, d_ff=64, max_seq=128)
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(scope="module")
+def trained(trained_small, trained_small_cfg):
+    assert CFG == trained_small_cfg  # shared-model drift fails loudly
+    return trained_small
+
+
+def _cycle_prompt(p):
+    return (np.arange(p) % 7).astype(np.int32)
+
+
+# ------------------------------------------------------------ trace build
+def test_trace_build_byte_deterministic():
+    """The acceptance criterion: same spec -> byte-identical JSON, so a
+    committed trace file IS the workload and a replay is exact."""
+    spec = loadgen.built_in_spec("fast")
+    a = loadgen.build_trace(spec).to_json()
+    b = loadgen.build_trace(spec).to_json()
+    assert a == b
+    # a different seed is a different workload
+    from dataclasses import replace
+
+    c = loadgen.build_trace(replace(spec, seed=spec.seed + 1)).to_json()
+    assert c != a
+
+
+def test_trace_roundtrip_and_schema():
+    trace = loadgen.build_trace(loadgen.built_in_spec("fast"))
+    again = loadgen.Trace.from_json(trace.to_json())
+    assert again.requests == trace.requests
+    assert again.classes == trace.classes
+    ts = [r["t_ms"] for r in trace.requests]
+    assert ts == sorted(ts)
+    names = {c["name"] for c in trace.classes}
+    for r in trace.requests:
+        assert r["cls"] in names
+        # every request fits the daemon serving window
+        assert len(r["prompt"]) + r["steps"] <= trace.spec["max_total"]
+        assert r["steps"] >= trace.spec["steps_min"]
+    with pytest.raises(ValueError, match="version"):
+        loadgen.Trace.from_json('{"version": 99}')
+
+
+def test_trace_workload_features():
+    """The fast spec exercises every workload dimension: both SLO
+    classes (distinct priority/deadline), multi-turn sessions whose
+    follow-up prompts EXTEND the parent verbatim (the prefix-cache
+    reuse shape), scripted cancellations, and heavy-tailed sizes."""
+    trace = loadgen.build_trace(loadgen.built_in_spec("fast"))
+    by_cls = {}
+    for r in trace.requests:
+        by_cls.setdefault(r["cls"], []).append(r)
+    assert set(by_cls) == {"interactive", "bulk"}
+    prios = {r["priority"] for r in trace.requests}
+    assert len(prios) > 1  # preemption-rank mix on the wire
+    assert any(r["deadline_ms"] is not None for r in trace.requests)
+    assert any(r["deadline_ms"] is None for r in trace.requests)
+    assert any(r["cancel_after_ms"] is not None for r in trace.requests)
+    # session prefix reuse: turn t+1 starts with turn t's full prompt
+    by_sess = {}
+    for r in trace.requests:
+        by_sess.setdefault(r["session"], []).append(r)
+    pairs = 0
+    for rs in by_sess.values():
+        rs.sort(key=lambda r: r["turn"])
+        for a, b in zip(rs, rs[1:]):
+            assert b["prompt"].startswith(a["prompt"])
+            pairs += 1
+    assert pairs > 0, "no multi-turn sessions in the fast trace"
+    # heavy tail: the longest prompt well past the median
+    lens = sorted(len(r["prompt"]) for r in trace.requests)
+    assert lens[-1] >= 2 * lens[len(lens) // 2]
+
+
+def test_arrival_processes():
+    from dataclasses import replace
+
+    fast = loadgen.built_in_spec("fast")
+    onoff = loadgen.build_trace(fast)
+    poisson = loadgen.build_trace(replace(fast, arrival="poisson"))
+    assert onoff.to_json() != poisson.to_json()
+    with pytest.raises(ValueError, match="arrival"):
+        loadgen.build_trace(replace(fast, arrival="bogus"))
+    with pytest.raises(ValueError, match="unknown spec"):
+        loadgen.built_in_spec("nope")
+    # on-off arrivals actually burst: some inter-arrival gap is far
+    # above the in-burst spacing (the off period)
+    first_turn = [r["t_ms"] for r in onoff.requests if r["turn"] == 0]
+    gaps = [b - a for a, b in zip(first_turn, first_turn[1:])]
+    in_burst = 1e3 / (fast.rate_rps * fast.burst_factor)
+    assert max(gaps) > 5 * in_burst
+
+
+# ------------------------------------------------------------- slow log
+def test_slowlog_worst_n_and_capacity():
+    log = SlowLog(capacity=3)
+    for i, e2e in enumerate((50.0, 10.0, 99.0, 70.0, 5.0)):
+        log.record({"rid": i, "e2e_ms": e2e})
+    worst = log.worst()
+    assert [e["e2e_ms"] for e in worst] == [99.0, 70.0, 50.0]
+    assert log.recorded == 5
+    assert [e["rid"] for e in log.worst(2)] == [2, 3]
+    log.clear()
+    assert log.worst() == [] and log.recorded == 0
+    disabled = SlowLog(capacity=0)
+    disabled.record({"e2e_ms": 1.0})
+    assert disabled.worst() == [] and disabled.recorded == 0
+    with pytest.raises(ValueError, match="capacity"):
+        SlowLog(capacity=-1)
+
+
+def test_engine_records_rid_linked_slowlog(trained):
+    """One engine wave, observability on: the slow log gains one span
+    summary per retired request, and the entry's rid keys the SAME
+    request's tracer events (submit -> admit -> first_token -> token*
+    -> retire — the linked span tree)."""
+    prior = obs.TRACER.capacity
+    obs.SLOWLOG.clear()
+    try:
+        obs.configure_tracer(1 << 12)  # fresh, private window
+        eng = PagedEngine(trained, CFG, slots=2, n_blocks=32, block_size=8,
+                          max_seq=64, prefill_chunk=8)
+        eng.submit(_cycle_prompt(20), max_new=6, tag="slow-a")
+        eng.submit(_cycle_prompt(4), max_new=4, tag="slow-b")
+        eng.run()
+        worst = obs.SLOWLOG.worst()
+        assert {e["tag"] for e in worst} == {"slow-a", "slow-b"}
+        by_tag = {e["tag"]: e for e in worst}
+        a = by_tag["slow-a"]
+        assert a["tokens"] == 6 and a["prompt_len"] == 20
+        assert a["prefill_chunks"] >= 2  # 19 prefill positions / chunk 8
+        assert a["e2e_ms"] >= a["ttft_ms"] >= a["queue_wait_ms"] >= 0
+        assert a["itl_max_ms"] >= 0 and 1 <= a["itl_max_at_token"] < 6
+        assert a["preemptions"] == 0 and a["resubmits"] == 0
+        # rid-linkage: the tracer's per-request events carry this rid
+        events = obs.TRACER.chrome_trace()["traceEvents"]
+        rid = a["rid"]
+        mine = {e["name"] for e in events
+                if e.get("args", {}).get("arg") == rid}
+        assert {"engine.submit", "engine.admit", "engine.first_token",
+                "engine.token", "engine.retire"} <= mine
+        # the prefill chunk spans carry the rid on their B records
+        assert any(e["name"] == "engine.prefill_chunk" and e["ph"] == "B"
+                   and e.get("args", {}).get("arg") == rid for e in events)
+        # rids are process-unique, distinct across requests
+        assert by_tag["slow-b"]["rid"] != rid
+    finally:
+        obs.configure_tracer(prior)
+        obs.SLOWLOG.clear()
+
+
+def test_engine_obs_off_records_no_slowlog(trained):
+    obs.SLOWLOG.clear()
+    eng = PagedEngine(trained, CFG, slots=1, n_blocks=16, block_size=8,
+                      max_seq=64, obs=False)
+    eng.submit(_cycle_prompt(4), max_new=4)
+    eng.run()
+    assert obs.SLOWLOG.recorded == 0
+
+
+def test_daemon_slowlog_request(trained):
+    """Acceptance: the daemon ``slowlog`` request returns the worst-N
+    with their span summaries, rid-linked and tag-labelled."""
+    from tpulab.daemon import _GenerateService, handle_request
+
+    obs.SLOWLOG.clear()
+    try:
+        svc = _GenerateService()
+        eng = PagedEngine(trained, CFG, slots=2, n_blocks=32, block_size=8,
+                          max_seq=64)
+        rid = obs.next_rid()
+        out = svc.generate(eng, _cycle_prompt(4), 8, req_rid=rid,
+                           tag="wire-tag")
+        assert len(out) == 8
+        got = json.loads(handle_request({"lab": "slowlog",
+                                         "config": {"n": 5}}, b""))
+        assert got["recorded"] >= 1 and got["capacity"] > 0
+        entry = next(e for e in got["worst"] if e["tag"] == "wire-tag")
+        assert entry["rid"] == rid and entry["tokens"] == 8
+        assert entry["e2e_ms"] > 0 and entry["ttft_ms"] is not None
+        # config {"clear": true} resets after the read
+        json.loads(handle_request(
+            {"lab": "slowlog", "config": {"clear": True}}, b""))
+        got = json.loads(handle_request({"lab": "slowlog"}, b""))
+        assert got["recorded"] == 0 and got["worst"] == []
+    finally:
+        obs.SLOWLOG.clear()
+
+
+# ------------------------------------------------------- live-daemon gate
+def test_goodput_gate_against_live_daemon(tmp_path, capsys):
+    """The round-12 acceptance scenario end to end: a seeded trace
+    replayed by tools/goodput_gate.py against a LIVE daemon (spawned by
+    the gate, CPU tier) — per-class goodput-under-SLO, the server
+    window percentiles diffed from the PR-5 histograms, the slowlog
+    worst-N with rid/tag linkage, and the bench rows the regression
+    gate consumes."""
+    import importlib.util
+    from dataclasses import replace
+
+    spec = importlib.util.spec_from_file_location(
+        "goodput_gate", ROOT / "tools" / "goodput_gate.py")
+    gate = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(gate)
+
+    tiny = replace(loadgen.built_in_spec("fast"), name="tiny",
+                   n_requests=6, p_cancel=0.0, steps_median=8,
+                   steps_max=12, prompt_median=24, prompt_max=64)
+    trace_path = tmp_path / "tiny_trace.json"
+    loadgen.build_trace(tiny).save(trace_path)
+    out_path = tmp_path / "goodput.json"
+    sock = str(tmp_path / "gate.sock")
+    rc = gate.main(["--socket", sock, "--spawn-daemon",
+                    "--trace", str(trace_path), "--out", str(out_path),
+                    "--warmup", "1", "--slowlog", "4",
+                    "--time-scale", "0.25", "--min-attainment", "0.0"])
+    assert rc == 0
+    report = json.loads(out_path.read_text())
+    overall = report["goodput"]["overall"]
+    assert overall["n"] == 6 and overall["errors"] == 0
+    assert overall["completed"] == 6 and overall["shed"] == 0
+    assert overall["goodput_tokens_per_s"] > 0
+    assert set(report["goodput"]["classes"]) == {"interactive", "bulk"}
+    # server-side window percentiles came from the scraped histograms
+    assert report["server_window"]["ttft_seconds"]["count"] >= 6
+    assert "daemon_shed_requests" in report["counters"]
+    # slowlog entries are rid-linked and tag-labelled with trace rows
+    assert report["slowlog"], "slowlog empty after a live replay"
+    tags = {e["tag"] for e in report["slowlog"] if e["tag"]}
+    assert any(t.startswith("tiny:") for t in tags), tags
+    assert all(e["rid"] > 0 for e in report["slowlog"])
+    # the emitted bench rows are what check_regression gates
+    rows = [json.loads(ln) for ln in capsys.readouterr().out.splitlines()
+            if ln.startswith("{")]
+    metrics = {r["metric"] for r in rows}
+    assert {"goodput_tiny_goodput_tokens_per_s",
+            "goodput_tiny_slo_attainment"} <= metrics
+
+
+# ------------------------------------------------------------------ lint
+def test_goodput_surfaces_documented():
+    """Catalog lint (the test_obs pattern): the new trace events, the
+    slowlog surface, and the goodput baseline rows are documented, and
+    the committed fast-trace artifacts exist and parse."""
+    docs = (ROOT / "docs" / "ARCHITECTURE.md").read_text()
+    for name in ("engine.submit", "engine.token", "engine.resubmit",
+                 "daemon.shed", "daemon.replay", "slowlog",
+                 "goodput_fast_goodput_tokens_per_s"):
+        assert name in docs, f"{name} missing from docs/ARCHITECTURE.md"
+    baselines = json.loads(
+        (ROOT / "results" / "baselines.json").read_text())["baselines"]
+    assert "goodput_fast_goodput_tokens_per_s" in baselines
+    assert "goodput_fast_slo_attainment" in baselines
+    # the committed r12 artifacts replay-match the in-repo spec
+    trace = loadgen.Trace.load(ROOT / "results" / "goodput_trace_fast.json")
+    assert trace.to_json() == loadgen.build_trace(
+        loadgen.built_in_spec("fast")).to_json()
+    report = json.loads((ROOT / "results" / "goodput_r12.json").read_text())
+    assert report["goodput"]["overall"]["n"] == len(trace.requests)
+    # the r12 queue script runs the goodput fast tier host-only and
+    # sources the shared relay lib (the dedup contract of r11)
+    r12 = (ROOT / "tools" / "onchip_queue_r12.sh").read_text()
+    assert "goodput_gate.py" in r12 and "relay_lib.sh" in r12
+    assert "JAX_PLATFORMS=cpu" in r12
+
+
+def test_tune_flash_best_pool_excludes_batched_rows():
+    """Round-5 advisor satellite, made directly testable: phase-3
+    --train-shape rows (batch > 1) may NEVER win the per-seq b=1
+    winner pools even when faster, while the train shape keeps its own
+    dedicated key (and a batch=1 train shape legitimately shares)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "tune_flash", ROOT / "tools" / "tune_flash.py")
+    tf = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(tf)
+    rows = [
+        {"seq": 2048, "batch": 1, "block_q": 128, "block_k": 128,
+         "fwd_ms": 5.0, "bwd_ms": 8.0, "fwdbwd_ms": 13.0},
+        # batched row, FASTER on every axis: must not contaminate b=1
+        {"seq": 2048, "batch": 8, "block_q": 64, "block_k": 64,
+         "fwd_ms": 0.5, "bwd_ms": 0.8, "fwdbwd_ms": 1.3},
+    ]
+    best = tf.select_best(rows, [2048], train_shape=(2048, 8))
+    assert best["fwd_s2048"]["fwd_ms"] == 5.0
+    assert best["bwd_s2048"]["bwd_ms"] == 8.0
+    assert best["fwdbwd_s2048"]["fwdbwd_ms"] == 13.0
+    assert best["fwdbwd_train_s2048_b8"]["fwdbwd_ms"] == 1.3
+    # legacy rows without a batch key count as b=1
+    legacy = [{"seq": 1024, "block_q": 64, "block_k": 64, "fwd_ms": 2.0}]
+    assert tf.select_best(legacy, [1024])["fwd_s1024"]["fwd_ms"] == 2.0

@@ -99,6 +99,40 @@ def test_percentile_estimation_interpolates():
         percentile_from_buckets((1.0,), (0, 0), 1.5)
 
 
+def test_percentile_from_buckets_edge_cases():
+    """The round-12 satellite: the shared interpolation rule now also
+    backs the goodput math (tools/goodput_gate.py window percentiles),
+    so its edge cases get direct coverage — empty histogram, all mass
+    in one bucket, p0/p100, overflow-bucket clamping, and ranks landing
+    exactly on bucket boundaries."""
+    bounds = (1.0, 2.0, 4.0)
+    # empty histogram: 0.0 at EVERY quantile, including the extremes
+    for q in (0.0, 0.5, 1.0):
+        assert percentile_from_buckets(bounds, (0, 0, 0, 0), q) == 0.0
+    # all mass in a single interior bucket: every quantile interpolates
+    # inside (1, 2], p100 reaches exactly its upper bound
+    counts = (0, 8, 0, 0)
+    assert percentile_from_buckets(bounds, counts, 0.25) == pytest.approx(1.25)
+    assert percentile_from_buckets(bounds, counts, 1.0) == 2.0
+    # p0 resolves to the lower edge of the first OCCUPIED bucket (rank
+    # 0 skips the empty leading bucket, never reports below the mass)
+    assert percentile_from_buckets(bounds, counts, 0.0) == 1.0
+    # all mass in the FIRST bucket interpolates down from 0
+    assert percentile_from_buckets(bounds, (10, 0, 0, 0), 0.1) == pytest.approx(0.1)
+    # p100 with overflow mass clamps to the last finite bound — the
+    # estimate can never exceed what the buckets resolve
+    assert percentile_from_buckets(bounds, (1, 0, 0, 3), 1.0) == 4.0
+    assert percentile_from_buckets(bounds, (0, 0, 0, 5), 0.5) == 4.0
+    # rank landing EXACTLY on a bucket boundary returns the bound (5 of
+    # 10 observations <= 1.0, so p50 == 1.0, no bleed into (1, 2])
+    assert percentile_from_buckets(bounds, (5, 5, 0, 0), 0.5) == 1.0
+    # ...and just past the boundary it moves into the next bucket
+    assert percentile_from_buckets(bounds, (5, 5, 0, 0), 0.6
+                                   ) == pytest.approx(1.2)
+    # single-bound histogram, overflow-only mass
+    assert percentile_from_buckets((0.5,), (0, 2), 0.9) == 0.5
+
+
 def test_histogram_percentile_method():
     r = Registry()
     h = r.histogram("p_seconds", buckets=tuple(float(i) for i in

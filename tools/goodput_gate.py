@@ -1,0 +1,315 @@
+#!/usr/bin/env python
+"""Goodput-under-SLO gate: replay a trace against a live daemon and
+fail on regression.
+
+The measurement layer ROADMAP item 5 asks for: instead of trusting
+steady-state tokens/s, drive a live tpulab daemon with a seeded
+trace (tpulab.loadgen — bursty arrivals, heavy-tail lengths,
+multi-turn prefix reuse, mid-stream cancellations, per-class
+deadline/priority mixes) and report **goodput**: the fraction of
+requests completed within their class's TTFT/ITL/e2e budgets, and the
+token throughput those good requests delivered.
+
+What one run produces:
+
+* per-class goodput-under-SLO + shed/cancel/error accounting from the
+  client-observed outcomes (tpulab.loadgen.summarize);
+* server-side latency percentiles for the replay WINDOW, computed by
+  differencing the daemon's Prometheus scrape before vs after (the
+  PR-5 histograms — cumulative, so the delta isolates this run);
+* shed / preemption / replay / restart counter deltas from the same
+  scrapes;
+* the daemon's ``slowlog`` worst-N with per-request span summaries —
+  each entry's ``rid`` links to the trace events, and its ``tag``
+  names the trace row that produced it;
+* bench-style JSONL rows (``goodput_<spec>_goodput_tokens_per_s``,
+  ``goodput_<spec>_slo_attainment``) on stdout, gated against the
+  signed ``results/baselines.json`` by ``--check-baselines`` (exit 1
+  on regression — the ratchet lives in tools/check_regression.py).
+
+Usage (host-only fast tier, as tools/onchip_queue_r12.sh runs it):
+
+    python tools/goodput_gate.py --spawn-daemon --spec fast \
+        --out results/goodput_r12.json --check-baselines
+
+or against an already-running daemon: ``--socket /tmp/tpulab.sock``
+(never spawn a daemon you don't own on a chip — the running one holds
+the claim).
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib.util
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(ROOT))
+
+from tpulab import loadgen  # noqa: E402
+from tpulab.obs.registry import percentile_from_buckets  # noqa: E402
+
+
+def _load_obs_report():
+    spec = importlib.util.spec_from_file_location(
+        "obs_report", pathlib.Path(__file__).resolve().parent
+        / "obs_report.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+#: counters whose before/after delta the report carries (the PR-6
+#: fault-tolerance counters plus the engine preemption mirror)
+_COUNTERS = ("daemon_shed_requests", "daemon_replays",
+             "daemon_engine_restarts", "engine_preemptions")
+
+#: histograms percentile-diffed over the replay window
+_HISTOGRAMS = ("ttft_seconds", "itl_seconds", "e2e_seconds",
+               "queue_wait_seconds", "prefill_seconds")
+
+
+def _histogram_counts(metric: dict):
+    """Scraped cumulative buckets -> (bounds, per-bucket counts)."""
+    pairs = metric.get("buckets") or []
+    if not pairs or pairs[-1][0] != float("inf"):
+        return None
+    bounds = tuple(le for le, _ in pairs[:-1])
+    cums = [c for _, c in pairs]
+    return bounds, [cums[0]] + [b - a for a, b in zip(cums, cums[1:])]
+
+
+def window_percentiles(before: dict, after: dict) -> dict:
+    """Server-side p50/p90/p99 for the replay WINDOW: per-bucket deltas
+    of the cumulative scraped histograms (the process-lifetime scrape
+    would fold warmup and any earlier traffic into the estimate)."""
+    out = {}
+    for name in _HISTOGRAMS:
+        b, a = before.get(name), after.get(name)
+        if not a or a.get("type") != "histogram":
+            continue
+        got = _histogram_counts(a)
+        if got is None:
+            continue
+        bounds, counts = got
+        got_b = _histogram_counts(b) if b else None
+        if got_b is not None and got_b[0] == bounds:
+            counts = [x - y for x, y in zip(counts, got_b[1])]
+        n = sum(counts)
+        if n <= 0:
+            continue
+        out[name] = {
+            "count": n,
+            "p50_ms": round(
+                percentile_from_buckets(bounds, counts, 0.50) * 1e3, 3),
+            "p90_ms": round(
+                percentile_from_buckets(bounds, counts, 0.90) * 1e3, 3),
+            "p99_ms": round(
+                percentile_from_buckets(bounds, counts, 0.99) * 1e3, 3),
+        }
+    return out
+
+
+def counter_deltas(before: dict, after: dict) -> dict:
+    out = {}
+    for name in _COUNTERS:
+        a = after.get(name, {}).get("value")
+        if a is None:
+            continue
+        b = before.get(name, {}).get("value") or 0
+        out[name] = int(a - b)
+    return out
+
+
+def _spawn_daemon(sock: str, slowlog: int, trace_buffer: int):
+    """Host-only convenience: spawn a private daemon for the replay and
+    SIGTERM it afterwards.  CPU-tier only — an on-chip daemon holds the
+    relay claim and must be driven, not owned, by this gate."""
+    # a stale socket file from a killed earlier run would satisfy the
+    # readiness poll before the child ever binds (skipping its crash
+    # detection); the daemon unlinks on bind, so pre-clear it here too
+    if os.path.exists(sock):
+        os.unlink(sock)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tpulab.daemon", "--socket", sock,
+         "--slowlog", str(slowlog), "--trace-buffer", str(trace_buffer)],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(
+                f"spawned daemon exited rc={proc.returncode} before "
+                f"its socket appeared")
+        if os.path.exists(sock):
+            return proc
+        time.sleep(0.1)
+    proc.send_signal(signal.SIGTERM)
+    raise RuntimeError("spawned daemon socket never appeared")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--socket", default="/tmp/tpulab.sock")
+    ap.add_argument("--spawn-daemon", action="store_true",
+                    help="spawn a private daemon on --socket for the "
+                         "replay and stop it after (HOST tier only — "
+                         "never own a chip-claiming daemon from here)")
+    ap.add_argument("--trace", default=None, metavar="FILE",
+                    help="replay this committed trace JSON instead of "
+                         "building one from --spec")
+    ap.add_argument("--spec", default="fast",
+                    help=f"built-in spec name ({sorted(loadgen.SPECS)}) "
+                         f"when --trace is not given")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="override the spec seed")
+    ap.add_argument("--n", type=int, default=None,
+                    help="override the spec request count")
+    ap.add_argument("--write-trace", default=None, metavar="FILE",
+                    help="persist the built trace JSON (the run's exact "
+                         "workload definition)")
+    ap.add_argument("--time-scale", type=float, default=1.0,
+                    help="multiply trace send times (0 = fire as fast "
+                         "as possible)")
+    ap.add_argument("--timeout-s", type=float, default=120.0,
+                    help="per-request hard deadline during replay")
+    ap.add_argument("--warmup", type=int, default=2, metavar="N",
+                    help="generate requests sent before the measured "
+                         "window (engine build + XLA compile must not "
+                         "count against the first trace row's TTFT)")
+    ap.add_argument("--slowlog", type=int, default=8, metavar="N",
+                    help="worst-N slow-log entries to embed in the report")
+    ap.add_argument("--out", default=None, metavar="FILE",
+                    help="write the full JSON report here")
+    ap.add_argument("--min-attainment", type=float, default=0.0,
+                    help="hard floor on overall SLO attainment (exit 1 "
+                         "below it)")
+    ap.add_argument("--check-baselines", action="store_true",
+                    help="gate the emitted rows against "
+                         "results/baselines.json via check_regression "
+                         "(exit 1 on regression)")
+    ap.add_argument("--baselines", default=str(ROOT / "results"
+                                               / "baselines.json"))
+    args = ap.parse_args(argv)
+
+    rep = _load_obs_report()
+    if args.trace:
+        trace = loadgen.Trace.load(args.trace)
+    else:
+        spec = loadgen.built_in_spec(args.spec)
+        if args.seed is not None or args.n is not None:
+            from dataclasses import replace
+
+            spec = replace(
+                spec,
+                **({"seed": args.seed} if args.seed is not None else {}),
+                **({"n_requests": args.n} if args.n is not None else {}))
+        trace = loadgen.build_trace(spec)
+    if args.write_trace:
+        trace.save(args.write_trace)
+    name = trace.spec.get("name", "trace")
+
+    daemon_proc = None
+    if args.spawn_daemon:
+        daemon_proc = _spawn_daemon(args.socket, max(args.slowlog, 16),
+                                    1 << 16)
+    try:
+        # warmup OUTSIDE the measured window: the first request pays
+        # engine build + XLA compile; a goodput number that charges
+        # cold start to the first trace row measures the wrong thing
+        for i in range(args.warmup):
+            rep.request_with_retry(args.socket, "generate", {"steps": 4},
+                                   b"goodput gate warmup", deadline_s=300.0)
+        before = rep.parse_prometheus(
+            rep.request(args.socket, "metrics").decode("utf-8"))
+        results, wall_s = loadgen.replay(
+            trace, args.socket, time_scale=args.time_scale,
+            timeout_s=args.timeout_s,
+            log=lambda m: print(m, file=sys.stderr, flush=True))
+        after = rep.parse_prometheus(
+            rep.request(args.socket, "metrics").decode("utf-8"))
+        slow = json.loads(rep.request(args.socket, "slowlog",
+                                      {"n": args.slowlog}))
+    finally:
+        if daemon_proc is not None:
+            daemon_proc.send_signal(signal.SIGTERM)
+            try:
+                daemon_proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                daemon_proc.kill()
+
+    goodput = loadgen.summarize(results, trace, wall_s)
+    report = {
+        "trace": {"name": name, "seed": trace.spec.get("seed"),
+                  "n_requests": len(trace.requests),
+                  "arrival": trace.spec.get("arrival"),
+                  "source": args.trace or f"spec:{args.spec}"},
+        "goodput": goodput,
+        "server_window": window_percentiles(before, after),
+        "counters": counter_deltas(before, after),
+        "slowlog": slow.get("worst", []),
+        "results": results,
+    }
+    if args.out:
+        out = pathlib.Path(args.out)
+        out.parent.mkdir(parents=True, exist_ok=True)
+        out.write_text(json.dumps(report, indent=2) + "\n")
+        print(f"[goodput_gate] report -> {args.out}", file=sys.stderr,
+              flush=True)
+
+    overall = goodput["overall"]
+    rows = [
+        {"metric": f"goodput_{name}_goodput_tokens_per_s",
+         "value": overall["goodput_tokens_per_s"], "unit": "tokens/s",
+         "vs_baseline": None, "attainment": overall["attainment"],
+         "completed": overall["completed"], "shed": overall["shed"],
+         "cancelled": overall["cancelled"], "errors": overall["errors"],
+         "wall_s": overall["wall_s"]},
+        {"metric": f"goodput_{name}_slo_attainment",
+         "value": overall["attainment"], "unit": "fraction",
+         "vs_baseline": None, "in_slo": overall["in_slo"],
+         "eligible": overall["n"] - overall["cancelled"]},
+    ]
+    for row in rows:
+        print(json.dumps(row), flush=True)
+
+    rc = 0
+    if overall["errors"]:
+        bad = [r for r in results if r["error"]][:3]
+        print(f"[goodput_gate] FAIL: {overall['errors']} hard error(s), "
+              f"e.g. {bad}", file=sys.stderr, flush=True)
+        rc = 1
+    att = overall["attainment"]
+    if att is not None and att < args.min_attainment:
+        print(f"[goodput_gate] FAIL: attainment {att} < floor "
+              f"{args.min_attainment}", file=sys.stderr, flush=True)
+        rc = 1
+    if args.check_baselines:
+        cr_spec = importlib.util.spec_from_file_location(
+            "check_regression", pathlib.Path(__file__).resolve().parent
+            / "check_regression.py")
+        check_regression = importlib.util.module_from_spec(cr_spec)
+        cr_spec.loader.exec_module(check_regression)
+
+        with tempfile.NamedTemporaryFile("w", suffix=".jsonl",
+                                         delete=False) as f:
+            for row in rows:
+                f.write(json.dumps(row) + "\n")
+            rows_path = f.name
+        try:
+            gate_rc = check_regression.main(
+                [rows_path, "--baselines", args.baselines])
+        finally:
+            os.unlink(rows_path)
+        rc = rc or gate_rc
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

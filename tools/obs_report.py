@@ -10,6 +10,9 @@ socket and issues the observability requests this layer added:
   * ``trace_dump`` — the ring-buffer tracer's retained window as Chrome
     trace-event JSON (``--trace-out FILE``; open the file directly in
     https://ui.perfetto.dev).
+  * ``slowlog`` — the worst-N requests by e2e latency with their
+    per-request span summaries (``--slowlog N``); each entry's ``rid``
+    links it to the same request's events in the trace dump.
 
 The summary table is the serving-metrics view production TPU serving
 comparisons report (PAPERS.md, arXiv:2605.25645): p50/p90/p99 TTFT,
@@ -42,12 +45,13 @@ import sys
 ROOT = pathlib.Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(ROOT))
 
+from tpulab.loadgen import SHED_RE as _SHED_RE  # noqa: E402
 from tpulab.obs.registry import percentile_from_buckets  # noqa: E402
 
-#: shed response contract (tpulab.daemon.ShedError): an error frame
-#: whose body starts with this line is BACKPRESSURE, not a failure —
-#: honor the retry-after and try again inside the caller's deadline
-_SHED_RE = re.compile(r"shed retry_after_ms=(\d+)")
+#: _SHED_RE (tpulab.loadgen.SHED_RE — the ONE copy of the client-side
+#: shed contract): an error frame whose body matches is BACKPRESSURE,
+#: not a failure — honor the retry-after and try again inside the
+#: caller's deadline
 
 #: histograms the summary table reports, in display order
 _LATENCY_METRICS = ("ttft_seconds", "itl_seconds", "e2e_seconds",
@@ -228,6 +232,10 @@ def main(argv=None) -> int:
     ap.add_argument("--trace-out", default=None, metavar="FILE",
                     help="also request trace_dump and write the Chrome "
                          "trace JSON here (open in ui.perfetto.dev)")
+    ap.add_argument("--slowlog", type=int, default=0, metavar="N",
+                    help="also print the daemon's worst-N slow-log "
+                         "entries (per-request span summaries; each "
+                         "rid links to the trace_dump events)")
     ap.add_argument("--raw", action="store_true",
                     help="print the raw Prometheus text instead of the "
                          "summary table")
@@ -249,19 +257,38 @@ def main(argv=None) -> int:
         pathlib.Path(args.trace_out).write_bytes(trace)
         print(f"[obs_report] trace written to {args.trace_out} "
               f"(open in ui.perfetto.dev)", file=sys.stderr)
+    slow = None
+    if args.slowlog:
+        slow = json.loads(request(args.socket, "slowlog",
+                                  {"n": args.slowlog}))
     if args.json:
-        print(json.dumps({"latency": rows}))
+        out = {"latency": rows}
+        if slow is not None:
+            out["slowlog"] = slow.get("worst", [])
+        print(json.dumps(out))
         return 0
     if not rows:
         print("no latency histograms populated yet "
               "(drive some generate traffic, or --drive N)")
-        return 0
-    w = max(len(r["metric"]) for r in rows)
-    print(f"{'metric':<{w}}  {'count':>7}  {'p50_ms':>9}  "
-          f"{'p90_ms':>9}  {'p99_ms':>9}")
-    for r in rows:
-        print(f"{r['metric']:<{w}}  {r['count']:>7}  {r['p50_ms']:>9.3f}  "
-              f"{r['p90_ms']:>9.3f}  {r['p99_ms']:>9.3f}")
+    else:
+        w = max(len(r["metric"]) for r in rows)
+        print(f"{'metric':<{w}}  {'count':>7}  {'p50_ms':>9}  "
+              f"{'p90_ms':>9}  {'p99_ms':>9}")
+        for r in rows:
+            print(f"{r['metric']:<{w}}  {r['count']:>7}  "
+                  f"{r['p50_ms']:>9.3f}  {r['p90_ms']:>9.3f}  "
+                  f"{r['p99_ms']:>9.3f}")
+    if slow is not None:
+        print(f"slowlog: worst {len(slow.get('worst', []))} of "
+              f"{slow.get('recorded', 0)} recorded")
+        for e in slow.get("worst", []):
+            print(f"  rid={e.get('rid')} tag={e.get('tag') or '-'} "
+                  f"e2e={e.get('e2e_ms')}ms ttft={e.get('ttft_ms')}ms "
+                  f"itl_max={e.get('itl_max_ms')}ms"
+                  f"@tok{e.get('itl_max_at_token')} "
+                  f"queue={e.get('queue_wait_ms')}ms "
+                  f"chunks={e.get('prefill_chunks')} "
+                  f"tokens={e.get('tokens')}")
     return 0
 
 

@@ -96,6 +96,41 @@ def bench_config(s: int, bq: int, bk: int, *, heads: int = 8, d: int = 64,
     return row
 
 
+def select_best(rows, seqs, train_shape=None):
+    """Winner pools from a finished sweep — factored out of main so the
+    pool discipline is directly testable (host-only, no chip).
+
+    Per-seq ``fwd_s*``/``bwd_s*``/``fwdbwd_s*`` pools admit **b=1 rows
+    only**: phase 3's ``--train-shape`` rows share a seq with the
+    per-seq sweep, and a batched row's time would contaminate the b=1
+    winner pool (round-5 advisor finding — today a batch-8 time can
+    never win the min, but ``--train-shape S,1`` or future shapes
+    would slip in silently without the filter).  The train shape gets
+    its own dedicated ``fwdbwd_train_s{S}_b{B}`` key, matched on the
+    exact (seq, batch) pair."""
+    best = {}
+    for s in seqs:
+        pool = [r for r in rows if r["seq"] == s and r.get("batch", 1) == 1]
+        cand = [r for r in pool if "fwd_ms" in r]
+        if cand:
+            best[f"fwd_s{s}"] = min(cand, key=lambda r: r["fwd_ms"])
+        cand_b = [r for r in pool if "fwdbwd_ms" in r]
+        if cand_b:
+            best[f"fwdbwd_s{s}"] = min(cand_b, key=lambda r: r["fwdbwd_ms"])
+        cand_bo = [r for r in pool if "bwd_ms" in r]
+        if cand_bo:
+            best[f"bwd_s{s}"] = min(cand_bo, key=lambda r: r["bwd_ms"])
+    if train_shape:
+        ts, tb = train_shape
+        cand_t = [r for r in rows
+                  if r["seq"] == ts and r.get("batch") == tb
+                  and "fwdbwd_ms" in r]
+        if cand_t:
+            best[f"fwdbwd_train_s{ts}_b{tb}"] = min(
+                cand_t, key=lambda r: r["fwdbwd_ms"])
+    return best
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--seqs", type=int, nargs="+", default=[8192, 32768])
@@ -193,29 +228,7 @@ def main(argv=None) -> int:
             tbest = min(good, key=lambda r: r["fwdbwd_ms"])
             print(json.dumps({"train_shape_winner": tbest}), flush=True)
 
-    best = {}
-    for s in args.seqs:
-        # b=1 rows only: phase 3's --train-shape rows share a seq with
-        # the per-seq sweep, and a batched row's time would contaminate
-        # the b=1 winner pool (round-5 advisor finding)
-        pool = [r for r in rows if r["seq"] == s and r.get("batch", 1) == 1]
-        cand = [r for r in pool if "fwd_ms" in r]
-        if cand:
-            best[f"fwd_s{s}"] = min(cand, key=lambda r: r["fwd_ms"])
-        cand_b = [r for r in pool if "fwdbwd_ms" in r]
-        if cand_b:
-            best[f"fwdbwd_s{s}"] = min(cand_b, key=lambda r: r["fwdbwd_ms"])
-        cand_bo = [r for r in pool if "bwd_ms" in r]
-        if cand_bo:
-            best[f"bwd_s{s}"] = min(cand_bo, key=lambda r: r["bwd_ms"])
-    if train_shape:
-        ts, tb = train_shape
-        cand_t = [r for r in rows
-                  if r["seq"] == ts and r.get("batch") == tb
-                  and "fwdbwd_ms" in r]
-        if cand_t:
-            best[f"fwdbwd_train_s{ts}_b{tb}"] = min(
-                cand_t, key=lambda r: r["fwdbwd_ms"])
+    best = select_best(rows, args.seqs, train_shape)
     report = {
         "device_kind": dev.device_kind,
         "peak_tflops_bf16": peak,

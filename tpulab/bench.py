@@ -535,9 +535,12 @@ def bench_obs_overhead(
 ) -> Dict[str, Any]:
     """Observability tax on the serving hot path: steady-state engine
     ticks/s with the tpulab.obs layer fully ON (latency histograms +
-    ring-buffer tracer recording) vs fully OFF (``PagedEngine(obs=
-    False)`` + tracer disabled) — the same mid-generation window as
-    ``bench_paged_tick``, no admission or release inside it.
+    ring-buffer tracer recording, including the round-12 rid-carrying
+    request events — ``engine.token`` records on NEW-WORST inter-token
+    gaps only, exactly so this budget holds; the per-token form
+    measured ~5%) vs fully OFF (``PagedEngine(obs=False)`` + tracer
+    disabled) — the same mid-generation window as ``bench_paged_tick``,
+    no admission or release inside it.
 
     The ISSUE budget is <3% overhead; the assert below enforces it on
     the BEST-of-reps pair (min wall time per mode — medians of a ~70 ms

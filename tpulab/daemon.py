@@ -28,7 +28,13 @@ gauges summed across the warm engines — scrape with
 ``tools/obs_report.py``);
 ``trace_dump`` returns the ring-buffer tracer's retained window as
 Chrome trace-event JSON (loads in Perfetto; size with
-``--trace-buffer N``).
+``--trace-buffer N``); ``slowlog`` returns the worst-N requests by
+end-to-end latency with their per-request span summaries (queue wait,
+prefill chunks, TTFT, worst inter-token gap and the token it landed
+on — size with ``--slowlog N``), each entry rid-linked to its
+``trace_dump`` events.  A generate request may carry ``tag`` (opaque
+label echoed in the slow-log entry — load generators key it to their
+trace rows).
 
 Fault tolerance (round 11): a generate request may carry
 ``deadline_ms`` (queue-wait-based load shedding: once a queue exists
@@ -390,6 +396,7 @@ class _GenerateService:
                  repetition_penalty: float = 1.0, stop_byte: int = -1,
                  spec: str = "off", spec_k: int = 0, spec_ngram: int = 0,
                  deadline_ms=None, priority: int = 0,
+                 req_rid=None, tag: str = "",
                  on_progress=None):
         """Block until the request finishes; returns the full token
         array.  ``on_progress(new_tokens)``, if given, is called with
@@ -407,19 +414,29 @@ class _GenerateService:
             while st.rebuilding:  # park until the supervisor swaps in
                 st.cond.wait()    # the replacement engine
             engine = st.engine  # supervision may have swapped the object
-            self._shed_check(engine, deadline_ms)
             try:
+                self._shed_check(engine, deadline_ms)
                 rid = engine.submit(prompt, max_new=steps,
                                     temperature=temperature, seed=seed,
                                     repetition_penalty=repetition_penalty,
                                     stop_byte=stop_byte, spec=spec,
                                     spec_k=spec_k, spec_ngram=spec_ngram,
-                                    priority=priority)
+                                    priority=priority, rid=req_rid,
+                                    tag=tag)
             except QueueFullError as e:
                 # bounded admission queue: backpressure surfaces as a
                 # shed-with-retry-after, never unbounded growth
                 _C_SHED.inc()
+                if req_rid is not None:
+                    _obs.event("daemon.shed", req_rid)
                 raise ShedError(self._retry_after_ms(), str(e)) from e
+            except ShedError:
+                # deadline shedding (_shed_check): the trace event rides
+                # the caller-allocated rid so a shed request is visible
+                # in the same rid-keyed event stream as admitted ones
+                if req_rid is not None:
+                    _obs.event("daemon.shed", req_rid)
+                raise
             req = engine.pending[-1]  # just appended under this cond
             if not st.stepper_alive:
                 st.stepper_alive = True
@@ -656,6 +673,7 @@ class _GenerateService:
                     continue
                 new_engine.resubmit(req)
                 _C_REPLAYS.inc()
+                _obs.event("daemon.replay", req.rid)
             st.stepper_alive = True
             threading.Thread(
                 target=self._step_loop, args=(new_engine, st), daemon=True
@@ -881,6 +899,13 @@ def _handle_generate(header: dict, payload: bytes,
             raise ValueError(
                 f"deadline_ms must be > 0, got {deadline_ms}")
     priority = int(config.get("priority", 0))
+    # per-request tracing identity: the rid is allocated HERE — before
+    # admission — so a shed request's daemon.shed event shares the id
+    # its engine events would have carried; ``tag`` is the caller's
+    # opaque label (a load generator's trace-row key), echoed in the
+    # slow-log entry
+    tag = str(config.get("tag", ""))
+    req_rid = _obs.next_rid()
     prefill_chunk = int(config.get("prefill_chunk", PREFILL_CHUNK))
     if prefill_chunk < 0:
         raise ValueError(
@@ -1055,6 +1080,7 @@ def _handle_generate(header: dict, payload: bytes,
         stop_byte=eng_stop,
         spec=spec_mode, spec_k=spec_k, spec_ngram=spec_ngram,
         deadline_ms=deadline_ms, priority=priority,
+        req_rid=req_rid, tag=tag,
         on_progress=on_progress,
     )
     if tok is None:
@@ -1138,6 +1164,29 @@ def _handle_trace_dump(header: dict) -> bytes:
     return json.dumps(obs.TRACER.chrome_trace()).encode("utf-8")
 
 
+def _handle_slowlog(header: dict) -> bytes:
+    """``slowlog`` request: the worst-N requests BY end-to-end latency
+    with their span summaries (queue wait / prefill chunks / TTFT /
+    worst inter-token gap + the token index it landed on / preemptions
+    / resubmits — tpulab.obs.slowlog) as JSON.  Each entry's ``rid``
+    links it to the same request's events in a ``trace_dump`` — "p99
+    blew the budget" converts into "this request, this tick".  Config:
+    ``n`` caps the returned entries (default 10); ``clear`` resets the
+    log after the read (a capture run that wants per-window worsts).
+    Size the window with ``--slowlog``."""
+    from tpulab import obs
+
+    config = header.get("config") or {}
+    n = int(config.get("n", 10))
+    # one atomic snapshot(+clear): entries and the recorded count come
+    # from the same lock acquisition, and under ``clear`` an entry
+    # retiring mid-request lands in exactly one window — never in
+    # neither, never counted-but-missing
+    return json.dumps(
+        obs.SLOWLOG.snapshot(n, clear=bool(config.get("clear")))
+    ).encode("utf-8")
+
+
 # Lab runs are SERIALIZED even though connections are threaded: their
 # "execution time:" lines feed the harness's stats CSVs, and two timed
 # kernels sharing the device would inflate each other's numbers.  (A
@@ -1156,6 +1205,8 @@ def handle_request(header: dict, payload: bytes,
         return _handle_metrics(header)
     if header.get("lab") == "trace_dump":
         return _handle_trace_dump(header)
+    if header.get("lab") == "slowlog":
+        return _handle_slowlog(header)
     if header.get("lab") == "platform":
         # observability: which backend this daemon actually computes on
         # (tools/run_reference_harness.py --backend tpu refuses to write
@@ -1356,16 +1407,28 @@ def main(argv=None) -> int:
                          "32768; 0 disables tracing).  Dump the retained "
                          "window with a 'trace_dump' request — the JSON "
                          "loads directly in Perfetto")
+    ap.add_argument("--slowlog", type=int, default=None, metavar="N",
+                    help="per-request slow-log window: keep the worst N "
+                         "requests by e2e latency (default 64; 0 "
+                         "disables).  Read with a 'slowlog' request — "
+                         "each entry's rid links to its trace_dump "
+                         "events")
     args = ap.parse_args(argv)
     if args.prefill_chunk < 0:
         ap.error("--prefill-chunk must be >= 0")
     if args.trace_buffer is not None and args.trace_buffer < 0:
         ap.error("--trace-buffer must be >= 0")
+    if args.slowlog is not None and args.slowlog < 0:
+        ap.error("--slowlog must be >= 0")
     PREFILL_CHUNK = args.prefill_chunk
     if args.trace_buffer is not None:
         from tpulab import obs
 
         obs.configure_tracer(args.trace_buffer)
+    if args.slowlog is not None:
+        from tpulab import obs
+
+        obs.configure_slowlog(args.slowlog)
     if _faults.configure_from_env():
         # chaos runs against a REAL daemon: arm the injector from
         # TPULAB_FAULTS (JSON schedule) — absent means inert

@@ -116,6 +116,7 @@ from tpulab import faults as _faults
 from tpulab.obs import tracer as _obs_tracer
 from tpulab.obs.registry import gauge as _obs_gauge
 from tpulab.obs.registry import histogram as _obs_histogram
+from tpulab.obs.slowlog import SLOWLOG as _SLOWLOG
 from tpulab.models.generate import (_attend_cached, _forward_window,
                                     _prefill, apply_repetition_penalty)
 from tpulab.models.labformer import LabformerConfig, _mlp, _rmsnorm, _rope
@@ -680,6 +681,20 @@ class _Request:
     t_submit: float = field(default_factory=time.monotonic)
     t_admit: float = 0.0
     t_last: float = 0.0         # previous drained-token time (ITL)
+    # per-request span summary (tpulab.obs.slowlog; all host-side, set
+    # only when the engine records observability): ``rid`` is the
+    # process-unique request id every tracer event carries (engine
+    # req_id restarts per engine/rebuild, so it cannot key a trace);
+    # ``tag`` is the caller's label (daemon wire config), echoed in the
+    # slow-log entry so a load generator can map it back to its trace
+    rid: int = 0
+    tag: str = ""
+    resubmits: int = 0          # preemption requeues + supervisor replays
+    pf_chunks: int = 0          # prefill windows dispatched (incl. draft)
+    t_first: float = 0.0        # first drained token (TTFT end)
+    t_prefill_done: float = 0.0
+    itl_max: float = 0.0        # worst inter-token gap (seconds)...
+    itl_max_at: int = 0         # ...and the token index it ended at
 
     def total_positions(self) -> int:
         """Positions this request can ever occupy: prompt + remaining
@@ -689,6 +704,37 @@ class _Request:
         sizing site (submit validation, admission claim, release deref)
         uses THIS so claims and releases can never disagree."""
         return len(self.prompt) + self.max_new - self.n_resumed
+
+
+def _span_summary(req: _Request, now: float) -> Dict:
+    """Compact per-request span summary for the slow log (milliseconds,
+    host timestamps only — built ONCE at retirement, never per tick).
+    Zero timestamps (a span that never happened: no token before a
+    cancel, no interleaved prefill) render as None rather than a bogus
+    submit-relative delta."""
+    ms = 1e3
+    return {
+        "rid": req.rid,
+        "tag": req.tag,
+        "e2e_ms": round((now - req.t_submit) * ms, 3),
+        "queue_wait_ms": (round((req.t_admit - req.t_submit) * ms, 3)
+                          if req.t_admit else None),
+        "prefill_ms": (round((req.t_prefill_done - req.t_admit) * ms, 3)
+                       if req.t_prefill_done else None),
+        "ttft_ms": (round((req.t_first - req.t_submit) * ms, 3)
+                    if req.t_first else None),
+        "itl_max_ms": round(req.itl_max * ms, 3),
+        "itl_max_at_token": req.itl_max_at,
+        # prompt net of tokens resubmit folded back in: the ORIGINAL
+        # prompt length, stable across preemption/replay resumes
+        "prompt_len": int(len(req.prompt) - req.n_resumed),
+        "tokens": len(req.out),
+        "prefill_chunks": req.pf_chunks,
+        "preemptions": req.preemptions,
+        "resubmits": req.resubmits,
+        "priority": req.priority,
+        "cancelled": bool(req.cancelled),
+    }
 
 
 class PagedEngine:
@@ -712,10 +758,15 @@ class PagedEngine:
     only the tick on which a request's FIRST token appears moves.
 
     ``obs=True`` (default) records per-request latency histograms
-    (queue_wait / prefill / ttft / itl / e2e — tpulab.obs registry) and
-    ring-buffer trace events at the host-side boundaries; pure host
-    timestamps, so every device-transfer contract above is unchanged.
-    ``obs=False`` silences both (the ``obs_overhead`` bench's A/B).
+    (queue_wait / prefill / ttft / itl / e2e — tpulab.obs registry),
+    ring-buffer trace events at the host-side boundaries (every
+    request-scoped event carries the request's process-unique ``rid``,
+    so one request's events form a linked span tree: submit -> admit ->
+    prefill_chunk* -> first_token -> token* -> retire), and a worst-N
+    per-request span summary into the process slow log
+    (tpulab.obs.slowlog) at retirement; pure host timestamps, so every
+    device-transfer contract above is unchanged.  ``obs=False``
+    silences all of it (the ``obs_overhead`` bench's A/B).
 
     Fault tolerance (round 11): ``max_pending`` bounds the admission
     queue (``submit`` raises :class:`QueueFullError` past it —
@@ -1021,7 +1072,8 @@ class PagedEngine:
     def submit(self, prompt, max_new: int, *, temperature: float = 0.0,
                seed: int = 0, repetition_penalty: float = 1.0,
                stop_byte: int = -1, spec: str = "off", spec_k: int = 0,
-               spec_ngram: int = 0, priority: int = 0) -> int:
+               spec_ngram: int = 0, priority: int = 0,
+               rid: Optional[int] = None, tag: str = "") -> int:
         """Queue a request.  ``temperature == 0`` decodes greedily;
         otherwise the slot samples from its own seeded PRNG stream —
         per-request sampling coexists with greedy slots in one batch.
@@ -1039,7 +1091,12 @@ class PagedEngine:
         ``spec="off"``).  A sampled (``temperature > 0``) request keeps
         its spec flag but falls back to single-token ticks inside the
         same batch.  ``spec_ngram`` overrides the engine's lookup
-        n-gram length (0 = engine default)."""
+        n-gram length (0 = engine default).
+
+        ``rid`` is the process-unique request id for tracing/slow-log
+        linkage (allocated here when None — pass one only to share it
+        with pre-submit events); ``tag`` is an opaque caller label
+        echoed in the slow-log entry.  Neither affects decode."""
         if self.max_pending and len(self.pending) >= self.max_pending:
             raise QueueFullError(
                 f"admission queue at max_pending={self.max_pending}; "
@@ -1084,15 +1141,22 @@ class PagedEngine:
                 f"({self.max_blocks} blocks/slot, pool "
                 f"{self.n_usable_blocks} blocks)"
             )
-        rid = self._next_id
+        req_id = self._next_id
         self._next_id += 1
-        self.pending.append(
-            _Request(rid, prompt, max_new, float(temperature), int(seed),
-                     float(repetition_penalty), int(stop_byte), spec,
-                     int(spec_k) or self.spec_k,
-                     int(spec_ngram) or self.spec_ngram, int(priority))
-        )
-        return rid
+        req = _Request(req_id, prompt, max_new, float(temperature),
+                       int(seed), float(repetition_penalty), int(stop_byte),
+                       spec, int(spec_k) or self.spec_k,
+                       int(spec_ngram) or self.spec_ngram, int(priority))
+        # process-unique rid: the LINK between this request's tracer
+        # events and its slow-log entry.  Callers (the daemon) may
+        # allocate it up front so pre-admission events (daemon.shed)
+        # share the id; allocated here otherwise.
+        req.rid = int(rid) if rid is not None else _obs_tracer.next_rid()
+        req.tag = str(tag)
+        if self.obs:
+            self._trace.event("engine.submit", req.rid)
+        self.pending.append(req)
+        return req_id
 
     def _blocks_needed(self, n_positions: int) -> int:
         return -(-n_positions // self.block_size)
@@ -1169,7 +1233,7 @@ class PagedEngine:
             req.t_admit = time.monotonic()
             if self.obs:
                 _H_QUEUE_WAIT.observe(req.t_admit - req.t_submit)
-                self._trace.event("engine.admit", req.req_id)
+                self._trace.event("engine.admit", req.rid)
             fresh = [self.free.pop() for _ in range(need_new)]
             for b in fresh:
                 self.block_refs[b] += 1
@@ -1225,7 +1289,8 @@ class PagedEngine:
                 if self.obs:
                     # dispatch-side prefill wall time (the synchronous
                     # path runs every chunk inline right here)
-                    _H_PREFILL.observe(time.monotonic() - req.t_admit)
+                    req.t_prefill_done = time.monotonic()
+                    _H_PREFILL.observe(req.t_prefill_done - req.t_admit)
                 self._push_slot(s, True)
 
     def _register_prefix(self, prompt: np.ndarray, row: np.ndarray):
@@ -1264,7 +1329,8 @@ class PagedEngine:
                 chunk = self.prefill_chunk or (p - shared_pos)
                 while start < p:
                     start = self._extend_window(s, req.prompt, start,
-                                                chunk, p)
+                                                chunk, p, req.rid)
+                    req.pf_chunks += 1
                 self._stall_prefill_credit += 1
             else:
                 bucket = _bucket(p)
@@ -1280,6 +1346,7 @@ class PagedEngine:
                     self.block_size,
                 )
                 self.counters["prefill_chunks"] += 1
+                req.pf_chunks += 1
                 self._stall_prefill_dispatches += 1
                 self._stall_prefill_credit += 1
         self.lengths[s] = p
@@ -1306,11 +1373,12 @@ class PagedEngine:
         # one prefill program, same accounting as the dense target
         # branch (the stats() contract counts target + draft programs)
         self.counters["prefill_chunks"] += 1
+        req.pf_chunks += 1
         self._stall_prefill_dispatches += 1
         self._stall_prefill_credit += 1
 
     def _extend_window(self, s: int, prompt: np.ndarray, start: int,
-                       chunk: int, end: int) -> int:
+                       chunk: int, end: int, rid: int = 0) -> int:
         """Dispatch ONE ``paged_extend`` window for slot ``s``
         (positions ``start .. min(start + chunk, end)``) — the shared
         chunk body of the synchronous loop and the interleaved per-tick
@@ -1330,12 +1398,19 @@ class PagedEngine:
             self._note_dense_bucket(bucket)
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :len(tail)] = tail
-        with self._trace.span("engine.prefill_chunk"):
+        # begin/end rather than the cached span handle: the B record
+        # carries the request's rid, linking this chunk's duration into
+        # the request's span tree (engine.submit -> admit ->
+        # prefill_chunk* -> first_token -> token* -> retire)
+        self._trace.begin("engine.prefill_chunk", rid or None)
+        try:
             self.kpool, self.vpool = paged_extend(
                 self.params, jnp.asarray(padded), self.kpool, self.vpool,
                 jnp.asarray(self.tables[s]), start, len(tail),
                 self.cfg, self.block_size, bucket,
             )
+        finally:
+            self._trace.end("engine.prefill_chunk")
         self.counters["prefill_chunks"] += 1
         self._stall_prefill_dispatches += 1
         return start + len(tail)
@@ -1372,7 +1447,8 @@ class PagedEngine:
         if req.pf_pos < p:
             chunk = self.prefill_chunk or (p - req.pf_pos)
             req.pf_pos = self._extend_window(s, req.prompt, req.pf_pos,
-                                             chunk, p)
+                                             chunk, p, req.rid)
+            req.pf_chunks += 1
             self._stall_prefill_credit += 1
             self._h2d = True
         if req.spec == "draft" and req.d_pf_pos < p:
@@ -1383,13 +1459,17 @@ class PagedEngine:
             bucket = _bucket(self.prefill_chunk)
             padded = np.zeros((1, bucket), np.int32)
             padded[0, :n] = req.prompt[req.d_pf_pos:req.d_pf_pos + n]
-            with self._trace.span("engine.prefill_chunk"):
+            self._trace.begin("engine.prefill_chunk", req.rid or None)
+            try:
                 self.d_kc, self.d_vc = _draft_extend(
                     self.draft_params, jnp.asarray(padded), self.d_kc,
                     self.d_vc, s, req.d_pf_pos, self.draft_cfg, bucket,
                 )
+            finally:
+                self._trace.end("engine.prefill_chunk")
             req.d_pf_pos += n
             self.counters["prefill_chunks"] += 1
+            req.pf_chunks += 1
             self._stall_prefill_dispatches += 1
             self._stall_prefill_credit += 1
             self._h2d = True
@@ -1412,7 +1492,8 @@ class PagedEngine:
             # admission -> final chunk dispatched (host-side span of the
             # interleaved prefill; the chunks themselves ride the async
             # dispatch stream)
-            _H_PREFILL.observe(time.monotonic() - req.t_admit)
+            req.t_prefill_done = time.monotonic()
+            _H_PREFILL.observe(req.t_prefill_done - req.t_admit)
         self._push_slot(s, True)
 
     def _prefill_tick(self) -> List[int]:
@@ -1468,10 +1549,24 @@ class PagedEngine:
                 # first drained token: TTFT is host-observed — under
                 # overlap=1 it includes the one-tick drain delay, which
                 # is exactly what a streaming client experiences
+                req.t_first = now
                 _H_TTFT.observe(now - req.t_submit)
-                self._trace.event("engine.first_token", req.req_id)
+                self._trace.event("engine.first_token", req.rid)
             elif req.t_last:
-                _H_ITL.observe(now - req.t_last)
+                itl = now - req.t_last
+                _H_ITL.observe(itl)
+                if itl > req.itl_max:
+                    # the worst inter-token gap AND the token index it
+                    # ended at: the slow-log's "here is the tick where
+                    # it stalled" answer.  Only NEW-WORST gaps earn a
+                    # trace event — the request's stall timeline stays
+                    # rid-linked in the dump while the steady state
+                    # (every tick the same pace) appends nothing, which
+                    # is what keeps the obs_overhead bench inside its
+                    # 3% budget (a per-token event measured ~5%)
+                    req.itl_max = itl
+                    req.itl_max_at = len(req.out)
+                    self._trace.event("engine.token", req.rid)
             req.t_last = now
         self.counters["tokens_out"] += 1
         req.out.append(tok)
@@ -1489,8 +1584,10 @@ class PagedEngine:
         blocks).  TRASH entries are blocks the sliding-window retirement
         already released mid-decode."""
         if self.obs:
-            _H_E2E.observe(time.monotonic() - req.t_submit)
-            self._trace.event("engine.retire", req.req_id)
+            now = time.monotonic()
+            _H_E2E.observe(now - req.t_submit)
+            self._trace.event("engine.retire", req.rid)
+            _SLOWLOG.record(_span_summary(req, now))
         self._release_blocks(s, req)
         self._clear_slot(s)
         self._done[req.req_id] = np.asarray(req.out, np.int32)
@@ -1574,6 +1671,9 @@ class PagedEngine:
                 _advance_key(key, len(req.out)), np.uint32)
         req.phase = "decode"
         req.pf_pos = req.pf_end = req.d_pf_pos = 0
+        req.resubmits += 1
+        if self.obs:
+            self._trace.event("engine.resubmit", req.rid)
         self._next_id = max(self._next_id, req.req_id + 1)
         self.pending.append(req)
         return req.req_id
@@ -1614,7 +1714,7 @@ class PagedEngine:
             return True  # the drain itself retired the victim
         self.counters["preemptions"] += 1
         req.preemptions += 1
-        self._trace.event("engine.preempt", req.req_id)
+        self._trace.event("engine.preempt", req.rid)
         self._release_blocks(s, req)
         self._clear_slot(s)
         self.resubmit(req)

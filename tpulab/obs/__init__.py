@@ -6,7 +6,12 @@ Two stdlib-only primitives the whole stack records into:
   fixed-bucket ``Histogram`` registry with Prometheus text exposition
   and copy-on-read snapshots.
 * :mod:`tpulab.obs.tracer` — preallocated ring-buffer timeline tracer
-  (``span``/``event``) with Chrome-trace JSON export for Perfetto.
+  (``span``/``event``) with Chrome-trace JSON export for Perfetto, plus
+  the process-unique per-request ``rid`` allocator (``next_rid``) every
+  request-scoped event carries as its arg.
+* :mod:`tpulab.obs.slowlog` — bounded worst-N per-request span
+  summaries (the daemon's ``slowlog`` request), rid-linked to the
+  tracer's event stream.
 
 Both are safe on the serving/training hot paths by construction (O(1),
 allocation-free, no device syncs); the ``obs_overhead`` bench holds the
@@ -21,12 +26,14 @@ from tpulab.obs.registry import (DEFAULT_BUCKETS, REGISTRY, Counter, Gauge,
                                  Histogram, Registry, counter, gauge,
                                  histogram, percentile_from_buckets,
                                  render_prometheus)
+from tpulab.obs.slowlog import SLOWLOG, SlowLog, configure_slowlog
 from tpulab.obs.tracer import (DEFAULT_CAPACITY, NULL, TRACER, Tracer,
-                               configure_tracer, event, span)
+                               configure_tracer, event, next_rid, span)
 
 __all__ = [
-    "DEFAULT_BUCKETS", "DEFAULT_CAPACITY", "REGISTRY", "Counter", "Gauge",
-    "Histogram", "NULL", "Registry", "TRACER", "Tracer", "configure_tracer",
-    "counter", "event", "gauge", "histogram", "percentile_from_buckets",
-    "render_prometheus", "span",
+    "DEFAULT_BUCKETS", "DEFAULT_CAPACITY", "REGISTRY", "SLOWLOG", "Counter",
+    "Gauge", "Histogram", "NULL", "Registry", "SlowLog", "TRACER", "Tracer",
+    "configure_slowlog", "configure_tracer", "counter", "event", "gauge",
+    "histogram", "next_rid", "percentile_from_buckets", "render_prometheus",
+    "span",
 ]

@@ -142,6 +142,30 @@ class Tracer:
             sp = self._spans[name]
         return sp
 
+    def begin(self, name: str, arg=None) -> None:
+        """Open a named span carrying ``arg`` on its B record (the
+        cached :meth:`span` handles are argless by design — they are
+        shared across requests).  Zero-allocation like ``event``; pair
+        with :meth:`end` in a try/finally.  Used for the per-request
+        span tree: a ``begin("engine.prefill_chunk", rid)`` links the
+        chunk's duration to the request's other rid-carrying events."""
+        if not self.enabled:
+            return
+        nid = self._names.get(name)
+        if nid is None:
+            nid = self._intern(name)
+        self._record(_BEGIN, nid, arg)
+
+    def end(self, name: str) -> None:
+        """Close the span :meth:`begin` opened (E records carry no
+        arg; Chrome-trace pairs B/E per thread by nesting order)."""
+        if not self.enabled:
+            return
+        nid = self._names.get(name)
+        if nid is None:
+            nid = self._intern(name)
+        self._record(_END, nid, None)
+
     def event(self, name: str, arg=None, **args) -> None:
         """Instant event.  ``arg`` carries one scalar at tuple-append
         cost; keyword ``args`` are allowed for RARE rich events (they
@@ -199,6 +223,22 @@ class Tracer:
 #: instead of per event
 TRACER = Tracer()
 NULL = Tracer(0)
+
+#: process-global request-id allocator: every request the daemon or an
+#: engine admits gets ONE ``rid``, unique across all engines in the
+#: process (engine-local ``req_id`` restarts at 0 per engine and per
+#: supervisor rebuild — it cannot key a process-wide trace).  The rid
+#: is the LINK between a request's tracer events (engine.submit /
+#: admit / first_token / token / retire / preempt, daemon.shed /
+#: daemon.replay — all carry it as their arg) and its slow-log span
+#: summary (tpulab.obs.slowlog).  ``next()`` on itertools.count is
+#: atomic under the GIL — no lock on the submit path.
+_RID = itertools.count(1)
+
+
+def next_rid() -> int:
+    """Allocate the next process-unique request id."""
+    return next(_RID)
 
 
 def configure_tracer(capacity: Optional[int]) -> Tracer:

@@ -1,0 +1,714 @@
+"""Replicated serving fleet: the round-13 router/migration suite.
+
+The daemon now serves each warm config from ``--replicas N``
+PagedEngine replicas behind a router (policy in ``tpulab/router.py``,
+mechanics in ``tpulab/daemon.py._FleetService``).  Headline
+properties certified here:
+
+  * placement is least-loaded + prefix-affinity over health-checked
+    replicas (HEALTHY -> SUSPECT on slow/stalled ticks -> QUARANTINED
+    on crash -> REBUILDING -> HEALTHY), policy unit-tested without an
+    engine;
+  * a replica failure MIGRATES its in-flight requests to a healthy
+    peer (``PagedEngine.resubmit(fresh_id=True)``) — greedy streams
+    BIT-IDENTICAL to a fault-free run, sampled streams resuming their
+    per-slot key chain, exact block accounting on both sides — while
+    the failed replica rebuilds in the background and rejoins;
+  * the replay budget (``TPULAB_DAEMON_REPLAY_BUDGET``) is charged
+    per migration: a request bounced around a failing fleet surfaces
+    its failure at the same budget, never loops;
+  * a rid cancelled during a migration window is dropped from the
+    replay set (the round-11 cancel-after-quarantine regression,
+    generalized to the fleet);
+  * hot drain: placement stops, the replica quiesces, rebuilds, and
+    returns on undrain — composing into a zero-shed rolling restart
+    under steady load;
+  * hedged retries: a straggler with no first token inside its hedge
+    budget is duplicated on a second replica, first token wins, the
+    loser is cancelled with its blocks released;
+  * fleet chaos schedules target individual replicas by scoped site
+    (``paged.tick@replica1``) deterministically;
+  * observability: ``engine_*_replica<i>`` per-replica gauge
+    breakdown next to the process-wide sums, the router counters
+    (``daemon_migrations`` / ``daemon_hedges`` / ``daemon_hedge_wins``
+    / ``daemon_drains``) registered + documented, and slow-log
+    entries carrying their replica hops / first-token replica /
+    migration count.
+"""
+
+import json
+import pathlib
+import re
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import tpulab.daemon as daemon_mod
+from tpulab import faults, obs, router
+from tpulab.models.generate import generate
+from tpulab.models.labformer import LabformerConfig
+from tpulab.models.paged import PagedEngine
+
+CFG = LabformerConfig(d_model=32, n_heads=4, n_layers=2, d_ff=64, max_seq=128)
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(scope="module")
+def trained(trained_small, trained_small_cfg):
+    assert CFG == trained_small_cfg  # shared-model drift fails loudly
+    return trained_small
+
+
+@pytest.fixture(autouse=True)
+def _injector_always_reset():
+    yield
+    faults.disable()
+
+
+def _cycle_prompt(p):
+    return (np.arange(p) % 7).astype(np.int32)
+
+
+def _mk_engine(params, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("n_blocks", 32)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("max_seq", 64)
+    return PagedEngine(params, CFG, **kw)
+
+
+def _mk_fleet(params, n, **eng_kw):
+    def builder():
+        return _mk_engine(params, **eng_kw), None
+
+    return daemon_mod._make_fleet(builder, n)
+
+
+def _no_leaks(eng):
+    cache_blocks = {b for blocks in eng.prefix_cache.values()
+                    for b in blocks}
+    assert len(eng.free) + len(cache_blocks) == eng.n_usable_blocks, (
+        len(eng.free), sorted(cache_blocks), eng.n_usable_blocks)
+    assert len(set(eng.free)) == len(eng.free), "double-freed block"
+    assert all(eng.block_refs[b] == 0 for b in eng.free)
+
+
+def _fleet_quiesce(fleet, timeout=60):
+    """Wait until every replica is idle, alive, and healthy-or-suspect
+    (background rebuilds finished) — keeps module-scoped params clean
+    between tests."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        busy = False
+        for r in fleet.replicas:
+            with r.cond:
+                eng = r.engine
+                if (r.dead or r.stepper_alive or eng.pending
+                        or eng.inflight_depth
+                        or any(a is not None for a in eng.active)):
+                    busy = True
+            with fleet.cv:
+                if r.health.state in (router.QUARANTINED,
+                                      router.REBUILDING):
+                    busy = True
+        if not busy:
+            return
+        time.sleep(0.02)
+    raise AssertionError("fleet never quiesced")
+
+
+# ------------------------------------------------------------ router units
+def test_health_state_machine_transitions():
+    h = router.ReplicaHealth(slow_tick_s=0.1, suspect_after=2,
+                             recover_after=3)
+    assert h.state == router.HEALTHY and h.placeable
+    h.note_tick(0.01)
+    h.note_tick(0.5)           # one slow tick: not yet suspect
+    assert h.state == router.HEALTHY
+    h.note_tick(0.5)           # second consecutive: SUSPECT
+    assert h.state == router.SUSPECT and h.placeable
+    assert h.suspects == 1
+    h.note_tick(0.01)
+    h.note_tick(0.01)
+    assert h.state == router.SUSPECT  # hysteresis: 2 of 3 fast ticks
+    h.note_tick(0.01)
+    assert h.state == router.HEALTHY
+    # stalled ticks count as slow evidence regardless of duration
+    h.note_tick(0.01, stalled=True)
+    h.note_tick(0.01, stalled=True)
+    assert h.state == router.SUSPECT
+    # crash wins from any state; only the rebuild lifecycle leaves it
+    h.note_crash()
+    assert h.state == router.QUARANTINED and not h.placeable
+    assert h.crashes == 1
+    h.note_tick(0.01)          # trailing ticks prove nothing
+    assert h.state == router.QUARANTINED
+    h.note_rebuild_start()
+    assert h.state == router.REBUILDING and not h.placeable
+    h.note_rebuild_failed()
+    assert h.state == router.QUARANTINED
+    h.note_rebuild_start()
+    h.note_rebuilt()
+    assert h.state == router.HEALTHY and h.placeable
+
+
+def test_choose_replica_scoring():
+    V = router.ReplicaView
+    # least-loaded wins among healthy equals
+    assert router.choose_replica(
+        [V(0, True, False, 3), V(1, True, False, 1)]) == 1
+    # prefix affinity outweighs load at the documented 2-blocks-per-
+    # request exchange rate
+    assert router.choose_replica(
+        [V(0, True, False, 2, affinity=2), V(1, True, False, 0)]) == 0
+    # SUSPECT is strictly deprioritized even when less loaded...
+    assert router.choose_replica(
+        [V(0, True, True, 0), V(1, True, False, 5)]) == 1
+    # ...but still serves when it is the only placeable replica
+    assert router.choose_replica(
+        [V(0, True, True, 0), V(1, False, False, 0)]) == 0
+    # unplaceable excluded entirely; empty -> None
+    assert router.choose_replica([V(0, False, False, 0)]) is None
+    assert router.choose_replica([]) is None
+    # deterministic tie-break: lowest index
+    assert router.choose_replica(
+        [V(1, True, False, 0), V(0, True, False, 0)]) == 0
+
+
+def test_scoped_fault_sites_are_per_replica_deterministic():
+    """A rule written ``site@scope`` counts hits on the scope's OWN
+    counter — replica interleaving cannot perturb it — while bare
+    rules keep the global count."""
+    with faults.active([{"site": "s@replica1", "kind": "raise", "at": 2},
+                        {"site": "s", "kind": "slow_ms", "at": 5,
+                         "arg": 0.0}]) as inj:
+        assert faults.fire("s", "replica0") is None
+        assert faults.fire("s", "replica1") is None   # replica1 hit 1
+        assert faults.fire("s", "replica0") is None
+        with pytest.raises(faults.InjectedFault):
+            faults.fire("s", "replica1")              # replica1 hit 2
+        # the bare rule fires on the GLOBAL 5th hit of the site
+        r = faults.fire("s", "replica0")
+        assert r is not None and r.kind == "slow_ms"
+        assert inj.hits("s") == 5
+        assert inj.hits("s@replica1") == 2
+        assert inj.fired() == {"s@replica1": 1, "s": 1}
+
+
+# ------------------------------------------------------------- placement
+def test_placement_least_loaded_and_prefix_affinity(trained):
+    svc = daemon_mod._FleetService()
+    fleet = _mk_fleet(trained, 2)
+    prompt = _cycle_prompt(20)
+    # warm the prefix on replica 0 (idle fleet ties break to index 0)
+    out = svc.generate(fleet, prompt, 4)
+    assert len(out) == 4
+    _fleet_quiesce(fleet)
+    # occupy replica 0 so pure least-loaded would pick replica 1...
+    hold = {}
+    t = threading.Thread(
+        target=lambda: hold.setdefault(
+            "out", svc.generate(fleet, _cycle_prompt(5), 40)))
+    t.start()
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        with fleet.replicas[0].cond:
+            eng = fleet.replicas[0].engine
+            if any(a is not None for a in eng.active):
+                break
+        time.sleep(0.01)
+    # ...a fresh unrelated prompt (no shared prefix anywhere) goes to
+    # the idle replica 1
+    other = (np.arange(30) % 5 + 1).astype(np.int32)
+    assert svc._place(fleet, other).index == 1
+    # but the CACHED-prefix prompt still routes to replica 0: two
+    # resident shared blocks outweigh one active request of load
+    assert svc._place(fleet, prompt).index == 0
+    t.join(timeout=60)
+    assert len(hold["out"]) == 40
+    _fleet_quiesce(fleet)
+
+
+# ------------------------------------------------------------- migration
+def test_migration_greedy_bit_identical_no_leaks(trained):
+    """The tentpole: replica0 crashes mid-wave; its request resumes on
+    replica1 with the greedy stream bit-identical to a fault-free run,
+    blocks balance on BOTH engines, and the crashed replica rebuilds
+    and rejoins."""
+    svc = daemon_mod._FleetService()
+    fleet = _mk_fleet(trained, 2)
+    m0 = daemon_mod._C_MIGRATIONS.value
+    with faults.active([{"site": "paged.tick@replica0", "kind": "raise",
+                         "at": 6}]):
+        out = svc.generate(fleet, _cycle_prompt(4), 16)
+        assert faults.INJECTOR.fired() == {"paged.tick@replica0": 1}
+    want = generate(trained, _cycle_prompt(4)[None, :], CFG, steps=16,
+                    temperature=0.0)[0]
+    assert np.array_equal(out, want)
+    assert daemon_mod._C_MIGRATIONS.value == m0 + 1
+    _fleet_quiesce(fleet)
+    st = svc.fleet_status(fleet)
+    assert st["replica"][0]["health"] == "healthy"
+    assert st["replica"][0]["generation"] == 1   # rebuilt and rejoined
+    assert st["replica"][0]["restarts"] == 1
+    for r in fleet.replicas:
+        with r.cond:
+            _no_leaks(r.engine)
+
+
+def test_migration_sampled_stream_resumes_key_chain(trained):
+    base = _mk_engine(trained)
+    rs = base.submit(_cycle_prompt(4), max_new=16, temperature=1.3, seed=7)
+    want = base.run()[rs]
+    svc = daemon_mod._FleetService()
+    fleet = _mk_fleet(trained, 2)
+    with faults.active([{"site": "paged.tick@replica0", "kind": "raise",
+                         "at": 6}]):
+        out = svc.generate(fleet, _cycle_prompt(4), 16, temperature=1.3,
+                           seed=7)
+    assert np.array_equal(out, want)
+    _fleet_quiesce(fleet)
+
+
+def test_replay_budget_charged_across_migrations(trained, monkeypatch):
+    """A request migrated twice then crashed again surfaces failure at
+    the SAME TPULAB_DAEMON_REPLAY_BUDGET — bounced around a failing
+    fleet, it never loops."""
+    monkeypatch.setattr(daemon_mod, "REPLAY_BUDGET", 2)
+    svc = daemon_mod._FleetService()
+    fleet = _mk_fleet(trained, 2)
+    t0 = time.monotonic()
+    with faults.active([{"site": "paged.tick", "kind": "raise",
+                         "at": 2, "count": 10 ** 6}]):
+        with pytest.raises(RuntimeError, match="engine step failed"):
+            svc.generate(fleet, _cycle_prompt(4), 8)
+    assert time.monotonic() - t0 < 120  # surfaced, not looping
+    _fleet_quiesce(fleet)
+
+
+def test_cancel_during_migration_not_replayed(trained):
+    """The round-11 cancel-after-quarantine regression, fleet form: a
+    ticket cancelled while its replica is being harvested must NOT be
+    resubmitted on the peer — and the live rider must migrate and
+    complete normally."""
+    svc = daemon_mod._FleetService()
+    fleet = _mk_fleet(trained, 2)
+    r0 = fleet.replicas[0]
+    with r0.cond:
+        eng = r0.engine
+        eng.submit(_cycle_prompt(4), max_new=8)
+        dead_tkt = daemon_mod._Ticket(eng.pending[-1], r0)
+        r0.tickets[dead_tkt.req.req_id] = dead_tkt
+        eng.submit(_cycle_prompt(5), max_new=6)
+        live_tkt = daemon_mod._Ticket(eng.pending[-1], r0)
+        r0.tickets[live_tkt.req.req_id] = live_tkt
+    with fleet.cv:
+        dead_tkt.cancelled = True   # waiter abandoned pre-harvest
+    svc._fail_replica(r0, eng, RuntimeError("boom"))
+    r1 = fleet.replicas[1]
+    with r1.cond:
+        replayed = [r.rid for r in r1.engine.pending] + [
+            r.rid for r in r1.engine.active if r is not None]
+        assert dead_tkt.req.rid not in replayed, (
+            "cancelled rid leaked into the migration set")
+        assert live_tkt.req.rid in replayed
+    deadline = time.monotonic() + 60
+    with fleet.cv:
+        while not live_tkt.done and time.monotonic() < deadline:
+            fleet.cv.wait(timeout=1)
+        assert live_tkt.done
+        out = live_tkt.result
+    want = generate(trained, _cycle_prompt(5)[None, :], CFG, steps=6,
+                    temperature=0.0)[0]
+    assert np.array_equal(out, want)
+    assert not dead_tkt.done
+    _fleet_quiesce(fleet)
+    with r1.cond:
+        _no_leaks(r1.engine)
+
+
+# ------------------------------------------------------------ drain / roll
+def test_drain_rebuilds_and_placement_avoids(trained):
+    svc = daemon_mod._FleetService()
+    fleet = _mk_fleet(trained, 2)
+    d0 = daemon_mod._C_DRAINS.value
+    out = svc.generate(fleet, _cycle_prompt(4), 4)
+    assert len(out) == 4
+    _fleet_quiesce(fleet)
+    row = svc.drain(fleet, 0)
+    assert row["draining"]
+    assert daemon_mod._C_DRAINS.value == d0 + 1
+    svc.drain(fleet, 0)  # idempotent: counted once per drain edge
+    assert daemon_mod._C_DRAINS.value == d0 + 1
+    deadline = time.monotonic() + 60
+    while time.monotonic() < deadline:
+        row = svc.replica_status(fleet.replicas[0])
+        if row["generation"] >= 1 and row["health"] == "healthy":
+            break
+        time.sleep(0.02)
+    assert row["generation"] == 1, row   # quiesced -> rebuilt
+    # placement excludes the drained replica even though it is healthy
+    for _ in range(3):
+        assert svc._place(fleet, _cycle_prompt(6)).index == 1
+    out = svc.generate(fleet, _cycle_prompt(6), 4)
+    assert len(out) == 4
+    svc.undrain(fleet, 0)
+    assert svc._place(fleet, _cycle_prompt(9)).index == 0  # least-loaded
+    _fleet_quiesce(fleet)
+
+
+def test_rolling_restart_under_load_zero_shed(trained):
+    """The acceptance scenario in-process: steady load across a
+    2-replica fleet while each replica in turn is drained, rebuilt,
+    and undrained — every request completes, none sheds or parks."""
+    svc = daemon_mod._FleetService()
+    fleet = _mk_fleet(trained, 2)
+    stop = threading.Event()
+    errors = []
+    done = [0]
+    lock = threading.Lock()
+
+    def loader():
+        while not stop.is_set():
+            try:
+                out = svc.generate(fleet, _cycle_prompt(4), 4)
+                assert len(out) == 4
+                with lock:
+                    done[0] += 1
+            except Exception as e:  # noqa: BLE001 — the assertion target
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=loader) for _ in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        for i in range(2):
+            base = svc.replica_status(fleet.replicas[i])["generation"]
+            svc.drain(fleet, i)
+            deadline = time.monotonic() + 120
+            while time.monotonic() < deadline:
+                row = svc.replica_status(fleet.replicas[i])
+                if row["generation"] > base and row["health"] == "healthy":
+                    break
+                time.sleep(0.05)
+            else:
+                raise AssertionError(f"replica{i} never rebuilt")
+            svc.undrain(fleet, i)
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=60)
+    assert not errors, errors
+    assert done[0] > 0
+    for i in range(2):
+        assert svc.replica_status(fleet.replicas[i])["generation"] >= 1
+    _fleet_quiesce(fleet)
+    for r in fleet.replicas:
+        with r.cond:
+            _no_leaks(r.engine)
+
+
+# --------------------------------------------------------------- hedging
+def test_hedge_first_token_wins_loser_cancelled(trained):
+    """Replica0's drains are wedged; the hedge fires onto replica1,
+    wins the first-token race (greedy stream identical), the loser is
+    cancelled, and block accounting balances on both replicas."""
+    svc = daemon_mod._FleetService()
+    fleet = _mk_fleet(trained, 2)
+    h0 = daemon_mod._C_HEDGES.value
+    w0 = daemon_mod._C_HEDGE_WINS.value
+    want = generate(trained, _cycle_prompt(4)[None, :], CFG, steps=8,
+                    temperature=0.0)[0]
+    with faults.active([{"site": "paged.drain@replica0",
+                         "kind": "slow_ms", "at": 1, "count": 80,
+                         "arg": 200.0}]):
+        out = svc.generate(fleet, _cycle_prompt(4), 8, hedge_ms=100.0)
+    assert np.array_equal(out, want)
+    assert daemon_mod._C_HEDGES.value == h0 + 1
+    assert daemon_mod._C_HEDGE_WINS.value == w0 + 1
+    _fleet_quiesce(fleet)
+    for r in fleet.replicas:
+        with r.cond:
+            _no_leaks(r.engine)
+
+
+def test_hedge_not_fired_when_primary_is_prompt(trained):
+    """A healthy primary that answers inside the budget never hedges
+    (the duplicate would only waste a slot)."""
+    svc = daemon_mod._FleetService()
+    fleet = _mk_fleet(trained, 2)
+    h0 = daemon_mod._C_HEDGES.value
+    out = svc.generate(fleet, _cycle_prompt(4), 8, hedge_ms=5000.0)
+    assert len(out) == 8
+    assert daemon_mod._C_HEDGES.value == h0
+    _fleet_quiesce(fleet)
+
+
+# ----------------------------------------------------------- park / retry
+def test_whole_fleet_drained_parks_then_rebuilding_frame(trained,
+                                                         monkeypatch):
+    """Every replica draining: submits park briefly, then surface the
+    parseable ``rebuilding retry_after_ms=N`` frame (NOT a shed — and
+    not counted as one)."""
+    monkeypatch.setattr(daemon_mod, "REBUILD_PARK_S", 0.4)
+    svc = daemon_mod._FleetService()
+    fleet = _mk_fleet(trained, 1)
+    svc.generate(fleet, _cycle_prompt(4), 2)
+    _fleet_quiesce(fleet)
+    svc.drain(fleet, 0)
+    shed0 = obs.REGISTRY.get("daemon_shed_requests").value
+    with pytest.raises(daemon_mod.RebuildingError,
+                       match=r"rebuilding retry_after_ms=\d+"):
+        svc.generate(fleet, _cycle_prompt(4), 2)
+    assert obs.REGISTRY.get("daemon_shed_requests").value == shed0
+    svc.undrain(fleet, 0)
+    out = svc.generate(fleet, _cycle_prompt(4), 2)  # serves again
+    assert len(out) == 2
+    _fleet_quiesce(fleet)
+
+
+def test_client_retry_honors_rebuilding_park(tmp_path):
+    """The obs_report satellite, protocol-only: a ``rebuilding
+    retry_after_ms=N`` error frame is retried with the same backoff
+    contract as shed — the capture survives a rolling restart."""
+    import importlib.util
+    import socket
+    import struct
+
+    spec = importlib.util.spec_from_file_location(
+        "obs_report", ROOT / "tools" / "obs_report.py")
+    rep = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(rep)
+    path = str(tmp_path / "park.sock")
+    state = {"n": 0}
+
+    def server():
+        srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        srv.bind(path)
+        srv.listen(4)
+        while state["n"] < 2:
+            conn, _ = srv.accept()
+            state["n"] += 1
+            hlen = struct.unpack("<I", conn.recv(4))[0]
+            conn.recv(hlen)
+            plen = struct.unpack("<Q", conn.recv(8))[0]
+            if plen:
+                conn.recv(plen)
+            if state["n"] == 1:
+                body = b"rebuilding retry_after_ms=20 (rolling restart)"
+                conn.sendall(struct.pack("<BQ", 1, len(body)) + body)
+            else:
+                conn.sendall(struct.pack("<BQ", 0, 4) + b"done")
+            conn.close()
+        srv.close()
+
+    t = threading.Thread(target=server, daemon=True)
+    t.start()
+    out = rep.request_with_retry(path, "metrics", deadline_s=30.0)
+    assert out == b"done"
+    assert state["n"] == 2  # parked once, then served
+
+
+# --------------------------------------------------------- observability
+def test_metrics_per_replica_breakdown(trained):
+    """The scrape carries engine_*_replica<i> gauges NEXT TO the
+    process-wide sums — one sick replica stays visible — and zeroes
+    them once the fleet is gone."""
+    svc = daemon_mod._FleetService()
+    fleet = _mk_fleet(trained, 2)
+    svc.generate(fleet, _cycle_prompt(4), 3)
+    _fleet_quiesce(fleet)
+    # route one request to each replica so both gauges are non-trivial
+    hold = {}
+    t = threading.Thread(target=lambda: hold.setdefault(
+        "out", svc.generate(fleet, _cycle_prompt(5), 30)))
+    t.start()
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        with fleet.replicas[0].cond:
+            if any(a is not None
+                   for a in fleet.replicas[0].engine.active):
+                break
+        time.sleep(0.01)
+    svc.generate(fleet, _cycle_prompt(9), 3)
+    t.join(timeout=60)
+    _fleet_quiesce(fleet)
+    key = (None, "gather", "native", 1, -13)
+    daemon_mod._FLEETS[key] = (None, fleet)
+    try:
+        text = daemon_mod.handle_request(
+            {"lab": "metrics"}, b"").decode("utf-8")
+    finally:
+        daemon_mod._FLEETS.pop(key, None)
+    m_sum = re.search(r"^engine_tokens_out (\d+)$", text, re.M)
+    m_r0 = re.search(r"^engine_tokens_out_replica0 (\d+)$", text, re.M)
+    m_r1 = re.search(r"^engine_tokens_out_replica1 (\d+)$", text, re.M)
+    assert m_sum and m_r0 and m_r1
+    assert int(m_r0.group(1)) > 0 and int(m_r1.group(1)) > 0
+    assert int(m_sum.group(1)) == int(m_r0.group(1)) + int(m_r1.group(1))
+    # fleet gone -> the replica breakdown zeroes like the sums do
+    text = daemon_mod.handle_request(
+        {"lab": "metrics"}, b"").decode("utf-8")
+    m_r0 = re.search(r"^engine_tokens_out_replica0 (\d+)$", text, re.M)
+    assert m_r0 and int(m_r0.group(1)) == 0
+
+
+def test_slowlog_carries_replica_hops_and_migrations(trained):
+    """A migrated request's slow-log entry names its hop chain, the
+    replica that served its first token, and its migration count — a
+    slow request blames a replica, not the fleet."""
+    svc = daemon_mod._FleetService()
+    fleet = _mk_fleet(trained, 2)
+    tag = "fleet-slowlog-test"
+    with faults.active([{"site": "paged.tick@replica0", "kind": "raise",
+                         "at": 6}]):
+        out = svc.generate(fleet, _cycle_prompt(4), 16, tag=tag)
+    assert len(out) == 16
+    _fleet_quiesce(fleet)
+    entries = [e for e in obs.SLOWLOG.worst()
+               if e.get("tag") == tag and e.get("migrations")]
+    assert entries, "migrated request missing from the slow log"
+    e = entries[0]
+    assert e["replica_hops"] == [0, 1]
+    assert e["migrations"] == 1
+    assert e["replica_first_token"] in (0, 1)
+
+
+def test_fleet_status_and_generate_stats_shape(trained):
+    svc = daemon_mod._FleetService()
+    fleet = _mk_fleet(trained, 2)
+    svc.generate(fleet, _cycle_prompt(4), 4)
+    _fleet_quiesce(fleet)
+    st = svc.fleet_status(fleet)
+    assert st["replicas"] == 2
+    assert [r["replica"] for r in st["replica"]] == [0, 1]
+    for row in st["replica"]:
+        assert row["health"] == "healthy"
+        assert not row["draining"] and not row["dead"]
+    # generate_stats over a warm FLEET key: replica-summed stats + count
+    key = (None, "gather", "native", 1, -17)
+    daemon_mod._FLEETS[key] = (None, fleet)
+    try:
+        got = json.loads(daemon_mod.handle_request(
+            {"lab": "generate_stats",
+             "config": {"prefill_chunk": -17}}, b""))
+    finally:
+        daemon_mod._FLEETS.pop(key, None)
+    assert got["replicas"] == 2
+    assert got["requests_done"] >= 1 and got["tokens_out"] >= 4
+
+
+def test_fleet_counters_registered_and_documented():
+    """The round-13 lint (tests/test_obs.py pattern): every router
+    counter is a registered metric AND has a docs entry."""
+    docs = (ROOT / "docs" / "ARCHITECTURE.md").read_text()
+    for name in ("daemon_migrations", "daemon_hedges",
+                 "daemon_hedge_wins", "daemon_drains"):
+        assert obs.REGISTRY.get(name) is not None, name
+        assert name in docs, f"{name} missing from docs/ARCHITECTURE.md"
+    # the chaos surfaces are documented too
+    for needle in ("engine_tokens_out_replica", "rebuilding "
+                   "retry_after_ms", "paged.tick@replica"):
+        assert needle in docs, needle
+
+
+def test_loadgen_separates_rebuilding_park_from_shed():
+    """RebuildingError's client half: a rolling restart's drain park
+    must not masquerade as load shedding in goodput accounting — both
+    arms count against attainment (the request was not served), but
+    they are tallied separately."""
+    from tpulab import loadgen
+
+    m = loadgen.SHED_RE.search("rebuilding retry_after_ms=120 (x)")
+    assert m and m.group(1) == "rebuilding" and m.group(2) == "120"
+    trace = loadgen.build_trace(loadgen.built_in_spec("chaos"))
+    cls = trace.classes[0]["name"]
+    rows = []
+    for i, kind in enumerate(("ok", "shed", "rebuilding")):
+        r = {"i": i, "cls": cls, "tag": f"t{i}",
+             "ok": kind == "ok", "shed": kind == "shed",
+             "rebuilding": kind == "rebuilding", "cancelled": False,
+             "error": None, "retry_after_ms": None,
+             "ttft_ms": 1.0 if kind == "ok" else None,
+             "e2e_ms": 2.0 if kind == "ok" else None,
+             "itl_max_ms": 0.5, "n_chunks": 1, "bytes_out": 4,
+             "sha": None, "stream_ok": None}
+        rows.append(r)
+    got = loadgen.summarize(rows, trace, wall_s=1.0)["overall"]
+    assert got["shed"] == 1 and got["rebuilding"] == 1
+    assert got["completed"] == 1 and got["errors"] == 0
+    assert got["attainment"] == round(1 / 3, 4)  # both arms count
+
+
+def test_handle_generate_validates_hedge_ms():
+    with pytest.raises(ValueError, match="hedge_ms must be >= 0"):
+        daemon_mod._handle_generate(
+            {"config": {"hedge_ms": -3}}, b"hi")
+
+
+# ----------------------------------------------------------- live daemon
+def test_live_daemon_fleet_drain_undrain_cycle(tmp_path):
+    """Acceptance over the real wire: a --replicas 2 daemon serves,
+    reports its fleet table, rolls one replica (drain -> generation
+    advance -> undrain) while a request lands on the other replica,
+    and exposes the per-replica gauge breakdown in its scrape."""
+    import importlib.util
+    import os
+    import signal as _signal
+    import subprocess
+    import sys
+
+    spec = importlib.util.spec_from_file_location(
+        "obs_report", ROOT / "tools" / "obs_report.py")
+    rep = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(rep)
+    sock = str(tmp_path / "fleet.sock")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tpulab.daemon", "--socket", sock,
+         "--replicas", "2"],
+        env=dict(os.environ),
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    try:
+        for _ in range(600):
+            if pathlib.Path(sock).exists():
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError("daemon socket never appeared")
+        out = rep.request_with_retry(sock, "generate", {"steps": 5},
+                                     b"fleet live", deadline_s=300.0)
+        assert len(out) == 5
+        st = json.loads(rep.request(sock, "fleet"))
+        assert st["replicas"] == 2
+        assert all(r["health"] == "healthy" for r in st["replica"])
+        row = json.loads(rep.request(sock, "drain", {"replica": 0}))
+        assert row["draining"]
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            st = json.loads(rep.request(sock, "fleet"))
+            if (st["replica"][0]["generation"] >= 1
+                    and st["replica"][0]["health"] == "healthy"):
+                break
+            time.sleep(0.1)
+        else:
+            raise AssertionError("drained replica never rebuilt")
+        # traffic during the drain is served by replica 1
+        out = rep.request_with_retry(sock, "generate", {"steps": 4},
+                                     b"drained window", deadline_s=300.0)
+        assert len(out) == 4
+        st = json.loads(rep.request(sock, "fleet"))
+        assert st["replica"][1]["requests_done"] >= 1
+        json.loads(rep.request(sock, "undrain", {"replica": 0}))
+        text = rep.request(sock, "metrics").decode("utf-8")
+        assert re.search(r"^engine_tokens_out_replica1 [1-9]", text, re.M)
+        assert re.search(r"^daemon_drains [1-9]", text, re.M)
+    finally:
+        proc.send_signal(_signal.SIGTERM)
+        try:
+            proc.wait(timeout=60)
+        except subprocess.TimeoutExpired:
+            proc.kill()

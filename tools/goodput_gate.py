@@ -35,6 +35,21 @@ Usage (host-only fast tier, as tools/onchip_queue_r12.sh runs it):
 or against an already-running daemon: ``--socket /tmp/tpulab.sock``
 (never spawn a daemon you don't own on a chip — the running one holds
 the claim).
+
+Chaos scenario (round 13, the fleet certification
+tools/onchip_queue_r13.sh runs):
+
+    python tools/goodput_gate.py --spawn-daemon --spec chaos \
+        --replicas 3 --chaos --rolling-restart \
+        --out results/goodput_chaos_r13.json --check-baselines
+
+replays the trace twice — fault-free for reference outputs, then with
+``CHAOS_SCHEDULE`` armed (replica1 crashes mid-trace, replica2 wedges)
+— and gates: every non-cancelled request completes, streamed chunks
+reassemble exactly, surviving outputs are BIT-IDENTICAL to the
+reference (migration loses/duplicates zero tokens), and a full
+rolling restart under steady load serves with zero shed requests.
+The ``goodput_chaos_*`` rows ride the same baselines ratchet.
 """
 
 from __future__ import annotations
@@ -67,9 +82,30 @@ def _load_obs_report():
 
 
 #: counters whose before/after delta the report carries (the PR-6
-#: fault-tolerance counters plus the engine preemption mirror)
+#: fault-tolerance counters, the engine preemption mirror, and the
+#: round-13 fleet-router counters)
 _COUNTERS = ("daemon_shed_requests", "daemon_replays",
-             "daemon_engine_restarts", "engine_preemptions")
+             "daemon_engine_restarts", "engine_preemptions",
+             "daemon_migrations", "daemon_hedges", "daemon_hedge_wins",
+             "daemon_drains")
+
+#: the chaos fault schedule (--chaos, replayed via TPULAB_FAULTS in
+#: the spawned daemon's environment): CRASH replica1 mid-trace (its
+#: in-flight requests must migrate to healthy peers and complete
+#: bit-identically) and WEDGE replica2 (long slow_ms drains — the
+#: health checker marks it SUSPECT and placement routes around it).
+#: Sites are replica-scoped (tpulab/faults.py round 13), so the
+#: schedule is deterministic per replica regardless of how the
+#: steppers interleave.
+CHAOS_SCHEDULE = [
+    {"site": "paged.tick@replica1", "kind": "raise", "at": 40},
+    # 300ms stretched ticks: above the router's slow-tick threshold
+    # (tpulab/router.py DEFAULT_SLOW_TICK_S = 0.25), so the wedge
+    # actually drives HEALTHY -> SUSPECT and placement routes around
+    # the wedged replica
+    {"site": "paged.drain@replica2", "kind": "slow_ms", "at": 30,
+     "count": 60, "arg": 300.0},
+]
 
 #: histograms percentile-diffed over the replay window
 _HISTOGRAMS = ("ttft_seconds", "itl_seconds", "e2e_seconds",
@@ -128,19 +164,26 @@ def counter_deltas(before: dict, after: dict) -> dict:
     return out
 
 
-def _spawn_daemon(sock: str, slowlog: int, trace_buffer: int):
+def _spawn_daemon(sock: str, slowlog: int, trace_buffer: int,
+                  replicas: int = 1, extra_env: dict | None = None):
     """Host-only convenience: spawn a private daemon for the replay and
     SIGTERM it afterwards.  CPU-tier only — an on-chip daemon holds the
-    relay claim and must be driven, not owned, by this gate."""
+    relay claim and must be driven, not owned, by this gate.
+    ``replicas`` sizes the serving fleet; ``extra_env`` injects e.g.
+    the TPULAB_FAULTS chaos schedule."""
     # a stale socket file from a killed earlier run would satisfy the
     # readiness poll before the child ever binds (skipping its crash
     # detection); the daemon unlinks on bind, so pre-clear it here too
     if os.path.exists(sock):
         os.unlink(sock)
+    env = dict(os.environ)
+    if extra_env:
+        env.update(extra_env)
     proc = subprocess.Popen(
         [sys.executable, "-m", "tpulab.daemon", "--socket", sock,
-         "--slowlog", str(slowlog), "--trace-buffer", str(trace_buffer)],
-        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+         "--slowlog", str(slowlog), "--trace-buffer", str(trace_buffer),
+         "--replicas", str(replicas)],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
     deadline = time.monotonic() + 120
     while time.monotonic() < deadline:
         if proc.poll() is not None:
@@ -152,6 +195,138 @@ def _spawn_daemon(sock: str, slowlog: int, trace_buffer: int):
         time.sleep(0.1)
     proc.send_signal(signal.SIGTERM)
     raise RuntimeError("spawned daemon socket never appeared")
+
+
+def rolling_restart(rep, sock: str, n_replicas: int, log) -> dict:
+    """Zero-shed rolling restart under steady load: background client
+    threads keep firing small generates (RAW requests — a shed or park
+    would surface as an error here, which is exactly what the gate
+    must count) while each replica in turn is drained, rebuilt
+    (generation advance observed via the ``fleet`` request), and
+    undrained.  Returns the outcome tally; the caller gates on
+    shed == rebuilding == errors == 0."""
+    import threading
+
+    stop = threading.Event()
+    tally = {"ok": 0, "shed": 0, "rebuilding": 0, "errors": 0}
+    lock = threading.Lock()
+
+    def loader(i: int):
+        j = 0
+        while not stop.is_set():
+            try:
+                rep.request(sock, "generate",
+                            {"steps": 4, "tag": f"roll:{i}"},
+                            f"rolling restart load {i} {j}".encode())
+                with lock:
+                    tally["ok"] += 1
+            except (RuntimeError, OSError, ConnectionError) as e:
+                msg = str(e)
+                with lock:
+                    if "shed retry_after_ms" in msg:
+                        tally["shed"] += 1
+                    elif "rebuilding retry_after_ms" in msg:
+                        tally["rebuilding"] += 1
+                    else:
+                        tally["errors"] += 1
+            j += 1
+
+    threads = [threading.Thread(target=loader, args=(i,), daemon=True)
+               for i in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        for i in range(n_replicas):
+            base = json.loads(rep.request(sock, "fleet"))
+            base_gen = base["replica"][i]["generation"]
+            rep.request(sock, "drain", {"replica": i})
+            log(f"[goodput_gate] rolling restart: drained replica{i}")
+            deadline = time.monotonic() + 180
+            while time.monotonic() < deadline:
+                st = json.loads(rep.request(sock, "fleet"))["replica"][i]
+                if (st["generation"] > base_gen
+                        and st["health"] == "healthy"):
+                    break
+                time.sleep(0.2)
+            else:
+                raise RuntimeError(
+                    f"replica{i} never rebuilt during rolling restart")
+            rep.request(sock, "undrain", {"replica": i})
+            log(f"[goodput_gate] rolling restart: replica{i} rebuilt "
+                f"(generation {st['generation']}) and undrained")
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+    return tally
+
+
+def compare_streams(ref_results: list, chaos_results: list):
+    """Greedy bit-equality across the fault-free and chaos replays:
+    for every trace row that COMPLETED in both runs (scripted cancels
+    excluded — a hang-up races completion, so a row can legitimately
+    complete in one run and cancel in the other), the output shas must
+    match — migration/hedging must not lose, duplicate, or alter one
+    token."""
+    compared = 0
+    mismatches = []
+    for a, b in zip(ref_results, chaos_results):
+        if (a["ok"] and b["ok"]
+                and not a["cancelled"] and not b["cancelled"]):
+            compared += 1
+            if a["sha"] != b["sha"]:
+                mismatches.append(
+                    {"i": a["i"], "tag": b["tag"],
+                     "ref_sha": a["sha"], "chaos_sha": b["sha"]})
+    return compared, mismatches
+
+
+def run_replay(args, rep, trace, *, extra_env=None, rolling=False,
+               label=""):
+    """One full replay window against a (possibly spawned) daemon:
+    warmup outside the window, before/after scrapes, trace replay,
+    slowlog + fleet captures, optional rolling-restart phase.  Returns
+    every capture the report needs."""
+    daemon_proc = None
+    if args.spawn_daemon:
+        daemon_proc = _spawn_daemon(
+            args.socket, max(args.slowlog, 16), 1 << 16,
+            replicas=args.replicas, extra_env=extra_env)
+    log = lambda m: print(m, file=sys.stderr, flush=True)  # noqa: E731
+    try:
+        # warmup OUTSIDE the measured window: the first request pays
+        # engine build + XLA compile; a goodput number that charges
+        # cold start to the first trace row measures the wrong thing
+        for i in range(args.warmup):
+            rep.request_with_retry(args.socket, "generate", {"steps": 4},
+                                   b"goodput gate warmup",
+                                   deadline_s=300.0)
+        before = rep.parse_prometheus(
+            rep.request(args.socket, "metrics").decode("utf-8"))
+        results, wall_s = loadgen.replay(
+            trace, args.socket, time_scale=args.time_scale,
+            timeout_s=args.timeout_s,
+            log=lambda m: log(f"{label}{m}"))
+        after = rep.parse_prometheus(
+            rep.request(args.socket, "metrics").decode("utf-8"))
+        slow = json.loads(rep.request(args.socket, "slowlog",
+                                      {"n": args.slowlog}))
+        try:
+            fleet = json.loads(rep.request(args.socket, "fleet"))
+        except Exception:
+            fleet = None
+        roll = None
+        if rolling:
+            roll = rolling_restart(rep, args.socket, args.replicas, log)
+    finally:
+        if daemon_proc is not None:
+            daemon_proc.send_signal(signal.SIGTERM)
+            try:
+                daemon_proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                daemon_proc.kill()
+    return {"results": results, "wall_s": wall_s, "before": before,
+            "after": after, "slow": slow, "fleet": fleet, "roll": roll}
 
 
 def main(argv=None) -> int:
@@ -183,6 +358,24 @@ def main(argv=None) -> int:
                     help="generate requests sent before the measured "
                          "window (engine build + XLA compile must not "
                          "count against the first trace row's TTFT)")
+    ap.add_argument("--replicas", type=int, default=1, metavar="N",
+                    help="fleet size for the spawned daemon "
+                         "(--spawn-daemon); the chaos scenario needs "
+                         ">= 3 (replica1 crashes, replica2 wedges)")
+    ap.add_argument("--chaos", action="store_true",
+                    help="chaos-certify the fleet: replay the trace "
+                         "FAULT-FREE first (reference outputs), then "
+                         "again with CHAOS_SCHEDULE armed (crash one "
+                         "replica mid-trace, wedge another) and gate: "
+                         "every non-cancelled request completes, "
+                         "streamed chunks reassemble exactly, and "
+                         "completed outputs are bit-identical to the "
+                         "reference (zero lost/duplicated tokens)")
+    ap.add_argument("--rolling-restart", action="store_true",
+                    help="after the replay, roll every replica "
+                         "(drain -> rebuild -> undrain) under steady "
+                         "background load and gate on ZERO shed/"
+                         "parked/errored requests")
     ap.add_argument("--slowlog", type=int, default=8, metavar="N",
                     help="worst-N slow-log entries to embed in the report")
     ap.add_argument("--out", default=None, metavar="FILE",
@@ -215,34 +408,32 @@ def main(argv=None) -> int:
         trace.save(args.write_trace)
     name = trace.spec.get("name", "trace")
 
-    daemon_proc = None
-    if args.spawn_daemon:
-        daemon_proc = _spawn_daemon(args.socket, max(args.slowlog, 16),
-                                    1 << 16)
-    try:
-        # warmup OUTSIDE the measured window: the first request pays
-        # engine build + XLA compile; a goodput number that charges
-        # cold start to the first trace row measures the wrong thing
-        for i in range(args.warmup):
-            rep.request_with_retry(args.socket, "generate", {"steps": 4},
-                                   b"goodput gate warmup", deadline_s=300.0)
-        before = rep.parse_prometheus(
-            rep.request(args.socket, "metrics").decode("utf-8"))
-        results, wall_s = loadgen.replay(
-            trace, args.socket, time_scale=args.time_scale,
-            timeout_s=args.timeout_s,
-            log=lambda m: print(m, file=sys.stderr, flush=True))
-        after = rep.parse_prometheus(
-            rep.request(args.socket, "metrics").decode("utf-8"))
-        slow = json.loads(rep.request(args.socket, "slowlog",
-                                      {"n": args.slowlog}))
-    finally:
-        if daemon_proc is not None:
-            daemon_proc.send_signal(signal.SIGTERM)
-            try:
-                daemon_proc.wait(timeout=30)
-            except subprocess.TimeoutExpired:
-                daemon_proc.kill()
+    if args.replicas < 1:
+        ap.error("--replicas must be >= 1")
+    chaos = None
+    if args.chaos:
+        if not args.spawn_daemon:
+            ap.error("--chaos needs --spawn-daemon (the reference and "
+                     "chaos replays each own a private daemon)")
+        if args.replicas < 3:
+            ap.error("--chaos targets replica1 (crash) and replica2 "
+                     "(wedge): use --replicas >= 3")
+        # fault-free REFERENCE replay first: its per-request output
+        # shas are what the chaos run's surviving streams must equal
+        ref = run_replay(args, rep, trace, label="[ref] ")
+        fault_env = {"TPULAB_FAULTS": json.dumps(CHAOS_SCHEDULE)}
+        run = run_replay(args, rep, trace, extra_env=fault_env,
+                         rolling=args.rolling_restart, label="[chaos] ")
+        compared, mismatches = compare_streams(ref["results"],
+                                               run["results"])
+        chaos = {"schedule": CHAOS_SCHEDULE, "compared": compared,
+                 "mismatches": mismatches,
+                 "reference_wall_s": round(ref["wall_s"], 3)}
+    else:
+        run = run_replay(args, rep, trace,
+                         rolling=args.rolling_restart)
+    results, wall_s = run["results"], run["wall_s"]
+    before, after, slow = run["before"], run["after"], run["slow"]
 
     goodput = loadgen.summarize(results, trace, wall_s)
     report = {
@@ -250,12 +441,18 @@ def main(argv=None) -> int:
                   "n_requests": len(trace.requests),
                   "arrival": trace.spec.get("arrival"),
                   "source": args.trace or f"spec:{args.spec}"},
+        "replicas": args.replicas,
         "goodput": goodput,
         "server_window": window_percentiles(before, after),
         "counters": counter_deltas(before, after),
         "slowlog": slow.get("worst", []),
+        "fleet": run["fleet"],
         "results": results,
     }
+    if chaos is not None:
+        report["chaos"] = chaos
+    if run["roll"] is not None:
+        report["rolling_restart"] = run["roll"]
     if args.out:
         out = pathlib.Path(args.out)
         out.parent.mkdir(parents=True, exist_ok=True)
@@ -269,6 +466,7 @@ def main(argv=None) -> int:
          "value": overall["goodput_tokens_per_s"], "unit": "tokens/s",
          "vs_baseline": None, "attainment": overall["attainment"],
          "completed": overall["completed"], "shed": overall["shed"],
+         "rebuilding": overall["rebuilding"],
          "cancelled": overall["cancelled"], "errors": overall["errors"],
          "wall_s": overall["wall_s"]},
         {"metric": f"goodput_{name}_slo_attainment",
@@ -285,6 +483,52 @@ def main(argv=None) -> int:
         print(f"[goodput_gate] FAIL: {overall['errors']} hard error(s), "
               f"e.g. {bad}", file=sys.stderr, flush=True)
         rc = 1
+    if chaos is not None:
+        # chaos acceptance: the fault schedule actually fired, every
+        # non-cancelled request completed, streams reassembled exactly,
+        # and surviving outputs are bit-identical to the reference
+        counters = report["counters"]
+        if counters.get("daemon_engine_restarts", 0) < 1:
+            print("[goodput_gate] FAIL: chaos schedule never crashed a "
+                  "replica (daemon_engine_restarts delta 0) — the run "
+                  "proved nothing", file=sys.stderr, flush=True)
+            rc = 1
+        incomplete = [r for r in results
+                      if not r["cancelled"] and not r["ok"]][:3]
+        if incomplete:
+            print(f"[goodput_gate] FAIL: non-cancelled request(s) did "
+                  f"not complete under chaos, e.g. {incomplete}",
+                  file=sys.stderr, flush=True)
+            rc = 1
+        torn = [r for r in results
+                if r["ok"] and r.get("stream_ok") is False][:3]
+        if torn:
+            print(f"[goodput_gate] FAIL: streamed chunks do not "
+                  f"reassemble to the terminal output (lost/duplicated "
+                  f"tokens), e.g. {torn}", file=sys.stderr, flush=True)
+            rc = 1
+        if chaos["mismatches"]:
+            print(f"[goodput_gate] FAIL: {len(chaos['mismatches'])} "
+                  f"stream(s) diverged from the fault-free reference, "
+                  f"e.g. {chaos['mismatches'][:3]}",
+                  file=sys.stderr, flush=True)
+            rc = 1
+        print(f"[goodput_gate] chaos: {chaos['compared']} streams "
+              f"bit-compared vs reference, "
+              f"{counters.get('daemon_engine_restarts', 0)} restart(s), "
+              f"{counters.get('daemon_migrations', 0)} migration(s)",
+              file=sys.stderr, flush=True)
+    if run["roll"] is not None:
+        roll = run["roll"]
+        bad_roll = roll["shed"] + roll["rebuilding"] + roll["errors"]
+        if bad_roll or not roll["ok"]:
+            print(f"[goodput_gate] FAIL: rolling restart was not "
+                  f"zero-shed: {roll}", file=sys.stderr, flush=True)
+            rc = 1
+        else:
+            print(f"[goodput_gate] rolling restart: {roll['ok']} "
+                  f"request(s) served, zero shed", file=sys.stderr,
+                  flush=True)
     att = overall["attainment"]
     if att is not None and att < args.min_attainment:
         print(f"[goodput_gate] FAIL: attainment {att} < floor "

@@ -51,7 +51,11 @@ from tpulab.obs.registry import percentile_from_buckets  # noqa: E402
 #: _SHED_RE (tpulab.loadgen.SHED_RE — the ONE copy of the client-side
 #: shed contract): an error frame whose body matches is BACKPRESSURE,
 #: not a failure — honor the retry-after and try again inside the
-#: caller's deadline
+#: caller's deadline.  The pattern covers BOTH daemon park flavors:
+#: ``shed retry_after_ms=N`` (deadline/queue shedding) and
+#: ``rebuilding retry_after_ms=N`` (the fleet's whole-fleet drain/
+#: rebuild park — e.g. mid rolling-restart), so a capture or drive
+#: riding :func:`request_with_retry` survives a rolling restart
 
 #: histograms the summary table reports, in display order
 _LATENCY_METRICS = ("ttft_seconds", "itl_seconds", "e2e_seconds",
@@ -114,8 +118,10 @@ def request_with_retry(sock_path: str, lab: str, config: dict | None = None,
                        base_backoff_s: float = 0.05,
                        rng: "random.Random | None" = None) -> bytes:
     """:func:`request` with client-side resilience: connect/send
-    failures retry on exponential backoff with full jitter, and a shed
-    response (``shed retry_after_ms=N``) honors the daemon's
+    failures retry on exponential backoff with full jitter, and a
+    shed/rebuilding park response (``shed retry_after_ms=N`` /
+    ``rebuilding retry_after_ms=N`` — the latter is the fleet's
+    drain-park during a rolling restart) honors the daemon's
     retry-after hint — all bounded by an absolute ``deadline_s``.  The
     last error is re-raised once the deadline is spent, so a genuinely
     dead daemon still fails loudly instead of looping forever."""
@@ -134,14 +140,16 @@ def request_with_retry(sock_path: str, lab: str, config: dict | None = None,
                 raise  # a real daemon-side error: retrying cannot help
             attempt += 1
             if shed is not None:
-                wait = int(shed.group(1)) / 1e3
+                # either arm (shed / rebuilding park): group 2 is the
+                # daemon's retry-after hint in milliseconds
+                wait = int(shed.group(2)) / 1e3
             else:
                 # exponential backoff, full jitter: concurrent clients
                 # must not re-dogpile a recovering daemon in lockstep
                 wait = rng.uniform(0, base_backoff_s * (2 ** min(attempt, 6)))
             if time.monotonic() + wait - t0 > deadline_s:
                 if shed is not None:
-                    raise ShedResponse(int(shed.group(1)), str(e)) from e
+                    raise ShedResponse(int(shed.group(2)), str(e)) from e
                 raise
             time.sleep(wait)
 
@@ -251,6 +259,13 @@ def main(argv=None) -> int:
         return 0
     metrics = parse_prometheus(text)
     rows = summarize(metrics)
+    # fleet state (round 13): replica count + per-replica health so a
+    # scrape of a sick fleet names the replica, not just the totals.
+    # Tolerant of an empty daemon (no warm fleet yet -> 0 replicas).
+    try:
+        fleet = json.loads(request(args.socket, "fleet"))
+    except Exception:
+        fleet = None
     if args.trace_out:
         trace = request(args.socket, "trace_dump")
         json.loads(trace)  # refuse to write a corrupt dump
@@ -263,6 +278,8 @@ def main(argv=None) -> int:
                                   {"n": args.slowlog}))
     if args.json:
         out = {"latency": rows}
+        if fleet is not None:
+            out["fleet"] = fleet
         if slow is not None:
             out["slowlog"] = slow.get("worst", [])
         print(json.dumps(out))
@@ -278,16 +295,32 @@ def main(argv=None) -> int:
             print(f"{r['metric']:<{w}}  {r['count']:>7}  "
                   f"{r['p50_ms']:>9.3f}  {r['p90_ms']:>9.3f}  "
                   f"{r['p99_ms']:>9.3f}")
+    if fleet is not None and fleet.get("replicas"):
+        print(f"fleet: {fleet['replicas']} replica(s)")
+        for r in fleet.get("replica", []):
+            print(f"  replica{r['replica']} {r['health']:<11} "
+                  f"{'draining ' if r.get('draining') else ''}"
+                  f"pending={r.get('pending', '-')} "
+                  f"active={r.get('active', '-')} "
+                  f"done={r.get('requests_done', '-')} "
+                  f"gen={r.get('generation', 0)} "
+                  f"restarts={r.get('restarts', 0)}")
     if slow is not None:
         print(f"slowlog: worst {len(slow.get('worst', []))} of "
               f"{slow.get('recorded', 0)} recorded")
         for e in slow.get("worst", []):
+            hops = e.get("replica_hops") or []
+            where = ("replicas=" + ">".join(str(h) for h in hops)
+                     + f" first_tok@r{e.get('replica_first_token')} "
+                     f"migrations={e.get('migrations', 0)} "
+                     if hops else "")
             print(f"  rid={e.get('rid')} tag={e.get('tag') or '-'} "
                   f"e2e={e.get('e2e_ms')}ms ttft={e.get('ttft_ms')}ms "
                   f"itl_max={e.get('itl_max_ms')}ms"
                   f"@tok{e.get('itl_max_at_token')} "
                   f"queue={e.get('queue_wait_ms')}ms "
                   f"chunks={e.get('prefill_chunks')} "
+                  f"{where}"
                   f"tokens={e.get('tokens')}")
     return 0
 

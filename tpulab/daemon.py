@@ -52,6 +52,31 @@ snapshots (greedy streams bit-identical to an uninterrupted run;
 failure surfaces).  ``daemon_engine_restarts`` / ``daemon_replays`` /
 ``daemon_shed_requests`` count it all in the ``metrics`` scrape.
 
+Fleet routing (round 13): ``--replicas N`` serves each warm config
+from N PagedEngine replicas behind a router (policy in
+``tpulab/router.py``): placement by least-loaded + prefix-affinity
+scoring over health-checked replicas (HEALTHY -> SUSPECT on slow or
+stalled ticks -> QUARANTINED on a crash -> REBUILDING -> HEALTHY), and
+a replica failure MIGRATES its in-flight requests to healthy peers
+(``PagedEngine.resubmit`` on the peer — greedy streams stay
+bit-identical, sampled streams resume their key chain, the replay
+budget is charged per migration) while the replica rebuilds in the
+background and rejoins.  ``drain`` / ``undrain`` requests (config
+``{"replica": i}``) stop placement on one replica, let it quiesce,
+rebuild it, and return it to service — a zero-shed rolling restart is
+drain -> poll ``fleet`` until the generation advances -> undrain, per
+replica.  The ``fleet`` request returns the per-replica health table.
+``--hedge-ms MS`` (or per-request ``hedge_ms``) arms hedged retries:
+no first token inside the budget duplicates the request on a second
+replica, first token wins, the loser is cancelled with its blocks
+released.  When every replica is draining/rebuilding, submits park
+briefly and then answer a parseable ``rebuilding retry_after_ms=N``
+error frame (same retry contract as shedding, not counted as a shed).
+``daemon_migrations`` / ``daemon_hedges`` / ``daemon_hedge_wins`` /
+``daemon_drains`` count the router's work, and the ``metrics`` scrape
+adds an ``engine_<key>_replica<i>`` per-replica gauge breakdown next
+to the process-wide sums.
+
 Run: ``python -m tpulab.daemon --socket /tmp/tpulab.sock``
 Stop: SIGTERM/SIGINT, or an empty header (client disconnect is fine too).
 """
@@ -177,8 +202,28 @@ PREFILL_CHUNK = 32
 MAX_PENDING = int(os.environ.get("TPULAB_DAEMON_MAX_PENDING", "64"))
 
 #: supervisor replay budget: how many engine rebuilds a single request
-#: may ride through before its failure is surfaced to the waiter
+#: may ride through before its failure is surfaced to the waiter.  The
+#: fleet router charges the SAME budget per cross-replica migration —
+#: a request bounced around a failing fleet surfaces its failure at
+#: exactly this many replays, never loops.
 REPLAY_BUDGET = int(os.environ.get("TPULAB_DAEMON_REPLAY_BUDGET", "2"))
+
+#: fleet size per warm serving config (``--replicas N`` overrides):
+#: each config's requests are placed across N PagedEngine replicas by
+#: least-loaded + prefix-affinity scoring (tpulab/router.py)
+REPLICAS = int(os.environ.get("TPULAB_DAEMON_REPLICAS", "1"))
+
+#: hedge budget in milliseconds (0 = off): a request still waiting for
+#: its FIRST token past this budget is duplicated onto a second
+#: healthy replica — first token wins, the loser is cancelled with its
+#: blocks released.  ``--hedge-ms`` / per-request ``hedge_ms`` override.
+HEDGE_MS = float(os.environ.get("TPULAB_DAEMON_HEDGE_MS", "0"))
+
+#: how long a submit may park waiting for SOME replica to become
+#: placeable (whole fleet draining/rebuilding) before the daemon
+#: answers a parseable ``rebuilding retry_after_ms=N`` error frame —
+#: backpressure clients retry on (tools/obs_report.py), not a failure
+REBUILD_PARK_S = float(os.environ.get("TPULAB_DAEMON_REBUILD_PARK_S", "30"))
 
 #: shedding looks at the queue-wait p99 over (roughly) the last window,
 #: not the process-lifetime histogram: a congestion spell an hour ago
@@ -197,6 +242,26 @@ _C_REPLAYS = _obs.counter(
 _C_SHED = _obs.counter(
     "daemon_shed_requests",
     "requests rejected with retry-after (deadline/backpressure shedding)")
+#: fleet-router counters (round 13): cross-replica request migrations
+#: after a replica failure, hedged duplicates fired for stragglers,
+#: hedges whose duplicate won the first-token race, and operator
+#: drain operations accepted
+_C_MIGRATIONS = _obs.counter(
+    "daemon_migrations",
+    "in-flight requests migrated to a healthy peer replica after a "
+    "replica failure")
+_C_HEDGES = _obs.counter(
+    "daemon_hedges",
+    "straggler requests duplicated onto a second replica (hedged "
+    "retries; first token wins)")
+_C_HEDGE_WINS = _obs.counter(
+    "daemon_hedge_wins",
+    "hedged requests whose duplicate produced the first token (the "
+    "original was cancelled)")
+_C_DRAINS = _obs.counter(
+    "daemon_drains",
+    "replica drain operations accepted (placement stopped; replica "
+    "rebuilds once quiesced)")
 
 
 class ShedError(RuntimeError):
@@ -211,6 +276,23 @@ class ShedError(RuntimeError):
         self.retry_after_ms = int(retry_after_ms)
         super().__init__(
             f"shed retry_after_ms={self.retry_after_ms} ({why})")
+
+
+class RebuildingError(ShedError):
+    """Fleet-wide park timed out: every replica of the requested
+    config is draining/quarantined/rebuilding, so placement waited
+    ``REBUILD_PARK_S`` and gave up.  Rendered as an error frame whose
+    body starts with ``rebuilding retry_after_ms=<int>`` — the same
+    parseable retry-after contract as shedding (clients back off and
+    retry; tpulab.loadgen.SHED_RE matches both), but NOT counted as a
+    shed: a rolling restart that briefly parks traffic must not look
+    like load shedding in the goodput accounting."""
+
+    def __init__(self, retry_after_ms: int, why: str):
+        self.retry_after_ms = int(retry_after_ms)
+        # skip ShedError.__init__'s "shed " prefix
+        RuntimeError.__init__(
+            self, f"rebuilding retry_after_ms={self.retry_after_ms} ({why})")
 
 
 #: serializes the remaining host-orchestrated single-stream strategy
@@ -332,12 +414,23 @@ class _GenerateService:
             # prime the queue-wait window baseline: the histogram
             # exists once any engine does (paged registers it at
             # import), and shedding wants deltas from HERE on
-            if not self._qw_marks:
-                h = _obs.REGISTRY.get("queue_wait_seconds")
-                if h is not None:
-                    self._qw_marks.append(
-                        (time.monotonic(), h.snapshot()["counts"]))
+            self._prime_qw_locked()
             return st
+
+    def _prime_qw_locked(self) -> None:
+        """Seed the rolling queue-wait mark (caller holds self.lock).
+        Shared by the legacy per-engine path (_state_for) and the
+        fleet submit path — both want shed p99 deltas measured from
+        the first serving activity, not process start."""
+        if not self._qw_marks:
+            h = _obs.REGISTRY.get("queue_wait_seconds")
+            if h is not None:
+                self._qw_marks.append(
+                    (time.monotonic(), h.snapshot()["counts"]))
+
+    def prime_queue_wait(self) -> None:
+        with self.lock:
+            self._prime_qw_locked()
 
     def _queue_wait_p99_ms(self) -> float:
         """Queue-wait p99 over (roughly) the last
@@ -713,6 +806,853 @@ def _counters_line(row: dict) -> str:
     return " ".join(f"{k}={row[k]}" for k in _WAVE_KEYS if k in row)
 
 
+# ------------------------------------------------------------------ fleet
+#
+# Round 13: the daemon serves each warm config from a FLEET of
+# ``REPLICAS`` identical PagedEngine replicas behind a router
+# (placement policy in tpulab/router.py).  Each replica keeps its own
+# condition (engine mutex + stepper wakeup) exactly like the legacy
+# per-engine states, so replica steppers never serialize behind each
+# other's device dispatch; waiters instead park on the FLEET's
+# condition (``_Fleet.cv``), which is the one LEAF lock of the layer —
+# a request that MIGRATES to a healthy peer after a replica failure
+# keeps its waiter without re-parenting it between replica conditions.
+#
+# Lock order (strict, deadlock-free by construction):
+#     replica.cond  ->  fleet.cv          (allowed)
+#     fleet.cv      ->  replica.cond      (NEVER)
+#     replica.cond  ->  other replica.cond (NEVER)
+# Paths that need "find the owner, then act on it" read under
+# fleet.cv, release, lock the replica, and re-validate.
+
+
+from tpulab import router as _router
+
+
+class _Ticket:
+    """One fleet request's waiter handle: the engine ``_Request`` (the
+    object itself migrates between replicas, so ``req.out`` streaming
+    survives a migration with zero lost or duplicated tokens), the
+    current owner replica, the eventual result, and the replay budget
+    the request carries ACROSS migrations — every field is guarded by
+    the fleet condition."""
+
+    __slots__ = ("req", "replica", "result", "done", "retries",
+                 "cancelled", "parked", "twin", "hedge_winner",
+                 "is_hedge")
+
+    def __init__(self, req, replica):
+        self.req = req
+        self.replica = replica      # current owner (None while parked)
+        self.result = None
+        self.done = False
+        self.retries = 0            # replay budget charged per failure
+        self.cancelled = False      # waiter abandoned: discard results
+        self.parked = False         # awaiting the owner's rebuild
+        self.twin = None            # hedge duplicate's ticket
+        self.hedge_winner = None    # decided first-token winner
+        self.is_hedge = False
+
+
+class _Replica:
+    """One engine replica: its engine + tokenizer, the per-replica
+    condition (engine mutex), the ticket table for its in-flight
+    requests, and the health/drain state the router places against.
+
+    ``cond``-guarded: engine, tickets, stepper_alive, dead.
+    ``fleet.cv``-guarded: health, draining, drain_pending, generation,
+    restarts, parked."""
+
+    def __init__(self, fleet, index, engine, tok):
+        self.fleet = fleet
+        self.index = index
+        self.scope = f"replica{index}"
+        self.cond = threading.Condition()
+        self.engine = engine
+        self.tok = tok
+        engine.replica_index = index
+        engine.fault_scope = self.scope
+        self.tickets: dict = {}       # engine req_id -> _Ticket
+        self.stepper_alive = False
+        #: True between a failure harvest and the rebuild's engine
+        #: swap: the engine object is quarantined — no submit, no
+        #: stepper may touch it
+        self.dead = False
+        self.health = _router.ReplicaHealth()
+        self.draining = False         # operator drain: no placement
+        self.drain_pending = False    # rebuild still owed once idle
+        self.generation = 0           # completed rebuilds
+        self.restarts = 0             # failure-driven rebuilds
+        self.parked: list = []        # tickets awaiting this rebuild
+
+
+class _Fleet:
+    """N replicas serving one config, plus the fleet condition every
+    waiter parks on.  ``builder`` is the cold-build recipe shared by
+    all replicas (``_build_engine`` closure for daemon fleets; tests
+    inject their own)."""
+
+    def __init__(self, builder, key=None, stamp=None):
+        self.builder = builder
+        self.key = key
+        self.stamp = stamp
+        self.cv = threading.Condition()
+        self.replicas: list = []
+        self.tok = None
+
+    def add(self, engine, tok) -> "_Replica":
+        r = _Replica(self, len(self.replicas), engine, tok)
+        self.replicas.append(r)
+        if self.tok is None:
+            self.tok = tok
+        return r
+
+
+def _make_fleet(builder, n: int, key=None, stamp=None) -> _Fleet:
+    fleet = _Fleet(builder, key=key, stamp=stamp)
+    for _ in range(max(1, int(n))):
+        eng, tok = builder()
+        fleet.add(eng, tok)
+    return fleet
+
+
+class _FleetService:
+    """Fleet-grade continuous batching: placement, health, migration,
+    drain, and hedged retries over a :class:`_Fleet`.
+
+    The per-replica stepping discipline is the `_GenerateService` one
+    (a single stepper thread per replica advances all its slots under
+    the replica condition); what changes is the FAILURE path — a
+    crashed replica's in-flight requests are resubmitted on a healthy
+    PEER (``PagedEngine.resubmit`` generalized from rebuild-in-place
+    to resubmit-anywhere) while the crashed replica rebuilds in the
+    background and rejoins, so a single replica failure no longer
+    stalls every rider behind one recompile."""
+
+    def __init__(self):
+        self.lock = threading.Lock()   # the _FLEETS registry lock
+
+    # ---------------------------------------------------------- placement
+    def _views(self, fleet: _Fleet, prompt, exclude) -> list:
+        views = []
+        with fleet.cv:
+            cand = [(r, r.health.placeable and not r.draining,
+                     r.health.state == _router.SUSPECT)
+                    for r in fleet.replicas if r.index not in exclude]
+        for r, placeable, suspect in cand:
+            if not placeable:
+                continue
+            with r.cond:
+                if r.dead:
+                    continue
+                eng = r.engine
+                load = len(eng.pending) + sum(
+                    1 for a in eng.active if a is not None)
+                affinity = 0
+                if prompt is not None and len(prompt) > 1:
+                    # shared-prefix blocks already resident in THIS
+                    # replica's cache (the LRU freshen is harmless —
+                    # the entry IS being matched)
+                    affinity = len(eng._lookup_prefix(prompt)[0])
+            views.append(_router.ReplicaView(
+                r.index, True, suspect, load, affinity))
+        return views
+
+    def _place(self, fleet: _Fleet, prompt,
+               exclude=frozenset()) -> Optional[_Replica]:
+        idx = _router.choose_replica(self._views(fleet, prompt, exclude))
+        return None if idx is None else fleet.replicas[idx]
+
+    # ---------------------------------------------------------- submission
+    def _ensure_stepper_locked(self, replica: _Replica) -> None:
+        """Spawn the replica's stepper if dead (caller holds
+        replica.cond) — same flag discipline as the legacy stepper: the
+        flag only clears inside the locked idle check, so a submitter
+        can never observe a dead-but-flagged-alive stepper."""
+        if not replica.stepper_alive:
+            replica.stepper_alive = True
+            threading.Thread(
+                target=self._step_loop, args=(replica, replica.engine),
+                daemon=True).start()
+
+    def _try_submit(self, fleet: _Fleet, replica: _Replica, prompt,
+                    steps: int, kw: dict, deadline_ms, req_rid, tag):
+        """Submit on one replica; returns the ticket, ``"full"`` on a
+        bounded-queue rejection, or None when the replica became
+        unplaceable between scoring and submit (caller re-places).
+        Raises ShedError on a blown deadline budget."""
+        from tpulab.models.paged import QueueFullError
+
+        draft = None
+        for _ in range(2):
+            with replica.cond:
+                if replica.dead:
+                    return None
+                with fleet.cv:
+                    if not (replica.health.placeable
+                            and not replica.draining):
+                        return None
+                eng = replica.engine
+                if (kw.get("spec") == "draft"
+                        and eng.draft_params is None and draft is None):
+                    pass  # build the int8 draft OUTSIDE the condition
+                else:
+                    _GEN_SERVICE._shed_check(eng, deadline_ms)
+                    try:
+                        eng.submit(prompt, max_new=steps, rid=req_rid,
+                                   tag=tag, **kw)
+                    except QueueFullError:
+                        return "full"
+                    req = eng.pending[-1]
+                    tkt = _Ticket(req, replica)
+                    replica.tickets[req.req_id] = tkt
+                    self._ensure_stepper_locked(replica)
+                    return tkt
+            draft = _draft_for(eng)
+            with replica.cond:
+                if replica.engine is not eng:
+                    return None  # swapped mid-build: re-place
+                if eng.draft_params is None:
+                    eng.set_draft(draft, eng.cfg)
+        return None
+
+    def _submit(self, fleet: _Fleet, prompt, steps: int, kw: dict,
+                deadline_ms, req_rid, tag, exclude=frozenset(),
+                park: bool = True) -> _Ticket:
+        """Place and submit one request: best replica by router score;
+        a bounded-queue rejection tries the next-best before shedding;
+        a fleet with NO placeable replica (rolling restart's worst
+        case) parks up to ``REBUILD_PARK_S`` on the fleet condition,
+        then answers the parseable ``rebuilding retry_after_ms=N``
+        frame clients retry on."""
+        deadline = time.monotonic() + REBUILD_PARK_S
+        full: set = set()
+        while True:
+            replica = self._place(fleet, prompt, exclude | full)
+            if replica is None:
+                if self._place(fleet, prompt, exclude) is not None:
+                    # placeable replicas exist but every queue is at
+                    # its bound: backpressure, exactly like the
+                    # single-engine QueueFullError shed
+                    _C_SHED.inc()
+                    if req_rid is not None:
+                        _obs.event("daemon.shed", req_rid)
+                    raise ShedError(
+                        _GEN_SERVICE._retry_after_ms(),
+                        "every placeable replica is at max_pending")
+                if not park:
+                    raise RebuildingError(
+                        _GEN_SERVICE._retry_after_ms(),
+                        "no placeable replica")
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise RebuildingError(
+                        _GEN_SERVICE._retry_after_ms(),
+                        "no placeable replica (fleet "
+                        "draining/rebuilding)")
+                with fleet.cv:
+                    fleet.cv.wait(min(remaining, 0.25))
+                full.clear()  # queues may have drained while parked
+                continue
+            try:
+                got = self._try_submit(fleet, replica, prompt, steps,
+                                       kw, deadline_ms, req_rid, tag)
+            except ShedError:
+                # deadline shedding (_shed_check counted it): the
+                # trace event rides the caller-allocated rid
+                if req_rid is not None:
+                    _obs.event("daemon.shed", req_rid)
+                raise
+            if got == "full":
+                full.add(replica.index)
+                continue
+            if got is None:
+                continue  # replica flipped unplaceable: re-place
+            return got
+
+    # ------------------------------------------------------------ stepping
+    def _finish_locked(self, tkt: _Ticket, out) -> None:
+        """Publish a ticket's result (caller holds fleet.cv and
+        notifies).  An abandoned ticket's output is discarded."""
+        if tkt.cancelled:
+            return
+        tkt.result = out
+        tkt.done = True
+
+    def _finish_error_locked(self, tkt: _Ticket, err: Exception) -> None:
+        if tkt.cancelled:
+            return
+        tkt.result = err
+        tkt.done = True
+
+    def _step_loop(self, replica: _Replica, eng) -> None:
+        fleet = replica.fleet
+        try:
+            last_stall = eng.counters["stall_ticks"]
+            while True:
+                published = []
+                with replica.cond:
+                    if _faults.ACTIVE:
+                        _faults.fire("daemon.step", replica.scope)
+                    if (not eng.pending and not eng.inflight_depth
+                            and not any(
+                                r is not None for r in eng.active)):
+                        # clear INSIDE the locked region (submitters
+                        # either see alive-and-running or dead-and-
+                        # respawn, never a dead flag-alive); the print
+                        # happens outside the lock
+                        replica.stepper_alive = False
+                        row = _engine_stats(eng)
+                        break
+                    t0 = time.monotonic()
+                    for rid_e in eng.step():
+                        out = eng._done.pop(rid_e)
+                        tkt = replica.tickets.pop(rid_e, None)
+                        if tkt is not None:
+                            published.append((tkt, out))
+                    dt = time.monotonic() - t0
+                    stall = eng.counters["stall_ticks"]
+                    stalled = stall != last_stall
+                    last_stall = stall
+                with fleet.cv:
+                    # health evidence + publication + the per-tick
+                    # wakeup streaming waiters ride (the fleet.cv is a
+                    # leaf: taking it while holding nothing is safe,
+                    # and the stepper holds nothing here)
+                    replica.health.note_tick(dt, stalled)
+                    for tkt, out in published:
+                        self._finish_locked(tkt, out)
+                    fleet.cv.notify_all()
+            print(f"[serve] replica{replica.index} wave done: "
+                  + _counters_line(row), flush=True)
+            self._maybe_drain_rebuild(replica)
+        except Exception as e:
+            try:
+                self._fail_replica(replica, eng, e)
+            except Exception:
+                traceback.print_exc()
+
+    # ----------------------------------------------------- failure handling
+    def _fail_replica(self, replica: _Replica, eng, err: Exception):
+        """A replica's step loop died: quarantine it, publish what the
+        failed step already banked, then MIGRATE the in-flight set onto
+        healthy peers (park on this replica's rebuild only when no peer
+        is placeable — the fleet-of-one degenerate case, which behaves
+        exactly like the PR-6 rebuild-in-place supervisor).  Each
+        failure charges every survivor one replay against
+        ``REPLAY_BUDGET`` — the SAME budget whether the replay lands
+        here or on a peer, so a request bounced around a failing fleet
+        surfaces its failure instead of looping."""
+        import numpy as np
+
+        fleet = replica.fleet
+        _C_RESTARTS.inc()
+        with fleet.cv:
+            replica.restarts += 1
+            replica.health.note_crash()
+        with replica.cond:
+            banked = list(eng._done.items())
+            eng._done.clear()
+            survivors = list(eng.pending) + [
+                r for r in eng.active if r is not None]
+            eng.pending.clear()
+            eng.active = [None] * eng.slots
+            eng._inflight.clear()  # dead device buffers
+            tickets = dict(replica.tickets)
+            replica.tickets = {}
+            replica.stepper_alive = False
+            replica.dead = True
+        migrate = []
+        n_failed = 0
+        with fleet.cv:
+            for rid_e, out in banked:
+                tkt = tickets.pop(rid_e, None)
+                if tkt is not None:
+                    self._finish_locked(tkt, out)
+            for req in survivors:
+                tkt = tickets.pop(req.req_id, None)
+                if tkt is None:
+                    continue
+                if tkt.cancelled:
+                    # the waiter abandoned (possibly AFTER the failure):
+                    # never migrate a request nobody will consume
+                    continue
+                if req.cancelled:
+                    # waiter alive but already satisfied (early stop):
+                    # complete with the tokens it has
+                    self._finish_locked(tkt, np.asarray(req.out, np.int32))
+                    continue
+                tkt.retries += 1
+                if tkt.retries > REPLAY_BUDGET or fleet.builder is None:
+                    self._finish_error_locked(tkt, err)
+                    n_failed += 1
+                    continue
+                migrate.append(tkt)
+            fleet.cv.notify_all()
+        n_migrated = n_parked = 0
+        for tkt in migrate:
+            try:
+                migrated_ok = self._migrate(fleet, tkt, {replica.index})
+            except Exception as mig_err:  # noqa: BLE001 — one bad
+                # ticket must not strand the rest of the harvest: its
+                # waiter gets the error, the loop keeps migrating
+                with fleet.cv:
+                    self._finish_error_locked(tkt, mig_err)
+                    fleet.cv.notify_all()
+                n_failed += 1
+                continue
+            if migrated_ok:
+                n_migrated += 1
+            else:
+                # no placeable peer: park for THIS replica's rebuild
+                with fleet.cv:
+                    tkt.parked = True
+                    tkt.replica = None
+                    replica.parked.append(tkt)
+                n_parked += 1
+        if fleet.builder is not None:
+            with fleet.cv:
+                replica.health.note_rebuild_start()
+            threading.Thread(target=self._rebuild, args=(replica,),
+                             daemon=True).start()
+        print(f"[serve] replica{replica.index} failed "
+              f"({type(err).__name__}: {err}): migrated {n_migrated}, "
+              f"parked {n_parked}, failed {n_failed} request(s)",
+              flush=True)
+
+    def _migrate(self, fleet: _Fleet, tkt: _Ticket, exclude) -> bool:
+        """Resubmit one harvested request on the best healthy peer;
+        False when no peer is placeable (caller parks)."""
+        tried = set(exclude)
+        while True:
+            target = self._place(fleet, tkt.req.prompt, tried)
+            if target is None:
+                return False
+            if self._resubmit_on(target, tkt, migrated=True):
+                return True
+            tried.add(target.index)
+
+    def _resubmit_on(self, replica: _Replica, tkt: _Ticket,
+                     migrated: bool) -> bool:
+        """Resume a harvested request on ``replica`` via
+        ``PagedEngine.resubmit(fresh_id=True)`` (the peer's id space is
+        independent of the failed engine's).  Greedy streams stay
+        bit-identical to a fault-free run and sampled streams resume
+        their per-slot key chain — resubmit's own contract, now applied
+        across engines.  Returns False if the replica can't take it
+        (died/unplaceable in the meantime)."""
+        import numpy as np
+
+        fleet = replica.fleet
+        with fleet.cv:
+            if tkt.cancelled:
+                return True  # dropped: nothing to replay for
+            req = tkt.req
+            satisfied = req.cancelled
+        if satisfied:
+            with fleet.cv:
+                self._finish_locked(tkt, np.asarray(tkt.req.out, np.int32))
+                fleet.cv.notify_all()
+            return True
+        draft = None
+        if req.spec == "draft":
+            with replica.cond:
+                eng = replica.engine
+                need = (not replica.dead and eng.spec_k
+                        and eng.draft_params is None)
+            if need:
+                # a replayed dense-draft request needs the peer's int8
+                # draft installed up front; built OUTSIDE the condition
+                draft = _draft_for(eng)
+        with replica.cond:
+            if replica.dead:
+                return False
+            with fleet.cv:
+                if not replica.health.placeable:
+                    return False
+            eng = replica.engine
+            if draft is not None and eng.draft_params is None and eng.spec_k:
+                eng.set_draft(draft, eng.cfg)
+            if req.spec != "off" and not eng.spec_k:
+                # peer without spec capability: degrade to plain ticks
+                # — greedy streams are identical either way
+                req.spec = "off"
+            try:
+                rid_e = eng.resubmit(req, fresh_id=True)
+            except ValueError:
+                # an early-stop cancel raced past the satisfied check
+                # above (resubmit refuses cancelled requests — and the
+                # cancel path's parked branch sets the flag without
+                # this replica's condition): complete with the tokens
+                # the request already has, exactly like the satisfied
+                # path
+                rid_e = None
+            else:
+                replica.tickets[rid_e] = tkt
+                self._ensure_stepper_locked(replica)
+        if rid_e is None:
+            with fleet.cv:
+                self._finish_locked(tkt, np.asarray(tkt.req.out, np.int32))
+                fleet.cv.notify_all()
+            return True
+        with fleet.cv:
+            tkt.replica = replica
+            tkt.parked = False
+            if migrated:
+                tkt.req.migrations += 1
+                _C_MIGRATIONS.inc()
+                _obs.event("daemon.migrate", tkt.req.rid)
+            else:
+                _C_REPLAYS.inc()
+                _obs.event("daemon.replay", tkt.req.rid)
+            fleet.cv.notify_all()
+        return True
+
+    def _rebuild(self, replica: _Replica) -> None:
+        """Background rebuild of a quarantined/drained replica from the
+        fleet's builder recipe; on success the fresh engine swaps in,
+        the generation advances, and any parked requests replay into
+        it.  The cold build runs outside every lock — in-flight decode
+        on the healthy replicas never stalls behind it."""
+        fleet = replica.fleet
+        try:
+            eng, tok = fleet.builder()
+        except Exception as build_err:
+            with fleet.cv:
+                replica.health.note_rebuild_failed()
+                parked = list(replica.parked)
+                replica.parked = []
+                for tkt in parked:
+                    self._finish_error_locked(tkt, build_err)
+                fleet.cv.notify_all()
+            print(f"[serve] replica{replica.index} rebuild FAILED: "
+                  f"{build_err}", flush=True)
+            return
+        eng.replica_index = replica.index
+        eng.fault_scope = replica.scope
+        with replica.cond:
+            replica.engine = eng
+            replica.tok = tok
+            replica.tickets = {}
+            replica.dead = False
+        with fleet.cv:
+            replica.generation += 1
+            replica.health.note_rebuilt()
+            parked = list(replica.parked)
+            replica.parked = []
+            fleet.cv.notify_all()
+        for tkt in parked:
+            try:
+                if not self._resubmit_on(replica, tkt, migrated=False):
+                    if not self._migrate(fleet, tkt, set()):
+                        with fleet.cv:
+                            tkt.parked = True
+                            tkt.replica = None
+                            replica.parked.append(tkt)
+            except Exception as replay_err:  # noqa: BLE001 — one bad
+                # ticket must not strand the rest of the parked set
+                # (the waiters would hang past every client timeout):
+                # its waiter gets the error, the loop keeps replaying
+                with fleet.cv:
+                    self._finish_error_locked(tkt, replay_err)
+                    fleet.cv.notify_all()
+        print(f"[serve] replica{replica.index} rebuilt (generation "
+              f"{replica.generation}, {len(parked)} parked request(s) "
+              f"replayed)", flush=True)
+
+    # --------------------------------------------------------------- drain
+    def drain(self, fleet: _Fleet, index: int) -> dict:
+        """Stop placement on one replica; once it quiesces (pending,
+        active, and in-flight all empty) it REBUILDS from the recipe —
+        the hot-restart primitive a zero-shed rolling restart composes
+        from.  Idempotent; counted once per drain edge."""
+        replica = fleet.replicas[index]
+        with fleet.cv:
+            fresh = not replica.draining
+            replica.draining = True
+            if fresh:
+                # arm the rebuild on the drain EDGE only: a repeated
+                # drain request must not re-rebuild an already-drained
+                # replica (idempotency)
+                replica.drain_pending = True
+                _C_DRAINS.inc()
+                _obs.event("daemon.drain", index)
+        self._maybe_drain_rebuild(replica)
+        return self.replica_status(replica)
+
+    def undrain(self, fleet: _Fleet, index: int) -> dict:
+        """Return a drained replica to placement (its rebuild, if one
+        was owed and ran, stays — generation advanced)."""
+        replica = fleet.replicas[index]
+        with fleet.cv:
+            replica.draining = False
+            replica.drain_pending = False
+            fleet.cv.notify_all()
+        return self.replica_status(replica)
+
+    def _maybe_drain_rebuild(self, replica: _Replica) -> None:
+        """Kick the drain-owed rebuild if the replica is idle (called
+        from the stepper's idle exit and from the drain request — the
+        two moments quiescence can first hold)."""
+        fleet = replica.fleet
+        start = False
+        with replica.cond:
+            eng = replica.engine
+            idle = (not replica.stepper_alive and not replica.dead
+                    and not eng.pending and not eng.inflight_depth
+                    and not any(r is not None for r in eng.active))
+            if idle:
+                with fleet.cv:
+                    if (replica.draining and replica.drain_pending
+                            and replica.health.state
+                            != _router.REBUILDING):
+                        replica.health.note_rebuild_start()
+                        replica.drain_pending = False
+                        start = True
+        if start and fleet.builder is not None:
+            threading.Thread(target=self._rebuild, args=(replica,),
+                             daemon=True).start()
+
+    # --------------------------------------------------------------- status
+    def replica_status(self, replica: _Replica) -> dict:
+        fleet = replica.fleet
+        with fleet.cv:
+            row = {"replica": replica.index,
+                   "health": replica.health.state,
+                   "suspects": replica.health.suspects,
+                   "crashes": replica.health.crashes,
+                   "draining": replica.draining,
+                   "generation": replica.generation,
+                   "restarts": replica.restarts,
+                   "parked": len(replica.parked)}
+        with replica.cond:
+            row["dead"] = replica.dead
+            eng = replica.engine
+            if not replica.dead:
+                row["pending"] = len(eng.pending)
+                row["active"] = sum(
+                    1 for a in eng.active if a is not None)
+                row["requests_done"] = eng.counters["requests_done"]
+                row["tokens_out"] = eng.counters["tokens_out"]
+        return row
+
+    def fleet_status(self, fleet: _Fleet) -> dict:
+        return {"replicas": len(fleet.replicas),
+                "replica": [self.replica_status(r)
+                            for r in fleet.replicas]}
+
+    # -------------------------------------------------------------- hedging
+    def _decide_winner_locked(self, tkt: _Ticket):
+        """First-token-wins resolution (caller holds fleet.cv): before
+        any hedge exists the primary IS the winner; with a twin racing,
+        the first ticket to produce a token (or finish cleanly) wins —
+        primary preferred on a tie, both-failed surfaces the primary's
+        error.  None while the race is still open."""
+        twin = tkt.twin
+        if twin is None:
+            return tkt
+        if tkt.hedge_winner is not None:
+            return tkt.hedge_winner
+        p_err = tkt.done and isinstance(tkt.result, Exception)
+        h_err = twin.done and isinstance(twin.result, Exception)
+        if (tkt.done or len(tkt.req.out) > 0) and not p_err:
+            return tkt
+        if (twin.done or len(twin.req.out) > 0) and not h_err:
+            return twin
+        if p_err and h_err:
+            return tkt
+        return None
+
+    def _fire_hedge(self, fleet: _Fleet, tkt: _Ticket, prompt,
+                    steps: int, kw: dict, req_rid, tag) -> None:
+        """Duplicate a straggler (no first token inside its hedge
+        budget) onto a second replica.  The duplicate is a full ticket
+        with the same wire rid/tag (one linked trace tree); the loser
+        of the first-token race is cancelled with its blocks released
+        through the engine's normal cancel path."""
+        with fleet.cv:
+            if (tkt.done or tkt.cancelled or tkt.twin is not None
+                    or len(tkt.req.out) > 0):
+                return
+            cur = tkt.replica
+            exclude = {cur.index} if cur is not None else set()
+        try:
+            twin = self._submit(fleet, prompt, steps, kw, None,
+                                req_rid, tag,
+                                exclude=frozenset(exclude), park=False)
+        except ShedError:
+            return  # no healthy capacity to hedge into: not an error
+        with fleet.cv:
+            twin.is_hedge = True
+            tkt.twin = twin
+            _C_HEDGES.inc()
+            if req_rid is not None:
+                _obs.event("daemon.hedge", req_rid)
+            fleet.cv.notify_all()
+
+    # ------------------------------------------------------------ cancelling
+    def _engine_cancel(self, fleet: _Fleet, tkt: _Ticket,
+                       mark: bool) -> None:
+        """Cancel a ticket's request engine-side.  ``mark=True``
+        abandons it (results discarded — the waiter is gone);
+        ``mark=False`` is the early-stop path (waiter alive; the
+        request finishes through the NORMAL path next tick so block
+        accounting releases exactly).  Migration can move the request
+        between the lookup and the cancel — re-validate and retry
+        against the new owner (bounded: a request only migrates while
+        replicas are actively failing)."""
+        import numpy as np
+
+        for _ in range(64):
+            with fleet.cv:
+                if mark:
+                    tkt.cancelled = True
+                    tkt.result = None
+                    tkt.done = False
+                if tkt.done:
+                    return
+                rep = tkt.replica
+                if tkt.parked or rep is None:
+                    # parked for a rebuild: the resubmit path honors
+                    # the flags (cancelled -> dropped; req.cancelled ->
+                    # completed with the tokens it has)
+                    if not mark:
+                        tkt.req.cancelled = True
+                    return
+            finish_now = False
+            with rep.cond:
+                # the id is only meaningful while THIS ticket owns it
+                # on THIS replica: after a migrate-away + rebuild the
+                # fresh engine's counter can reissue the same small
+                # integer to a stranger, and cancelling by raw id
+                # would kill the stranger's request
+                if (not rep.dead
+                        and rep.tickets.get(tkt.req.req_id) is tkt):
+                    where = rep.engine.cancel(tkt.req.req_id)
+                    if where == "pending":
+                        rep.tickets.pop(tkt.req.req_id, None)
+                        finish_now = not mark
+            if finish_now:
+                # early stop caught the request queued (a migration
+                # window): nothing will ever publish it — complete
+                # with the tokens produced so far
+                with fleet.cv:
+                    self._finish_locked(
+                        tkt, np.asarray(tkt.req.out, np.int32))
+                    fleet.cv.notify_all()
+                return
+            with fleet.cv:
+                if tkt.done or tkt.parked or tkt.replica is rep:
+                    return
+            # migrated between reads: retry on the new owner
+
+    # ------------------------------------------------------------- generate
+    def generate(self, fleet: _Fleet, prompt, steps: int, *,
+                 temperature: float = 0.0, seed: int = 0,
+                 repetition_penalty: float = 1.0, stop_byte: int = -1,
+                 spec: str = "off", spec_k: int = 0, spec_ngram: int = 0,
+                 deadline_ms=None, priority: int = 0, req_rid=None,
+                 tag: str = "", hedge_ms: float = 0.0,
+                 on_progress=None):
+        """Block until the request finishes somewhere in the fleet;
+        returns the full token array.  Same contract as
+        ``_GenerateService.generate`` (streaming via ``on_progress``,
+        early-stop on a truthy return, shed/deadline semantics) plus
+        the fleet behaviors: router placement, transparent migration on
+        replica failure, and hedged retries (``hedge_ms`` > 0: no first
+        token inside the budget fires a duplicate on a second replica,
+        first token wins, loser cancelled)."""
+        import numpy as np
+
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        kw = dict(temperature=temperature, seed=seed,
+                  repetition_penalty=repetition_penalty,
+                  stop_byte=stop_byte, spec=spec, spec_k=spec_k,
+                  spec_ngram=spec_ngram, priority=priority)
+        _GEN_SERVICE.prime_queue_wait()
+        tkt = self._submit(fleet, prompt, steps, kw, deadline_ms,
+                           req_rid, tag)
+        hedge_at = None
+        if hedge_ms and len(fleet.replicas) > 1:
+            hedge_at = time.monotonic() + float(hedge_ms) / 1e3
+        sent = 0
+        stopped = False
+        try:
+            while True:
+                fire_hedge = False
+                loser = None
+                with fleet.cv:
+                    while True:
+                        win = self._decide_winner_locked(tkt)
+                        if win is not None and win.done:
+                            break
+                        if (win is not None and on_progress is not None
+                                and not stopped
+                                and len(win.req.out) > sent):
+                            break
+                        timeout = None
+                        if hedge_at is not None and tkt.twin is None:
+                            timeout = hedge_at - time.monotonic()
+                            if timeout <= 0:
+                                fire_hedge = True
+                                break
+                        fleet.cv.wait(timeout)
+                    if (tkt.twin is not None and win is not None
+                            and tkt.hedge_winner is None):
+                        # first token (or clean finish) decides the
+                        # race exactly once; the loser is cancelled
+                        # OUTSIDE the fleet condition
+                        tkt.hedge_winner = win
+                        loser = tkt.twin if win is tkt else tkt
+                        if win is not tkt:
+                            _C_HEDGE_WINS.inc()
+                    done = win is not None and win.done
+                    result = win.result if done else None
+                    inc = []
+                    if (win is not None and on_progress is not None
+                            and not stopped):
+                        inc = list(win.req.out[sent:])
+                        sent = len(win.req.out)
+                if loser is not None:
+                    self._engine_cancel(fleet, loser, mark=True)
+                if fire_hedge:
+                    hedge_at = None  # one hedge per request
+                    self._fire_hedge(fleet, tkt, prompt, steps, kw,
+                                     req_rid, tag)
+                    continue
+                if inc and on_progress is not None:
+                    if on_progress(inc) and not done and not stopped:
+                        stopped = True
+                        # early stop: finish through the NORMAL path
+                        # (result still publishes; admission's block
+                        # count releases exactly)
+                        self._engine_cancel(fleet, win, mark=False)
+                if done:
+                    if isinstance(result, Exception):
+                        raise RuntimeError(
+                            f"engine step failed: {result!r}"
+                        ) from result
+                    return result
+        except BaseException:
+            # the waiter is abandoning (typically: a streaming client
+            # died inside on_progress) — discard results, cancel the
+            # request (and any hedge twin) wherever it currently lives
+            with fleet.cv:
+                twin = tkt.twin
+            self._engine_cancel(fleet, tkt, mark=True)
+            if twin is not None:
+                self._engine_cancel(fleet, twin, mark=True)
+            raise
+
+
+_FLEET_SERVICE = _FleetService()
+
+#: (realpath|None, attn, kv_dtype, tp, prefill_chunk) -> (stamp, fleet);
+#: LRU, max 4 — the fleet-era sibling of _ENGINES (which stays for the
+#: legacy direct-engine surfaces and tests)
+_FLEETS: "dict" = {}
+
+
 def _ckpt_stamp(ckpt_dir: str):
     """Cheap CHANGE DETECTOR, not a step parser: the largest
     integer-named subdirectory.  Compared against the stamp taken when
@@ -823,6 +1763,38 @@ def _build_engine(path, attn: str, kv_dtype: str, tp: int,
     return engine, tok
 
 
+def _fleet_for(ckpt, attn: str = "gather", kv_dtype: str = "native",
+               tp: int = 1, prefill_chunk: Optional[int] = None) -> _Fleet:
+    """Warm :class:`_Fleet` (``REPLICAS`` engines + tokenizer) for a
+    serving config — the fleet-era ``_engine_for``: same cache keying
+    (realpath + serving knobs), same stamp-based checkpoint staleness
+    eviction, same LRU bound of 4 resident entries, and the same
+    build-outside-the-lock discipline (an N-replica cold build must
+    never stall in-flight decode on other fleets)."""
+    if prefill_chunk is None:
+        prefill_chunk = PREFILL_CHUNK
+    path = os.path.realpath(ckpt) if ckpt else None
+    key = (path, attn, kv_dtype, tp, prefill_chunk)
+    stamp = _ckpt_stamp(path) if path else None
+    with _FLEET_SERVICE.lock:
+        hit = _FLEETS.get(key)
+        if hit is not None and hit[0] == stamp:
+            _FLEETS[key] = _FLEETS.pop(key)  # LRU freshen
+            return hit[1]
+    builder = (lambda: _build_engine(path, attn, kv_dtype, tp,
+                                     prefill_chunk))
+    fleet = _make_fleet(builder, REPLICAS, key=key, stamp=stamp)
+    with _FLEET_SERVICE.lock:
+        hit = _FLEETS.get(key)
+        if hit is not None and hit[0] == stamp:
+            return hit[1]  # concurrent build won; use theirs
+        _FLEETS.pop(key, None)
+        _FLEETS[key] = (stamp, fleet)
+        while len(_FLEETS) > 4:
+            _FLEETS.pop(next(iter(_FLEETS)))
+    return fleet
+
+
 def _handle_generate(header: dict, payload: bytes,
                      send_chunk=None) -> bytes:
     """``generate`` pseudo-lab: payload = UTF-8 prompt bytes (the byte
@@ -906,6 +1878,14 @@ def _handle_generate(header: dict, payload: bytes,
     # slow-log entry
     tag = str(config.get("tag", ""))
     req_rid = _obs.next_rid()
+    # hedged retries (fleet): a request still waiting for its FIRST
+    # token past ``hedge_ms`` is duplicated on a second replica —
+    # first token wins, the loser is cancelled with its blocks
+    # released.  0 disables; the daemon-wide default is --hedge-ms.
+    hedge_ms = config.get("hedge_ms", HEDGE_MS)
+    hedge_ms = float(hedge_ms) if hedge_ms else 0.0
+    if hedge_ms < 0:
+        raise ValueError(f"hedge_ms must be >= 0, got {hedge_ms}")
     prefill_chunk = int(config.get("prefill_chunk", PREFILL_CHUNK))
     if prefill_chunk < 0:
         raise ValueError(
@@ -991,8 +1971,13 @@ def _handle_generate(header: dict, payload: bytes,
         raise ValueError(
             "tp > 1 serves the engine decode path only: drop "
             "beams/speculative/prompt_lookup or tp")
-    engine, tok = _engine_for(config.get("ckpt_dir"), attn, kv_dtype, tp,
-                              prefill_chunk)
+    fleet = _fleet_for(config.get("ckpt_dir"), attn, kv_dtype, tp,
+                       prefill_chunk)
+    tok = fleet.tok
+    # config-validation reads only (beam search additionally runs on
+    # these params): every replica shares the one build recipe, so
+    # replica 0's config speaks for the fleet
+    engine = fleet.replicas[0].engine
     if tok is None:
         prompt = np.frombuffer(payload, np.uint8).astype(np.int32)
         eng_stop = stop_byte
@@ -1033,16 +2018,14 @@ def _handle_generate(header: dict, payload: bytes,
         # proposes from per-slot dense caches.  Concurrent speculative
         # clients batch through the same verify ticks as plain traffic
         # — the old host-orchestrated loop (and its _SPEC_LOCK
-        # serialization) is retired for the paged path.
+        # serialization) is retired for the paged path.  The int8
+        # draft installs lazily PER REPLICA at placement time
+        # (_FleetService._try_submit), so only replicas that actually
+        # serve speculative traffic pay the quantization.
         if engine.cfg.n_experts:
             raise ValueError(
                 "speculative decoding needs an int8 draft; MoE "
                 "checkpoints are not quantizable (models/quant.py)")
-        if engine.draft_params is None:
-            draft = _draft_for(engine)  # built OUTSIDE the engine cond
-            st = _GEN_SERVICE._state_for(engine)
-            with st.cond:  # serialize install against the stepper
-                engine.set_draft(draft, engine.cfg)
 
     on_progress = None
     if send_chunk is not None and bool(config.get("stream")):
@@ -1072,15 +2055,15 @@ def _handle_generate(header: dict, payload: bytes,
                 send_chunk(chunk)
             return state["done"]
 
-    out = _GEN_SERVICE.generate(
-        engine, prompt, steps,
+    out = _FLEET_SERVICE.generate(
+        fleet, prompt, steps,
         temperature=float(config.get("temperature", 0.0)),
         seed=int(config.get("seed", 0)),
         repetition_penalty=float(config.get("repetition_penalty", 1.0)),
         stop_byte=eng_stop,
         spec=spec_mode, spec_k=spec_k, spec_ngram=spec_ngram,
         deadline_ms=deadline_ms, priority=priority,
-        req_rid=req_rid, tag=tag,
+        req_rid=req_rid, tag=tag, hedge_ms=hedge_ms,
         on_progress=on_progress,
     )
     if tok is None:
@@ -1108,6 +2091,24 @@ def _handle_generate_stats(header: dict) -> bytes:
            str(config.get("kv_dtype", "native")),
            int(config.get("tp", 1)),
            int(config.get("prefill_chunk", PREFILL_CHUNK)))
+    with _FLEET_SERVICE.lock:
+        fhit = _FLEETS.get(key)
+    if fhit is not None:
+        # fleet-era warm config: key-wise SUM across its replicas (the
+        # shape every existing consumer expects) plus the replica
+        # count; the per-replica breakdown lives in the `fleet`
+        # request and the metrics scrape's suffixed gauges
+        total: dict = {}
+        for r in fhit[1].replicas:
+            with r.cond:
+                eng = None if r.dead else r.engine
+            if eng is None:
+                continue
+            for k, v in _engine_stats(eng).items():
+                total[k] = total.get(k, 0) + v
+        if total:
+            total["replicas"] = len(fhit[1].replicas)
+        return json.dumps(total).encode("utf-8")
     with _GEN_SERVICE.lock:  # registry lookup only — short-held
         hit = _ENGINES.get(key)
     # the snapshot runs OUTSIDE any lock so observability never queues
@@ -1118,6 +2119,11 @@ def _handle_generate_stats(header: dict) -> bytes:
     # histogram surfaces a tick races against.)
     stats = _engine_stats(hit[1]) if hit else {}
     return json.dumps(stats).encode("utf-8")
+
+
+#: serializes the engine-gauge rewrite + render inside a ``metrics``
+#: scrape (see _handle_metrics) — scrapes only, never the serving path
+_METRICS_RENDER_LOCK = threading.Lock()
 
 
 def _handle_metrics(header: dict) -> bytes:
@@ -1136,23 +2142,55 @@ def _handle_metrics(header: dict) -> bytes:
 
     with _GEN_SERVICE.lock:  # registry lookup only — short-held
         engines = [v[1] for v in _ENGINES.values()]
+    with _FLEET_SERVICE.lock:
+        fleets = [v[1] for v in _FLEETS.values()]
     total: dict = {}
+    per_replica: dict = {}
     for eng in engines:
         # stats math OUTSIDE the service lock: a scrape must never
         # block a submit; the registry's own per-metric locks make the
         # render below copy-on-read (no torn histograms)
         for k, v in _engine_stats(eng).items():
             total[k] = total.get(k, 0) + v
-    if total:
-        publish_engine_stats(total)
-    else:
-        # no warm engines (none built yet, or the last one was evicted
-        # after a stepper failure): zero the mirror instead of freezing
-        # the dead engine's final values into every future scrape
-        for name in obs.REGISTRY.names():
-            if name.startswith("engine_"):
-                obs.REGISTRY.get(name).set(0)
-    return obs.render_prometheus().encode("utf-8")
+    for fleet in fleets:
+        for r in fleet.replicas:
+            with r.cond:  # engine pointer read only — short-held
+                eng = None if r.dead else r.engine
+            if eng is None:
+                continue
+            st = _engine_stats(eng)
+            agg = per_replica.setdefault(r.index, {})
+            for k, v in st.items():
+                total[k] = total.get(k, 0) + v
+                agg[k] = agg.get(k, 0) + v
+    # gauge rewrite + render under ONE scrape lock: the stale-suffix
+    # zeroing below is not atomic with the re-publish, so a concurrent
+    # scrape rendering mid-rewrite would report a healthy fleet as
+    # all-zero replicas next to non-zero totals.  Scrapes serialize
+    # against each other only — submits never take this lock.
+    with _METRICS_RENDER_LOCK:
+        if total:
+            publish_engine_stats(total)
+            # per-replica breakdown NEXT TO the process-wide sum
+            # (engine_<key>_replica<i> — one sick replica stays visible
+            # in a scrape instead of vanishing into the total).  Stale
+            # suffixed gauges (an evicted fleet's replicas) zero first
+            # so they can't freeze their final values into every
+            # scrape.
+            for name in obs.REGISTRY.names():
+                if name.startswith("engine_") and "_replica" in name:
+                    obs.REGISTRY.get(name).set(0)
+            for i, st in sorted(per_replica.items()):
+                publish_engine_stats(st, suffix=f"_replica{i}")
+        else:
+            # no warm engines (none built yet, or the last one was
+            # evicted after a stepper failure): zero the mirror instead
+            # of freezing the dead engine's final values into every
+            # future scrape
+            for name in obs.REGISTRY.names():
+                if name.startswith("engine_"):
+                    obs.REGISTRY.get(name).set(0)
+        return obs.render_prometheus().encode("utf-8")
 
 
 def _handle_trace_dump(header: dict) -> bytes:
@@ -1187,6 +2225,68 @@ def _handle_slowlog(header: dict) -> bytes:
     ).encode("utf-8")
 
 
+def _resolve_fleet(config: dict) -> Optional[_Fleet]:
+    """The warm fleet a ``fleet``/``drain``/``undrain`` request
+    targets: by the engine-selection keys when any are given (or when
+    several fleets are warm), else the single warm fleet — the common
+    one-config daemon needs no key juggling from operators."""
+    with _FLEET_SERVICE.lock:
+        fleets = dict(_FLEETS)
+    if not fleets:
+        return None
+    explicit = any(k in config for k in
+                   ("ckpt_dir", "attn", "kv_dtype", "tp", "prefill_chunk"))
+    if explicit or len(fleets) > 1:
+        path = config.get("ckpt_dir")
+        key = (os.path.realpath(path) if path else None,
+               str(config.get("attn", "gather")),
+               str(config.get("kv_dtype", "native")),
+               int(config.get("tp", 1)),
+               int(config.get("prefill_chunk", PREFILL_CHUNK)))
+        hit = fleets.get(key)
+        return hit[1] if hit else None
+    return next(iter(fleets.values()))[1]
+
+
+def _handle_fleet(header: dict) -> bytes:
+    """``fleet`` request: the fleet's replica table as JSON — per
+    replica: health state (HEALTHY/SUSPECT/QUARANTINED/REBUILDING),
+    drain flag, rebuild generation, restart count, parked requests,
+    and live load (pending/active/done/tokens).  Empty table when no
+    fleet is warm yet."""
+    config = header.get("config") or {}
+    fleet = _resolve_fleet(config)
+    if fleet is None:
+        return json.dumps({"replicas": 0, "replica": []}).encode("utf-8")
+    return json.dumps(_FLEET_SERVICE.fleet_status(fleet)).encode("utf-8")
+
+
+def _handle_drain(header: dict, undrain: bool = False) -> bytes:
+    """``drain`` / ``undrain`` requests: operator drain of one replica
+    (config ``{"replica": i}`` plus the engine-selection keys when
+    several fleets are warm).  Drain stops placement, lets the replica
+    quiesce, then rebuilds it from the recipe; undrain returns it to
+    placement.  Responds with the replica's status row; composing
+    drain -> poll ``fleet`` until the generation advances -> undrain
+    over each replica is a zero-shed rolling restart
+    (tools/goodput_gate.py --rolling-restart drives exactly that)."""
+    config = header.get("config") or {}
+    fleet = _resolve_fleet(config)
+    if fleet is None:
+        raise ValueError("no warm fleet to drain (serve a generate "
+                         "request first)")
+    idx = int(config.get("replica", 0))
+    if not 0 <= idx < len(fleet.replicas):
+        raise ValueError(
+            f"replica must be in [0, {len(fleet.replicas) - 1}], "
+            f"got {idx}")
+    if undrain:
+        row = _FLEET_SERVICE.undrain(fleet, idx)
+    else:
+        row = _FLEET_SERVICE.drain(fleet, idx)
+    return json.dumps(row).encode("utf-8")
+
+
 # Lab runs are SERIALIZED even though connections are threaded: their
 # "execution time:" lines feed the harness's stats CSVs, and two timed
 # kernels sharing the device would inflate each other's numbers.  (A
@@ -1207,6 +2307,12 @@ def handle_request(header: dict, payload: bytes,
         return _handle_trace_dump(header)
     if header.get("lab") == "slowlog":
         return _handle_slowlog(header)
+    if header.get("lab") == "fleet":
+        return _handle_fleet(header)
+    if header.get("lab") == "drain":
+        return _handle_drain(header)
+    if header.get("lab") == "undrain":
+        return _handle_drain(header, undrain=True)
     if header.get("lab") == "platform":
         # observability: which backend this daemon actually computes on
         # (tools/run_reference_harness.py --backend tpu refuses to write
@@ -1394,10 +2500,21 @@ def serve(socket_path: str, *, max_requests: Optional[int] = None) -> None:
 
 
 def main(argv=None) -> int:
-    global PREFILL_CHUNK
+    global PREFILL_CHUNK, REPLICAS, HEDGE_MS
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--socket", default=os.environ.get("TPULAB_DAEMON_SOCKET", "/tmp/tpulab.sock"))
     ap.add_argument("--max-requests", type=int, default=None, help="exit after N requests (tests)")
+    ap.add_argument("--replicas", type=int, default=REPLICAS, metavar="N",
+                    help="PagedEngine replicas per warm serving config "
+                         "(fleet routing: least-loaded + prefix-affinity "
+                         "placement, health checks, migration on replica "
+                         "failure, drain/undrain rolling restarts)")
+    ap.add_argument("--hedge-ms", type=float, default=HEDGE_MS,
+                    metavar="MS",
+                    help="hedged-retry budget: a request with no first "
+                         "token after MS is duplicated on a second "
+                         "replica, first token wins (0 = off; "
+                         "per-request 'hedge_ms' config overrides)")
     ap.add_argument("--prefill-chunk", type=int, default=PREFILL_CHUNK,
                     help="default prefill window for the serving engines "
                          "(chunked+interleaved admission; 0 = whole-prompt "
@@ -1414,6 +2531,10 @@ def main(argv=None) -> int:
                          "each entry's rid links to its trace_dump "
                          "events")
     args = ap.parse_args(argv)
+    if args.replicas < 1:
+        ap.error("--replicas must be >= 1")
+    if args.hedge_ms < 0:
+        ap.error("--hedge-ms must be >= 0")
     if args.prefill_chunk < 0:
         ap.error("--prefill-chunk must be >= 0")
     if args.trace_buffer is not None and args.trace_buffer < 0:
@@ -1421,6 +2542,8 @@ def main(argv=None) -> int:
     if args.slowlog is not None and args.slowlog < 0:
         ap.error("--slowlog must be >= 0")
     PREFILL_CHUNK = args.prefill_chunk
+    REPLICAS = args.replicas
+    HEDGE_MS = args.hedge_ms
     if args.trace_buffer is not None:
         from tpulab import obs
 

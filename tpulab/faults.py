@@ -67,6 +67,22 @@ firing; ``count`` (default 1) is how many consecutive hits it fires
 for.  ``faults.disable()`` restores the inert default; tests use the
 :func:`active` context manager.
 
+**Scoped sites (round 13).**  Fleet chaos needs to target ONE replica
+out of N identical engines: every engine-side site call carries an
+optional ``scope`` (the daemon's fleet layer stamps each replica's
+engine with ``fault_scope="replica<i>"``), and a rule whose site is
+written ``site@scope`` — e.g. ``paged.step@replica1`` — matches hits
+of that site from that scope only, counted on the scope's OWN
+deterministic hit counter.  Bare-site rules keep their pre-round-13
+meaning (the global hit count across all scopes), so existing
+schedules are unchanged::
+
+    faults.configure([
+        {"site": "paged.tick@replica1", "kind": "raise", "at": 40},
+        {"site": "paged.drain@replica2", "kind": "slow_ms", "at": 30,
+         "count": 40, "arg": 120.0},
+    ])
+
 For the wedged-socket-CLIENT case the daemon cannot inject (the client
 is another process), :func:`open_wedged_client` opens a connection
 that sends a partial frame and then stalls forever — chaos tests point
@@ -157,19 +173,37 @@ class FaultInjector:
                     out[r.site] = out.get(r.site, 0) + r.fired
             return out
 
-    def fire(self, site: str) -> Optional[_Rule]:
+    def fire(self, site: str,
+             scope: Optional[str] = None) -> Optional[_Rule]:
         """Count one hit of ``site``; apply the matching rule if any.
 
         ``raise`` raises, ``slow_ms`` sleeps, right here; the
         state-corrupting kinds (``nan_tokens``, ``corrupt_table``) are
         returned for the SITE to apply — only the site knows which
         array to damage.  At most one rule fires per hit (first match
-        in schedule order)."""
+        in schedule order).
+
+        ``scope`` (e.g. a fleet replica's ``"replica1"``) additionally
+        counts the hit on the scoped counter ``site@scope``; a rule
+        written against the scoped name matches that counter only —
+        the per-replica determinism fleet chaos schedules need (each
+        replica's stepper hits its own sites in its own order, while
+        the bare-site interleaving across replicas is scheduling-
+        dependent)."""
         with self._lock:
             hit = self._hits.get(site, 0) + 1
             self._hits[site] = hit
-            rule = next((r for r in self._rules
-                         if r.site == site and r.matches(hit)), None)
+            scoped = None
+            scoped_hit = 0
+            if scope is not None:
+                scoped = f"{site}@{scope}"
+                scoped_hit = self._hits.get(scoped, 0) + 1
+                self._hits[scoped] = scoped_hit
+            rule = next(
+                (r for r in self._rules
+                 if (r.site == site and r.matches(hit))
+                 or (scoped is not None and r.site == scoped
+                     and r.matches(scoped_hit))), None)
             if rule is not None:
                 rule.fired += 1
         if rule is None:
@@ -202,12 +236,15 @@ def disable() -> None:
     INJECTOR.disable()
 
 
-def fire(site: str) -> Optional[_Rule]:
+def fire(site: str, scope: Optional[str] = None) -> Optional[_Rule]:
     """Module-level site entry point.  Callers guard with
-    ``if faults.ACTIVE:`` so the disabled hot path never enters."""
+    ``if faults.ACTIVE:`` so the disabled hot path never enters.
+    ``scope`` opts the hit into the per-replica ``site@scope``
+    counters fleet chaos schedules target (see :class:`FaultInjector`
+    — bare-site rules are unaffected)."""
     if not ACTIVE:
         return None
-    return INJECTOR.fire(site)
+    return INJECTOR.fire(site, scope)
 
 
 def configure_from_env(var: str = "TPULAB_FAULTS") -> bool:

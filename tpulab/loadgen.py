@@ -54,11 +54,18 @@ import time
 from dataclasses import asdict, dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
-#: shed response contract (tpulab.daemon.ShedError): an error frame
-#: whose body matches this is backpressure, not a failure.  THE one
-#: copy of the client-side pattern — tools/obs_report.py imports it, so
-#: the two consumers can never drift apart on the wire contract.
-SHED_RE = re.compile(r"shed retry_after_ms=(\d+)")
+#: shed response contract (tpulab.daemon.ShedError, and the fleet
+#: layer's RebuildingError — a rolling restart's brief whole-fleet
+#: park): an error frame whose body matches this is backpressure, not
+#: a failure — honor the retry-after.  Group 1 is the ARM (``shed`` =
+#: load shedding, ``rebuilding`` = the fleet's drain park), group 2
+#: the retry-after ms: the two must stay distinguishable client-side
+#: too, or a rolling restart would masquerade as load shedding in
+#: goodput accounting (the same separation RebuildingError keeps
+#: server-side).  THE one copy of the client-side pattern —
+#: tools/obs_report.py imports it, so the consumers can never drift
+#: apart on the wire contract.
+SHED_RE = re.compile(r"(shed|rebuilding) retry_after_ms=(\d+)")
 
 #: deterministic filler vocabulary for prompt text (ASCII, so traces
 #: stay readable and JSON stays byte-stable)
@@ -146,6 +153,23 @@ SPECS: Dict[str, TraceSpec] = {
                       cancel_ms=(20.0, 120.0)),
     "steady": TraceSpec(name="steady", seed=7, n_requests=200,
                         arrival="poisson", rate_rps=12.0),
+    # the fleet chaos tier (tools/goodput_gate.py --chaos): longer
+    # output budgets keep requests IN FLIGHT when the fault schedule
+    # kills/wedges replicas mid-trace, and the classes carry no
+    # deadline — the acceptance gate requires every non-cancelled
+    # request to COMPLETE (migration, not shedding, absorbs the
+    # failures), so deadline-shedding must not be in play
+    "chaos": TraceSpec(
+        name="chaos", seed=21, n_requests=32, arrival="onoff",
+        rate_rps=8.0, steps_median=24, steps_sigma=0.5, steps_min=8,
+        steps_max=48, p_cancel=0.08, cancel_ms=(30.0, 200.0),
+        classes=(
+            SLOClass("interactive", weight=0.6, priority=2,
+                     deadline_ms=None, ttft_ms=20000.0, itl_ms=5000.0,
+                     e2e_ms=45000.0),
+            SLOClass("bulk", weight=0.4, priority=0, deadline_ms=None,
+                     ttft_ms=40000.0, itl_ms=10000.0, e2e_ms=90000.0),
+        )),
 }
 
 
@@ -353,9 +377,16 @@ def _blank_result(r: dict, tag: str) -> dict:
     return {
         "i": r["i"], "cls": r["cls"], "tag": tag, "session": r["session"],
         "turn": r["turn"], "t_sched_ms": r["t_ms"], "steps": r["steps"],
-        "ok": False, "shed": False, "cancelled": False, "error": None,
+        "ok": False, "shed": False, "rebuilding": False,
+        "cancelled": False, "error": None,
         "retry_after_ms": None, "ttft_ms": None, "e2e_ms": None,
         "itl_max_ms": 0.0, "n_chunks": 0, "bytes_out": 0,
+        # output identity + stream integrity (the chaos gate's
+        # zero-lost/duplicated-token evidence): ``sha`` hashes the
+        # terminal frame's full output; ``stream_ok`` is whether the
+        # streamed chunk concatenation equals that output exactly
+        # (None when nothing streamed before the terminal frame)
+        "sha": None, "stream_ok": None,
     }
 
 
@@ -379,6 +410,7 @@ def _run_one(socket_path: str, r: dict, tag: str, timeout_s: float) -> dict:
         s.sendall(struct.pack("<I", len(header)) + header
                   + struct.pack("<Q", len(payload)) + payload)
         t_prev = None
+        streamed = b""
         while True:
             status = _read_exact(s, 1, cancel_at, deadline)[0]
             (n,) = struct.unpack(
@@ -387,6 +419,7 @@ def _run_one(socket_path: str, r: dict, tag: str, timeout_s: float) -> dict:
             now = time.monotonic()
             if status == 2:  # streamed chunk: the client-observed ticks
                 out["n_chunks"] += 1
+                streamed += body
                 if out["ttft_ms"] is None:
                     out["ttft_ms"] = round((now - t_send) * 1e3, 3)
                 elif t_prev is not None:
@@ -395,15 +428,28 @@ def _run_one(socket_path: str, r: dict, tag: str, timeout_s: float) -> dict:
                 t_prev = now
                 continue
             if status == 0:
+                import hashlib
+
                 out["ok"] = True
                 out["e2e_ms"] = round((now - t_send) * 1e3, 3)
                 out["bytes_out"] = len(body)
+                out["sha"] = hashlib.sha256(body).hexdigest()[:16]
+                if out["n_chunks"]:
+                    # the terminal frame carries the FULL output with
+                    # chunks included: exact equality of the streamed
+                    # concatenation is the zero-lost/duplicated-token
+                    # check a migrated/hedged stream must pass
+                    out["stream_ok"] = streamed == body
             else:
                 text = body.decode("utf-8", "replace")
                 shed = SHED_RE.search(text)
                 if shed:
-                    out["shed"] = True
-                    out["retry_after_ms"] = int(shed.group(1))
+                    # both arms are backpressure, but they are NOT the
+                    # same outcome: "shed" is the daemon refusing load,
+                    # "rebuilding" is a rolling restart's drain park
+                    out["shed" if shed.group(1) == "shed"
+                        else "rebuilding"] = True
+                    out["retry_after_ms"] = int(shed.group(2))
                 else:
                     out["error"] = text[-300:]
             return out
@@ -471,15 +517,20 @@ def summarize(results: List[dict], trace: Trace, wall_s: float) -> dict:
     class's budgets (client-observed TTFT, worst inter-token gap, e2e).
     ``attainment`` divides by the eligible population (everything
     except scripted cancellations — a request the client hung up on is
-    neither good nor bad); sheds and errors count AGAINST attainment
-    (the daemon chose not to serve them).  ``goodput_tokens_per_s`` is
+    neither good nor bad); sheds, rebuilding parks, and errors count
+    AGAINST attainment (the request was not served inside the window),
+    but sheds and parks are tallied SEPARATELY — a rolling restart's
+    drain park must not masquerade as load shedding (the distinction
+    tpulab.daemon.RebuildingError keeps server-side).
+    ``goodput_tokens_per_s`` is
     the byte-LM token output of good requests over the replay wall
     time — the headline number the regression gate ratchets."""
     classes = {c["name"]: c for c in trace.classes}
     per: Dict[str, dict] = {}
     for c in trace.classes:
         per[c["name"]] = {
-            "n": 0, "completed": 0, "shed": 0, "cancelled": 0, "errors": 0,
+            "n": 0, "completed": 0, "shed": 0, "rebuilding": 0,
+            "cancelled": 0, "errors": 0,
             "slo_ttft": 0, "slo_itl": 0, "slo_e2e": 0, "in_slo": 0,
             "goodput_tokens": 0,
             "budgets_ms": {"ttft": c["ttft_ms"], "itl": c["itl_ms"],
@@ -494,6 +545,9 @@ def summarize(results: List[dict], trace: Trace, wall_s: float) -> dict:
             continue
         if r["shed"]:
             p["shed"] += 1
+            continue
+        if r.get("rebuilding"):
+            p["rebuilding"] += 1
             continue
         if not r["ok"]:
             p["errors"] += 1
@@ -513,8 +567,8 @@ def summarize(results: List[dict], trace: Trace, wall_s: float) -> dict:
         p["attainment"] = (round(p["in_slo"] / eligible, 4)
                            if eligible else None)
     tot = {k: sum(p[k] for p in per.values())
-           for k in ("n", "completed", "shed", "cancelled", "errors",
-                     "in_slo", "goodput_tokens")}
+           for k in ("n", "completed", "shed", "rebuilding", "cancelled",
+                     "errors", "in_slo", "goodput_tokens")}
     eligible = tot["n"] - tot["cancelled"]
     return {
         "classes": per,

@@ -625,7 +625,7 @@ def _spec_commit(state, adv, last_tok, new_keys, marks):
     )
 
 
-def publish_engine_stats(st: Dict[str, int]) -> None:
+def publish_engine_stats(st: Dict[str, int], suffix: str = "") -> None:
     """THE one site that writes the ``engine_<key>`` gauge mirror into
     the process-global registry (tests/test_obs.py lints that every
     stats() key has a registered metric and a docs entry, so a new
@@ -634,9 +634,16 @@ def publish_engine_stats(st: Dict[str, int]) -> None:
     engines — the daemon's ``metrics`` handler publishes the sum, so
     the exposition reports process-wide totals (identical to the
     engine's own stats in the common one-engine case) instead of
-    whichever engine happened to publish last."""
+    whichever engine happened to publish last.
+
+    ``suffix`` names a per-replica breakdown gauge set
+    (``engine_<key>_replica<i>``): the daemon's fleet scrape publishes
+    each replica's stats under its suffix NEXT TO the unsuffixed
+    process-wide sum, so one sick replica is visible in a scrape
+    instead of vanishing into the total (the round-13 observability
+    satellite)."""
     for k, v in st.items():
-        _obs_gauge("engine_" + k).set(int(v))
+        _obs_gauge("engine_" + k + suffix).set(int(v))
 
 
 def _bucket(n: int) -> int:
@@ -690,6 +697,14 @@ class _Request:
     rid: int = 0
     tag: str = ""
     resubmits: int = 0          # preemption requeues + supervisor replays
+    # fleet attribution (tpulab/daemon.py router layer): which replicas
+    # this request was placed on (``hops``, deduped consecutive), which
+    # one served its FIRST token, and how many times it migrated to a
+    # healthy peer after a replica failure — a slow request's slow-log
+    # entry then blames the replica, not the fleet
+    hops: List[int] = field(default_factory=list)
+    first_replica: Optional[int] = None
+    migrations: int = 0
     pf_chunks: int = 0          # prefill windows dispatched (incl. draft)
     t_first: float = 0.0        # first drained token (TTFT end)
     t_prefill_done: float = 0.0
@@ -732,6 +747,12 @@ def _span_summary(req: _Request, now: float) -> Dict:
         "prefill_chunks": req.pf_chunks,
         "preemptions": req.preemptions,
         "resubmits": req.resubmits,
+        # fleet attribution: the replica that served the first token,
+        # the placement hop chain, and cross-replica migrations — None/
+        # empty/0 outside a fleet (a bare engine has no replica index)
+        "replica_first_token": req.first_replica,
+        "replica_hops": list(req.hops),
+        "migrations": req.migrations,
         "priority": req.priority,
         "cancelled": bool(req.cancelled),
     }
@@ -993,6 +1014,13 @@ class PagedEngine:
         # once here so the hot paths never branch on the flag for spans
         self.obs = bool(obs)
         self._trace = _obs_tracer.TRACER if self.obs else _obs_tracer.NULL
+        # fleet identity (set by the daemon's router layer, None for a
+        # bare engine): ``replica_index`` stamps requests' slow-log
+        # replica attribution; ``fault_scope`` scopes this engine's
+        # fault-injection sites (``paged.step@replica<i>``) so chaos
+        # schedules can target ONE replica out of N identical engines
+        self.replica_index: Optional[int] = None
+        self.fault_scope: Optional[str] = None
 
     def _init_dev_state(self):
         # DEVICE-allocated (jnp.zeros/ones, never jnp.asarray of a
@@ -1153,6 +1181,8 @@ class PagedEngine:
         # share the id; allocated here otherwise.
         req.rid = int(rid) if rid is not None else _obs_tracer.next_rid()
         req.tag = str(tag)
+        if self.replica_index is not None:
+            req.hops.append(self.replica_index)
         if self.obs:
             self._trace.event("engine.submit", req.rid)
         self.pending.append(req)
@@ -1550,6 +1580,7 @@ class PagedEngine:
                 # overlap=1 it includes the one-tick drain delay, which
                 # is exactly what a streaming client experiences
                 req.t_first = now
+                req.first_replica = self.replica_index
                 _H_TTFT.observe(now - req.t_submit)
                 self._trace.event("engine.first_token", req.rid)
             elif req.t_last:
@@ -1632,7 +1663,7 @@ class PagedEngine:
         self._push_slot(s, False)
 
     # ---------------------------------------------------- resume / preempt
-    def resubmit(self, req: _Request) -> int:
+    def resubmit(self, req: _Request, fresh_id: bool = False) -> int:
         """Requeue a request from its snapshot so decode RESUMES where
         it left off — the one mechanism behind both KV-pressure
         preemption (this engine releases the slot, re-admits later) and
@@ -1652,9 +1683,13 @@ class PagedEngine:
         tokens is ``len(out)`` splits from the seed — the resumed slot
         re-seeds there and continues the original draw sequence.
 
-        ``req.req_id`` is preserved (waiters keep their handle across a
-        supervisor replay); the id counter advances past it so later
-        submissions can never collide."""
+        ``req.req_id`` is preserved by default (waiters keep their
+        handle across a supervisor replay); the id counter advances
+        past it so later submissions can never collide.
+        ``fresh_id=True`` instead re-ids the request from THIS engine's
+        counter — required when migrating onto a healthy PEER engine
+        (tpulab/daemon.py fleet router), whose id space is independent
+        of the failed engine's and may already hold the old id."""
         if req.cancelled:
             # the consumer is gone (or already satisfied): there is
             # nobody to resume FOR — callers complete or drop instead
@@ -1672,8 +1707,13 @@ class PagedEngine:
         req.phase = "decode"
         req.pf_pos = req.pf_end = req.d_pf_pos = 0
         req.resubmits += 1
+        if self.replica_index is not None and (
+                not req.hops or req.hops[-1] != self.replica_index):
+            req.hops.append(self.replica_index)
         if self.obs:
             self._trace.event("engine.resubmit", req.rid)
+        if fresh_id:
+            req.req_id = self._next_id
         self._next_id = max(self._next_id, req.req_id + 1)
         self.pending.append(req)
         return req.req_id
@@ -1774,7 +1814,7 @@ class PagedEngine:
         toks, snap = self._inflight.pop(0)
         nxt = np.asarray(jax.device_get(toks))
         if _faults.ACTIVE:
-            rule = _faults.fire("paged.drain")
+            rule = _faults.fire("paged.drain", self.fault_scope)
             if rule is not None and rule.kind == "nan_tokens":
                 # the NaN-logits signature: sampling over non-finite
                 # logits cannot be trusted, so the injector substitutes
@@ -1826,7 +1866,7 @@ class PagedEngine:
         blocks held by a request finishing inside the window."""
         finished: List[int] = []
         if _faults.ACTIVE:
-            rule = _faults.fire("paged.step")
+            rule = _faults.fire("paged.step", self.fault_scope)
             if rule is not None and rule.kind == "corrupt_table":
                 # damage the first occupied slot's host table — the
                 # release-time integrity tripwire must catch it before
@@ -1912,7 +1952,8 @@ class PagedEngine:
                 snap = [r if (r is not None and r.phase == "decode")
                         else None for r in self.active]
                 if _faults.ACTIVE:
-                    _faults.fire("paged.tick")  # dispatch-exception site
+                    # dispatch-exception site (scoped per fleet replica)
+                    _faults.fire("paged.tick", self.fault_scope)
                 toks, self._dev, self.kpool, self.vpool = paged_tick(
                     self.params, self._dev, self.kpool, self.vpool,
                     self.cfg, self.block_size, self.attn,

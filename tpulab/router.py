@@ -1,0 +1,171 @@
+"""Fleet routing policy: replica health tracking + placement scoring.
+
+The daemon's fleet layer (``tpulab/daemon.py``, ``--replicas N``) keeps
+N identical ``PagedEngine`` replicas warm per serving config.  This
+module is the POLICY half of that layer — pure stdlib, no jax, no
+threads — so the decisions the router makes are unit-testable without
+building an engine:
+
+* :class:`ReplicaHealth` — the per-replica health state machine
+
+      HEALTHY -> SUSPECT -> (crash) QUARANTINED -> REBUILDING -> HEALTHY
+
+  fed from signals the serving stack already produces: stepper tick
+  durations (a wedged replica's ticks stretch — the ``slow_ms`` chaos
+  signature), stall ticks from ``engine.stats()``, and step-loop
+  crashes (dispatch exceptions and ``EngineIntegrityError`` tripwires
+  both surface as a crash).  SUSPECT only *deprioritizes* a replica in
+  placement (it still serves — a compile pause must not brown-out the
+  fleet); QUARANTINED/REBUILDING exclude it entirely until the rebuild
+  swaps a fresh engine in.
+
+* :func:`choose_replica` — placement scoring over
+  :class:`ReplicaView` snapshots: prefer non-SUSPECT replicas, then
+  the best ``affinity_weight * prefix_affinity - load`` score
+  (prefix-affinity = shared prompt-prefix blocks already resident in
+  that replica's cache — sending the request there dedups the prefill
+  the fleet already paid), ties broken least-loaded then lowest index.
+
+The daemon gathers the views under its own locks and applies the
+returned decision; DRAINING is daemon-side state (an operator drain is
+not a health observation) and arrives here as ``placeable=False``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Sequence
+
+#: health states (string-valued so they serialize straight into the
+#: daemon's ``fleet`` JSON response and the obs_report table)
+HEALTHY = "healthy"
+SUSPECT = "suspect"
+QUARANTINED = "quarantined"
+REBUILDING = "rebuilding"
+
+#: a stepper tick at or above this duration counts as SLOW — sized for
+#: the chaos tier's wedge signature (``slow_ms`` >= 100ms on a
+#: millisecond-tick CPU engine) while staying far above a healthy tick
+DEFAULT_SLOW_TICK_S = 0.25
+
+
+class ReplicaHealth:
+    """Per-replica health state machine.
+
+    Not thread-safe by design: the daemon guards every transition with
+    its fleet condition (one lock, one writer discipline), and tests
+    drive it single-threaded.
+
+    ``suspect_after`` consecutive slow/stalled ticks demote HEALTHY ->
+    SUSPECT; ``recover_after`` consecutive clean ticks promote SUSPECT
+    -> HEALTHY (hysteresis: one fast tick inside a wedge must not
+    flap the replica back into preferred placement).  A crash goes
+    straight to QUARANTINED regardless of state; only the rebuild
+    lifecycle (:meth:`note_rebuild_start` / :meth:`note_rebuilt`)
+    leaves it."""
+
+    def __init__(self, slow_tick_s: float = DEFAULT_SLOW_TICK_S,
+                 suspect_after: int = 3, recover_after: int = 8):
+        if slow_tick_s <= 0:
+            raise ValueError(f"slow_tick_s must be > 0, got {slow_tick_s}")
+        if suspect_after < 1 or recover_after < 1:
+            raise ValueError("suspect_after and recover_after must be >= 1")
+        self.slow_tick_s = float(slow_tick_s)
+        self.suspect_after = int(suspect_after)
+        self.recover_after = int(recover_after)
+        self.state = HEALTHY
+        self._slow = 0
+        self._fast = 0
+        #: lifetime transition counts (the ``fleet`` response surfaces
+        #: them so an operator can see a replica flapping)
+        self.suspects = 0
+        self.crashes = 0
+
+    @property
+    def placeable(self) -> bool:
+        """Whether placement may target this replica at all (SUSPECT
+        still serves — just deprioritized)."""
+        return self.state in (HEALTHY, SUSPECT)
+
+    def note_tick(self, dt_s: float, stalled: bool = False) -> None:
+        """One stepper tick took ``dt_s`` seconds; ``stalled`` marks a
+        tick whose stats counted stall work (a decode slot starved) —
+        both count as slow evidence.  Ignored outside HEALTHY/SUSPECT
+        (a quarantined replica's trailing ticks prove nothing)."""
+        if self.state not in (HEALTHY, SUSPECT):
+            return
+        if stalled or dt_s >= self.slow_tick_s:
+            self._slow += 1
+            self._fast = 0
+            if self.state == HEALTHY and self._slow >= self.suspect_after:
+                self.state = SUSPECT
+                self.suspects += 1
+        else:
+            self._fast += 1
+            self._slow = 0
+            if self.state == SUSPECT and self._fast >= self.recover_after:
+                self.state = HEALTHY
+
+    def note_crash(self) -> None:
+        """The replica's step loop died (dispatch exception or an
+        integrity tripwire): QUARANTINED until rebuilt."""
+        self.state = QUARANTINED
+        self.crashes += 1
+        self._slow = self._fast = 0
+
+    def note_rebuild_start(self) -> None:
+        self.state = REBUILDING
+
+    def note_rebuild_failed(self) -> None:
+        """The rebuild itself raised: back to QUARANTINED (the daemon
+        may retry on the next failure-driven rebuild request)."""
+        self.state = QUARANTINED
+
+    def note_rebuilt(self) -> None:
+        """A fresh engine was swapped in: fully healthy, counters
+        reset (the new engine has produced no evidence yet)."""
+        self.state = HEALTHY
+        self._slow = self._fast = 0
+
+    def snapshot(self) -> dict:
+        return {"state": self.state, "suspects": self.suspects,
+                "crashes": self.crashes}
+
+
+@dataclass(frozen=True)
+class ReplicaView:
+    """One replica's placement-relevant state, snapshotted by the
+    daemon under its locks: ``load`` = queued + active requests,
+    ``affinity`` = shared prompt-prefix blocks already resident in the
+    replica's prefix cache.  ``placeable=False`` covers QUARANTINED /
+    REBUILDING health AND operator drain."""
+
+    index: int
+    placeable: bool
+    suspect: bool
+    load: int
+    affinity: int = 0
+
+
+def choose_replica(views: Sequence[ReplicaView],
+                   affinity_weight: float = 2.0) -> Optional[int]:
+    """Pick the replica index to place a request on, or None when no
+    view is placeable (the caller parks or rejects).
+
+    Policy: non-SUSPECT strictly preferred over SUSPECT (a wedged
+    replica takes traffic only when every healthy one is unplaceable);
+    within a tier, maximize ``affinity_weight * affinity - load``
+    (prefix-affinity measured in blocks, load in requests — the weight
+    says one resident shared block is worth eating two queued
+    requests' wait); ties break least-loaded, then lowest index
+    (deterministic for tests and for an idle fleet)."""
+    best = None
+    best_key = None
+    for v in views:
+        if not v.placeable:
+            continue
+        key = (v.suspect, -(affinity_weight * v.affinity - v.load),
+               v.load, v.index)
+        if best_key is None or key < best_key:
+            best, best_key = v.index, key
+    return best

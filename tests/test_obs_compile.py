@@ -1,0 +1,660 @@
+"""Compiler & device observability (round 14).
+
+Covers the round-14 ISSUE checklist:
+
+  * the compile ledger (tpulab.obs.compilestats): per-program compile
+    counts / compile-seconds via the executable-cache delta,
+    cost_analysis snapshots at first compile, thread-filtered event
+    bracketing, memory_analysis under the opt-in flag;
+  * the RECOMPILE TRIPWIRE, proven BOTH WAYS (the acceptance pair): a
+    steady-state decode window with spec + interleave + overlap ON
+    records ZERO recompiles under strict(), and a deliberately
+    bucket-busting prompt mix records a nonzero ``engine_recompiles``
+    (and raises under strict at the offending tick);
+  * MFU/roofline (tpulab.obs.roofline): the shared analytic-FLOPs
+    implementation (tpulab.bench and tools/train_mfu_probe re-import
+    it), compute- vs bandwidth-bound classification against the
+    generation peaks, the engine_mfu/train_mfu gauges, and the
+    CPU-proxy caveat (0 / "unknown", never a fabricated number);
+  * HBM/KV occupancy: blocks used/free arithmetic, pool bytes, prefix
+    cache bytes, the device-memory gauges' estimate fallback, and the
+    per-program compile-bucket census gauges (census warn-once
+    preserved — tests/test_paged_interleave.py keeps that assert);
+  * the crash flight recorder (tpulab.obs.flightrec), exercised END TO
+    END on the chaos path: an injected ``paged.step`` crash produces a
+    bundle whose trace slice contains the failing request's rid-linked
+    events and whose compile-stats table matches the live scrape, with
+    zero leaked blocks after the supervised replay;
+  * runtime/device info paths (device_info / ici_topology /
+    generation_limits) on the CPU backend — they feed the roofline
+    peak lookup and were previously untested;
+  * the daemon's ``compile_stats``/``postmortem`` requests and
+    tools/obs_report.py's ``--roofline``/``--postmortem`` renderers;
+  * standing contracts re-certified with the new instrumentation ON:
+    the transfer-guard flat-h2d steady window runs INSIDE strict()
+    (obs on/off bit-equality and the obs_overhead <3% budget keep
+    their existing certifications in tests/test_obs.py, which now run
+    with the compile wrappers active).
+"""
+
+import importlib.util
+import json
+import pathlib
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpulab import faults, obs
+from tpulab.models.generate import generate
+from tpulab.models.labformer import LabformerConfig
+from tpulab.models.paged import PagedEngine
+from tpulab.obs import compilestats as cstats
+from tpulab.obs import flightrec, roofline
+from tpulab.obs.compilestats import COMPILESTATS, CompileStats, RecompileError
+
+CFG = LabformerConfig(d_model=32, n_heads=4, n_layers=2, d_ff=64, max_seq=128)
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+#: explicit TPU-shaped peaks for gauge/classification tests (the CPU
+#: proxy has none by design)
+PEAKS = {"device_kind": "test", "peak_tflops": 100.0, "peak_gbps": 1000.0}
+
+
+@pytest.fixture(scope="module")
+def trained(trained_small, trained_small_cfg):
+    assert CFG == trained_small_cfg  # shared-model drift fails loudly
+    return trained_small
+
+
+@pytest.fixture(autouse=True)
+def _injector_always_reset():
+    yield
+    faults.disable()
+
+
+def _cycle_prompt(p):
+    return (np.arange(p) % 7).astype(np.int32)
+
+
+# ------------------------------------------------------- compile ledger
+def test_instrument_counts_compiles_and_snapshots_cost():
+    cs = CompileStats()
+    fn = cs.instrument("probe", jax.jit(lambda x: x * 2 + 1))
+    c0 = cs.seq()
+    fn(jnp.ones((4,)))               # compile 1
+    fn(jnp.ones((4,)))               # cache hit
+    snap = cs.snapshot()["probe"]
+    assert snap["compiles"] == 1
+    assert snap["compile_seconds"] > 0
+    # first-compile cost_analysis snapshot: FLOPs + bytes from the
+    # lowered module, no second backend compile needed
+    assert snap["flops"] and snap["flops"] > 0
+    assert snap["bytes_accessed"] and snap["bytes_accessed"] > 0
+    fn(jnp.ones((8,)))               # new shape -> compile 2
+    assert cs.snapshot()["probe"]["compiles"] == 2
+    assert cs.names_since(c0) == ["probe", "probe"]
+    assert cs.seq() == c0 + 2
+    assert cs.total_compiles() == 2
+    assert cs.total_compile_seconds() > 0
+    # analytic model-FLOPs registration rides the same ledger
+    cs.set_model_flops("probe", 123.0)
+    assert cs.model_flops("probe") == 123.0
+    assert cs.model_flops("never-registered") is None
+
+
+def test_instrument_forwards_attrs_and_reregisters_into_one_row():
+    cs = CompileStats()
+    base = jax.jit(lambda x: x + 1)
+    fn = cs.instrument("twice", base)
+    fn2 = cs.instrument("twice", jax.jit(lambda x: x + 2))
+    fn(jnp.ones(3))
+    fn2(jnp.ones(3))
+    assert cs.snapshot()["twice"]["compiles"] == 2  # ONE accumulated row
+    # attribute proxying: the wrapper is call-transparent
+    assert fn.__wrapped__ is base
+    assert callable(fn.lower)
+
+
+def test_reset_does_not_orphan_wrappers():
+    """reset() must not blind the ledger: wrappers resolve their row
+    BY NAME per compile, so a post-reset compile re-creates the row
+    (the review finding: a cached ProgramStats object survived reset
+    and swallowed every later compile)."""
+    cs = CompileStats()
+    fn = cs.instrument("reborn", jax.jit(lambda x: x * 3))
+    fn(jnp.ones((2,)))
+    assert cs.snapshot()["reborn"]["compiles"] == 1
+    cs.reset()
+    assert cs.snapshot() == {} and cs.seq() == 0
+    fn(jnp.ones((3,)))                     # fresh shape -> fresh compile
+    assert cs.snapshot()["reborn"]["compiles"] == 1
+    assert cs.names_since(0) == ["reborn"]
+
+
+def test_names_since_filters_by_thread():
+    """A compile triggered on ANOTHER thread (a peer replica's warmup)
+    must not appear in this thread's bracket — the property that stops
+    fleet warmup from tripping a steady engine's wire."""
+    cs = CompileStats()
+    fn = cs.instrument("other-thread", jax.jit(lambda x: x - 1))
+    c0 = cs.seq()
+    t = threading.Thread(target=lambda: fn(jnp.ones((5,))))
+    t.start()
+    t.join()
+    assert cs.seq() == c0 + 1
+    assert cs.names_since(c0) == []          # not OUR thread's compile
+    assert cs.names_since(c0, thread_id=t.ident) == ["other-thread"]
+
+
+def test_strict_raises_and_production_counts():
+    cs = CompileStats()
+    cs.note_steady_recompile(["paged_tick"])          # production: count
+    assert cs.steady_recompiles == 1
+    cs.strict = True
+    with pytest.raises(RecompileError, match="paged_tick"):
+        cs.note_steady_recompile(["paged_tick"])
+    assert cs.steady_recompiles == 2                  # counted BEFORE raise
+    # the module-level context manager arms/restores the global ledger
+    assert not COMPILESTATS.strict
+    with cstats.strict():
+        assert COMPILESTATS.strict
+    assert not COMPILESTATS.strict
+
+
+def test_memory_analysis_capture_opt_in(monkeypatch):
+    """TPULAB_COMPILESTATS_MEMORY=1 additionally snapshots
+    memory_analysis (arg/output/temp bytes) at first compile — works on
+    the CPU backend, costs one extra backend compile, off by default."""
+    monkeypatch.setattr(cstats, "CAPTURE_MEMORY", True)
+    cs = CompileStats()
+    fn = cs.instrument("mem", jax.jit(lambda x: x @ x.T))
+    fn(jnp.ones((4, 4)))
+    mem = cs.snapshot()["mem"]["memory"]
+    assert mem is not None
+    assert mem["argument_size_in_bytes"] > 0
+    assert "temp_size_in_bytes" in mem and "output_size_in_bytes" in mem
+
+
+# ------------------------------------- recompile tripwire (acceptance)
+def test_steady_decode_window_zero_recompiles(trained):
+    """Acceptance, direction 1: a steady-state decode window with
+    speculative verify + interleaved chunked prefill + the async
+    overlap window all ON records ZERO recompiles — asserted the hard
+    way, with strict() armed so any compile raises at the tick."""
+    eng = PagedEngine(trained, CFG, slots=4, n_blocks=32, block_size=8,
+                      max_seq=64, prefill_chunk=8, interleave=True,
+                      overlap=1, spec_k=2)
+    for i in range(4):
+        # budget outlasts warm + window even at spec_k+1 tokens/tick
+        eng.submit(_cycle_prompt(4 + i), max_new=56,
+                   spec="lookup" if i % 2 == 0 else "off")
+    for _ in range(12):   # admission + every program compile
+        eng.step()
+    assert eng._steady, "engine never reached the steady state"
+    r0 = eng.counters["recompiles"]
+    with cstats.strict():
+        for _ in range(16):
+            eng.step()
+    assert eng.counters["recompiles"] == r0 == 0
+    assert eng.stats()["recompiles"] == 0
+
+
+def test_bucket_busting_mix_records_nonzero_recompiles(trained):
+    """Acceptance, direction 2: an unchunked engine gone steady on
+    short prompts is hit with a prompt from an UNSEEN dense bucket —
+    the fresh prefill compile lands inside a steady step, increments
+    ``engine_recompiles``, and raises under strict() at that tick.
+    Unique pool geometry (block_size=4) guarantees the compile is
+    genuinely fresh regardless of what earlier tests compiled."""
+    def mk():
+        return PagedEngine(trained, CFG, slots=3, n_blocks=48,
+                           block_size=4, max_seq=64, prefill_chunk=0,
+                           interleave=True)
+
+    eng = mk()
+    eng.submit(_cycle_prompt(4), max_new=40)
+    for _ in range(8):
+        eng.step()
+    assert eng._steady
+    assert eng.counters["recompiles"] == 0
+    eng.submit(_cycle_prompt(34), max_new=4)    # dense bucket 64, unseen
+    with pytest.raises(RecompileError):
+        with cstats.strict():
+            for _ in range(30):
+                eng.step()
+    assert eng.counters["recompiles"] > 0
+    st = eng.stats()
+    assert st["recompiles"] > 0
+    assert st["compile_buckets_dense"] >= 2     # the census saw both
+    # production mode (no strict): the same mix only counts — the wave
+    # completes and the counter reaches the scrape
+    eng2 = mk()
+    eng2.submit(_cycle_prompt(4), max_new=40)
+    for _ in range(8):
+        eng2.step()
+    assert eng2._steady
+    eng2.submit(_cycle_prompt(30), max_new=4)   # bucket 32, fresh for bs=4
+    eng2.run()
+    assert eng2.stats()["recompiles"] > 0
+    row = eng2.publish_metrics()
+    assert obs.REGISTRY.get("engine_recompiles").value == row["recompiles"]
+
+
+def test_steady_window_transfer_guard_inside_strict(trained):
+    """Standing contract: the tripwire accounting itself is host-only —
+    a steady window under jax.transfer_guard('disallow') AND strict()
+    moves nothing and compiles nothing, h2d_ticks/host_syncs flat."""
+    eng = PagedEngine(trained, CFG, slots=2, n_blocks=32, block_size=8,
+                      max_seq=64)
+    eng.submit(_cycle_prompt(4), max_new=30)
+    eng.submit(_cycle_prompt(5), max_new=30, temperature=1.1, seed=5)
+    for _ in range(4):
+        eng.step()
+    before = eng.stats()
+    with cstats.strict():
+        with jax.transfer_guard("disallow"):
+            for _ in range(8):
+                eng.step()
+    st = eng.stats()
+    assert st["ticks"] == before["ticks"] + 8
+    assert st["h2d_ticks"] == before["h2d_ticks"]
+    assert st["host_syncs"] == before["host_syncs"]
+    assert st["recompiles"] == 0
+
+
+# ------------------------------------------------------- MFU / roofline
+def test_flops_math_is_shared_single_copy():
+    import tpulab.bench as bench
+
+    assert bench.labformer_fwd_flops is roofline.labformer_fwd_flops
+    assert bench._mfu_fields is roofline.mfu_fields
+    # per-token decode FLOPs == the fwd per-token matmul term
+    class _Cfg:
+        d_model, d_ff, n_layers, vocab = 8, 16, 2, 10
+    per_tok = roofline.per_token_flops(_Cfg)
+    assert per_tok == 2 * 2 * (4 * 64 + 2 * 8 * 16) + 2 * 8 * 10
+    # fwd(b=1, s=1, causal=False) = per_tok + the s^2 attention term
+    assert (roofline.labformer_fwd_flops(_Cfg, 1, 1, causal=False)
+            == per_tok + 2 * 4 * 8 // 2 * 2)  # n_layers*4*1*1*d
+
+
+def test_mfu_pct_and_cpu_caveat():
+    assert roofline.mfu_pct(50e12, 1.0, PEAKS) == pytest.approx(50.0)
+    assert roofline.mfu_pct(50e12, 1.0, {"peak_tflops": None}) == 0.0
+    # the attached device is the CPU proxy: no peak, never a number
+    assert roofline.device_peaks()["peak_tflops"] is None
+    assert roofline.device_peaks()["peak_gbps"] is None
+    assert roofline.device_peaks(device_kind="TPU v4")["peak_gbps"] == 1228
+
+
+def test_roofline_classification():
+    # intensity 200 F/B vs ridge 100 -> compute-bound at full peak
+    c = roofline.classify(2e12, 1e10, PEAKS)
+    assert c["bound"] == "compute-bound"
+    assert c["ceiling_tflops"] == PEAKS["peak_tflops"]
+    assert c["ridge_flops_per_byte"] == pytest.approx(100.0)
+    # intensity 2 F/B -> bandwidth-bound, ceiling = intensity * bw
+    c = roofline.classify(2e10, 1e10, PEAKS)
+    assert c["bound"] == "bandwidth-bound"
+    assert c["ceiling_tflops"] == pytest.approx(2e10 / 1e10 * 1000 / 1e3)
+    # no peaks (CPU proxy): says so instead of fabricating
+    assert "unknown" in roofline.classify(2e10, 1e10, {})["bound"]
+    assert roofline.classify(None, 1e10, PEAKS)["bound"] == "unknown"
+
+
+def test_roofline_rows_from_snapshot():
+    rows = roofline.roofline_rows(
+        {"p1": {"compiles": 2, "compile_seconds": 1.5, "flops": 2e12,
+                "bytes_accessed": 1e10, "model_flops": None}},
+        PEAKS)
+    assert rows[0]["program"] == "p1"
+    assert rows[0]["bound"] == "compute-bound"
+    assert rows[0]["compiles"] == 2
+
+
+def test_engine_mfu_gauge_from_itl_and_registered_flops(trained):
+    """A served wave populates itl_seconds and registers the engine's
+    per-tick analytic FLOPs; with explicit TPU-shaped peaks the gauge
+    computes, and with the real (CPU) peaks it publishes 0 — the
+    documented caveat."""
+    eng = PagedEngine(trained, CFG, slots=2, n_blocks=32, block_size=8,
+                      max_seq=64)
+    eng.submit(_cycle_prompt(4), max_new=8)
+    eng.run()
+    assert (COMPILESTATS.model_flops("paged_tick")
+            == 2 * roofline.per_token_flops(CFG))
+    got = roofline.update_mfu_gauges(PEAKS)
+    assert got["engine_mfu"] > 0
+    assert obs.REGISTRY.get("engine_mfu").value == got["engine_mfu"]
+    assert roofline.update_mfu_gauges()["engine_mfu"] == 0.0  # CPU proxy
+
+
+def test_train_mfu_accumulates_windows():
+    roofline.note_train_window(5e12, 1.0)
+    got = roofline.update_mfu_gauges(PEAKS)
+    assert got["train_mfu"] > 0
+    assert obs.REGISTRY.get("train_mfu").value == got["train_mfu"]
+
+
+# ------------------------------------------------- HBM / KV occupancy
+def test_capacity_stats_and_memory_gauges(trained):
+    from tpulab.models.paged import _pool_nbytes
+
+    eng = PagedEngine(trained, CFG, slots=2, n_blocks=32, block_size=8,
+                      max_seq=64)
+    st0 = eng.stats()
+    assert st0["blocks_used"] == 0
+    assert st0["blocks_used"] + st0["blocks_free"] == st0["blocks_total"]
+    assert st0["kv_pool_bytes"] == (_pool_nbytes(eng.kpool)
+                                    + _pool_nbytes(eng.vpool))
+    assert st0["cache_bytes"] == 0
+    # a prompt long enough to register a block-aligned prefix
+    eng.submit(_cycle_prompt(17), max_new=4)
+    eng.run()
+    st = eng.stats()
+    assert st["cache_entries"] == 1 and st["cache_bytes"] > 0
+    assert st["cache_bytes"] % (st["kv_pool_bytes"] // 32) == 0
+    assert st["blocks_used"] + st["blocks_free"] == st["blocks_total"]
+    # device estimate covers pools + params + per-slot state
+    est = eng.device_bytes_estimate()
+    assert est > st["kv_pool_bytes"]
+    assert eng.device_bytes_estimate() == est  # cached
+    # the scrape-path gauges: CPU backend has no memory_stats -> the
+    # in-use gauge falls back to the estimate, limit publishes 0
+    got = roofline.update_device_memory_gauges(est)
+    assert got["engine_hbm_bytes_in_use"] == est
+    assert obs.REGISTRY.get("engine_hbm_bytes_in_use").value == est
+    assert obs.REGISTRY.get("engine_hbm_bytes_limit").value == 0
+
+
+def test_int8_pool_bytes_include_scales(trained):
+    eng = PagedEngine(trained, CFG, slots=2, n_blocks=16, block_size=8,
+                      max_seq=64, kv_dtype="int8")
+    data, scale = eng.kpool
+    assert (eng.stats()["kv_pool_bytes"]
+            == 2 * (data.nbytes + scale.nbytes))
+
+
+def test_compile_bucket_census_per_program(trained):
+    """The promoted census gauges: dense whole-prompt buckets and
+    chunk-0 whole-tail extend buckets count separately per program
+    (the warn-once over the union is asserted where it always was,
+    tests/test_paged_interleave.py)."""
+    eng = PagedEngine(trained, CFG, slots=2, n_blocks=32, block_size=8,
+                      max_seq=64, prefill_chunk=0)
+    eng.submit(_cycle_prompt(5), max_new=2)    # dense bucket 16
+    eng.run()
+    eng.submit(_cycle_prompt(20), max_new=2)   # dense bucket 32
+    eng.run()
+    st = eng.stats()
+    assert st["compile_buckets_dense"] == 2
+    assert st["compile_buckets_extend"] == 0
+    # a prefix-hit admission on the unchunked engine runs the chunk-0
+    # whole-tail extend window -> the EXTEND census counts it
+    eng.submit(_cycle_prompt(20), max_new=2)   # shares the cached prefix
+    eng.run()
+    st = eng.stats()
+    assert st["prefix_hits"] >= 1
+    assert st["compile_buckets_extend"] >= 1
+    assert st["compile_buckets_dense"] == 2
+
+
+# ------------------------------------------------- device info (CPU)
+def test_generation_limits_lookup_and_bandwidth():
+    from tpulab.runtime.device import generation_limits
+
+    v4 = generation_limits("TPU v4")
+    assert v4["bf16_peak_tflops_per_chip"] == 275
+    assert v4["hbm_gbps_per_chip"] == 1228
+    # substring matching, case-insensitive, against real kind strings
+    assert generation_limits("TPU v5 lite chip")["hbm_gbps_per_chip"] == 819
+    assert generation_limits("tpu v5e")["bf16_peak_tflops_per_chip"] == 197
+    assert generation_limits("Intel Xeon") == {}
+    assert generation_limits("") == {}
+    # mutating the returned dict must not poison the table
+    v4["bf16_peak_tflops_per_chip"] = -1
+    assert generation_limits("TPU v4")["bf16_peak_tflops_per_chip"] == 275
+
+
+def test_device_info_cpu_backend():
+    from tpulab.runtime.device import (device_info, format_device_info,
+                                       ici_topology)
+
+    info = device_info()
+    assert info["platform"] == "cpu"
+    assert info["num_devices"] == jax.device_count()
+    assert info["num_local_devices"] == jax.local_device_count()
+    assert info["num_processes"] == 1 and info["process_index"] == 0
+    assert "id" in info and "device_kind" in info
+    # CPU has no generation-limit or memory_stats fields
+    assert "bf16_peak_tflops_per_chip" not in info
+    topo = ici_topology()
+    assert topo["num_chips"] == jax.device_count()
+    assert info["ici_num_chips"] == topo["num_chips"]
+    text = format_device_info()
+    assert "platform: cpu" in text
+    assert len(text.splitlines()) == len(info)
+
+
+def test_resolve_and_commit_paths():
+    from tpulab.runtime.device import (backend_name, cpu_device,
+                                       resolve_device)
+
+    assert backend_name() == "cpu"
+    assert resolve_device(None).platform == "cpu"
+    assert resolve_device("auto") is resolve_device("default")
+    assert resolve_device("cpu") == jax.devices("cpu")[0]
+    assert cpu_device() is cpu_device()  # cached
+
+
+# ------------------------------------------------- flight recorder
+def test_flightrec_roundtrip_and_retention(tmp_path):
+    flightrec.configure_flightrec(tmp_path)
+    try:
+        p = flightrec.record_postmortem(
+            "unit", err=ValueError("boom"), extra={"k": (1, 2)})
+        assert p is not None and p.is_file()
+        bundle = json.loads(p.read_text())
+        assert bundle["schema"] == 1 and bundle["reason"] == "unit"
+        assert bundle["error"] == {"type": "ValueError",
+                                   "message": "boom"}
+        assert bundle["extra"] == {"k": [1, 2]}
+        assert "metrics" in bundle and "compile_stats" in bundle
+        assert bundle["faults"]["enabled"] is False
+        latest = flightrec.latest_postmortem()
+        assert latest["path"] == str(p)
+        # bounded retention: KEEP newest survive, oldest deleted
+        for i in range(flightrec.KEEP + 3):
+            flightrec.record_postmortem(f"r{i}")
+        assert len(flightrec.list_bundles()) == flightrec.KEEP
+        assert flightrec.latest_postmortem()["reason"] == (
+            f"r{flightrec.KEEP + 2}")
+        # a corrupt newest bundle is skipped, not fatal
+        flightrec.list_bundles()[0].write_text("{corrupt")
+        assert flightrec.latest_postmortem()["reason"] == (
+            f"r{flightrec.KEEP + 1}")
+    finally:
+        flightrec.configure_flightrec(None)
+
+
+def _no_leaks(eng):
+    cache_blocks = {b for blocks in eng.prefix_cache.values()
+                    for b in blocks}
+    assert len(eng.free) + len(cache_blocks) == eng.n_usable_blocks
+    assert len(set(eng.free)) == len(eng.free)
+    assert all(eng.block_refs[b] == 0 for b in eng.free)
+
+
+def test_flight_recorder_end_to_end_on_chaos_path(trained, tmp_path):
+    """Acceptance: an injected ``paged.step`` crash rides the PR-6
+    supervisor, and the bundle it leaves behind is self-explaining —
+    the failing request's rid-linked trace events are in the slice,
+    the compile-stats table matches the live scrape, the armed fault
+    schedule is recorded, and the replayed wave completes with zero
+    leaked blocks."""
+    from tpulab.daemon import _GenerateService, _handle_compile_stats
+
+    flightrec.configure_flightrec(tmp_path)
+    prior = obs.TRACER.capacity
+    try:
+        obs.configure_tracer(1 << 12)  # fresh, private trace window
+        svc = _GenerateService()
+
+        def mk():
+            e = PagedEngine(trained, CFG, slots=2, n_blocks=32,
+                            block_size=8, max_seq=64)
+            e._rebuild = lambda: (mk(), None)
+            e._build_stamp = "test-stamp"
+            return e
+
+        eng = mk()
+        pm0 = obs.REGISTRY.get("daemon_postmortems").value
+        rid_lo = obs.next_rid()
+        with faults.active([{"site": "paged.step", "kind": "raise",
+                             "at": 4}]):
+            out = svc.generate(eng, _cycle_prompt(4), 12)
+            # read fired() INSIDE the context: disable() clears rules
+            assert faults.INJECTOR.fired() == {"paged.step": 1}
+        rid_hi = obs.next_rid()
+        # the replayed stream is bit-identical to a fault-free run
+        want = generate(trained, _cycle_prompt(4)[None, :], CFG, steps=12,
+                        temperature=0.0)[0]
+        assert np.array_equal(out, want)
+        assert obs.REGISTRY.get("daemon_postmortems").value == pm0 + 1
+        bundle = flightrec.latest_postmortem()
+        assert bundle["reason"] == "engine_quarantine"
+        assert bundle["error"]["type"] == "InjectedFault"
+        assert bundle["engine"]["build_stamp"] == "test-stamp"
+        assert bundle["engine"]["stats"]["ticks"] >= 1
+        # the armed schedule travelled into the bundle
+        sites = [r["site"] for r in bundle["faults"]["rules"]]
+        assert "paged.step" in sites
+        # rid linkage: the failing request's submit AND admit events
+        # (same rid, allocated between our two fenceposts) are in the
+        # trace slice
+        by_name = {}
+        for e in bundle["trace"]["events"]:
+            arg = (e.get("args") or {}).get("arg")
+            if arg is not None and rid_lo < arg < rid_hi:
+                by_name.setdefault(e["name"], set()).add(arg)
+        assert by_name.get("engine.submit"), by_name
+        rid = next(iter(by_name["engine.submit"]))
+        assert rid in by_name.get("engine.admit", set())
+        # compile-stats table matches the live scrape (same program
+        # set; the crash froze counts the scrape can only meet or
+        # exceed — the replay re-uses the already-compiled programs)
+        live = json.loads(_handle_compile_stats({}))["programs"]
+        assert set(bundle["compile_stats"]) == set(live)
+        for name, row in bundle["compile_stats"].items():
+            assert live[name]["compiles"] >= row["compiles"]
+        assert bundle["compile_stats"]["paged_tick"]["compiles"] >= 1
+        # zero leaked blocks on the engine that served the replay
+        _no_leaks(svc._state_for(eng).engine)
+    finally:
+        obs.configure_tracer(prior)
+        flightrec.configure_flightrec(None)
+
+
+# ------------------------------------------- daemon + report surfaces
+def test_daemon_compile_stats_request(trained):
+    from tpulab.daemon import handle_request
+
+    eng = PagedEngine(trained, CFG, slots=1, n_blocks=16, block_size=8,
+                      max_seq=64)
+    eng.submit(_cycle_prompt(4), max_new=2)
+    eng.run()
+    payload = json.loads(handle_request({"lab": "compile_stats"}, b""))
+    assert "paged_tick" in payload["programs"]
+    assert payload["programs"]["paged_tick"]["compiles"] >= 1
+    assert payload["peaks"]["peak_tflops"] is None  # CPU proxy
+    assert set(payload["mfu"]) == {"engine_mfu", "train_mfu"}
+    assert payload["total_compile_seconds"] > 0
+
+
+def test_daemon_postmortem_request(tmp_path):
+    from tpulab.daemon import handle_request
+
+    flightrec.configure_flightrec(tmp_path)
+    try:
+        assert json.loads(handle_request({"lab": "postmortem"}, b"")) == {
+            "bundles": 0}
+        flightrec.record_postmortem("wire-test", err=RuntimeError("x"))
+        got = json.loads(handle_request({"lab": "postmortem"}, b""))
+        assert got["reason"] == "wire-test" and got["bundles"] == 1
+        assert got["path"].startswith(str(tmp_path))
+    finally:
+        flightrec.configure_flightrec(None)
+
+
+def _load_obs_report():
+    spec = importlib.util.spec_from_file_location(
+        "obs_report", ROOT / "tools" / "obs_report.py")
+    rep = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(rep)
+    return rep
+
+
+def test_obs_report_roofline_and_postmortem_renderers():
+    rep = _load_obs_report()
+    payload = {
+        "programs": {"paged_tick": {
+            "compiles": 3, "compile_seconds": 2.25, "flops": 2e12,
+            "bytes_accessed": 1e10, "model_flops": 1e9}},
+        "peaks": PEAKS,
+        "mfu": {"engine_mfu": 12.5, "train_mfu": 0.0},
+        "steady_recompiles": 0, "total_compile_seconds": 2.25,
+    }
+    text = rep.format_roofline(payload)
+    assert "paged_tick" in text and "compute-bound" in text
+    assert "engine=12.5%" in text
+    empty = rep.format_roofline({"programs": {}, "peaks": {}, "mfu": {}})
+    assert "no programs compiled" in empty
+    assert "no post-mortem" in rep.format_postmortem({"bundles": 0})
+    pm = rep.format_postmortem({
+        "reason": "engine_quarantine", "bundles": 2, "path": "/x.json",
+        "error": {"type": "InjectedFault", "message": "boom"},
+        "engine": {"build_key": None, "build_stamp": "s",
+                   "replica_index": 1,
+                   "stats": {"ticks": 9, "recompiles": 0}},
+        "faults": {"rules": [{"site": "paged.step", "kind": "raise",
+                              "at": 4, "fired": 1}]},
+        "compile_stats": {"paged_tick": {"compiles": 2}},
+        "trace": {"events": [1, 2, 3], "dropped": 0},
+        "slowlog": {"worst": [{"rid": 7, "tag": "t", "e2e_ms": 5.0,
+                               "tokens": 3, "resubmits": 1}]},
+    })
+    assert "engine_quarantine" in pm and "InjectedFault" in pm
+    assert "paged.step raise at=4 fired=1" in pm
+    assert "paged_tickx2" in pm and "rid=7" in pm
+
+
+def test_device_tier_gauges_registered_and_documented(trained):
+    """The round-14 lint extension (tests/test_obs.py pattern): the
+    non-stats device-tier gauges and the postmortem counter are
+    registered AND documented — a new gauge cannot silently miss the
+    scrape surface or the docs catalog."""
+    PagedEngine(trained, CFG, slots=1, n_blocks=16, block_size=8,
+                max_seq=64).publish_metrics()
+    import tpulab.daemon  # noqa: F401  (registers daemon_postmortems)
+
+    docs = (ROOT / "docs" / "ARCHITECTURE.md").read_text()
+    for name in ("engine_mfu", "train_mfu", "engine_hbm_bytes_in_use",
+                 "engine_hbm_bytes_limit", "daemon_postmortems"):
+        assert obs.REGISTRY.get(name) is not None, name
+        assert name in docs, f"{name} missing from docs/ARCHITECTURE.md"
+
+
+def test_bench_registry_has_decode_recompiles():
+    import inspect
+
+    from tpulab.bench import bench_decode_recompiles, run_benchmarks
+
+    src = inspect.getsource(run_benchmarks)
+    assert "decode_recompiles" in src
+    row = bench_decode_recompiles(slots=2, steps=12, spec_k=2)
+    assert row["metric"] == "decode_steady_recompiles"
+    assert row["value"] == 0, row

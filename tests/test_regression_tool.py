@@ -45,6 +45,21 @@ def test_regression_fails_both_directions(tmp_path):
     assert proc.stdout.count("[regressed]") == 2
 
 
+def test_zero_baseline_matches_and_regresses(tmp_path):
+    """Round-14 fix: a ref == 0 baseline (decode_steady_recompiles,
+    expected 0) must pass when the measurement is also 0 — the old
+    unconditional inf ratio reported a perfect 0-vs-0 match as
+    regressed — and any positive value must still fail the gate."""
+    base = {"zero_count": {"value": 0, "tol_rel": 0.0,
+                           "direction": "lower", "measured": "r14"}}
+    proc, _ = _run(tmp_path, [{"metric": "zero_count", "value": 0}], base)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "[ok] zero_count" in proc.stdout
+    proc, _ = _run(tmp_path, [{"metric": "zero_count", "value": 1}], base)
+    assert proc.returncode == 1
+    assert "[regressed] zero_count" in proc.stdout
+
+
 def test_committed_gate_catches_20pct_tokens_regression(tmp_path):
     """Round-4 verdict weak #2 / next #3: with the COMMITTED baseline
     table, a synthetic -20% injection on every tokens/s metric must

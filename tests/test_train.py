@@ -115,7 +115,12 @@ class TestTracing:
         assert os.path.isdir(d) and any(os.scandir(d))
 
     def test_event_log(self, tmp_path):
+        # canonical home since the round-14 fold (tpulab.obs.profiler);
+        # the runtime.trace shim must keep re-exporting it unchanged
+        from tpulab.obs import EventLog as ObsEventLog
         from tpulab.runtime.trace import EventLog
+
+        assert EventLog is ObsEventLog
 
         p = str(tmp_path / "events.jsonl")
         log = EventLog(p, echo=False)

@@ -101,7 +101,11 @@ def main(argv=None) -> int:
             continue
         val, ref, tol = got[metric], spec["value"], spec["tol_rel"]
         lower_is_better = spec["direction"] == "lower"
-        ratio = val / ref if ref else float("inf")
+        # ref == 0 baselines (e.g. decode_steady_recompiles, expected
+        # 0): matching 0 is OK, any positive value is infinitely worse
+        # for a lower-is-better metric — the old unconditional inf made
+        # a 0-vs-0 match report as regressed
+        ratio = val / ref if ref else (float("inf") if val > 0 else 1.0)
         if lower_is_better:
             state = ("regressed" if ratio > 1 + tol
                      else "improved" if ratio < 1 - tol else "ok")

@@ -13,6 +13,15 @@ socket and issues the observability requests this layer added:
   * ``slowlog`` — the worst-N requests by e2e latency with their
     per-request span summaries (``--slowlog N``); each entry's ``rid``
     links it to the same request's events in the trace dump.
+  * ``compile_stats`` — the process compile ledger + device peaks
+    (``--roofline``: per-program compiles / compile-seconds / FLOPs /
+    bytes accessed / compute- vs bandwidth-bound classification, plus
+    the engine_mfu/train_mfu gauges; on the CPU proxy the bound column
+    says so instead of fabricating a peak).
+  * ``postmortem`` — the newest crash flight-recorder bundle
+    (``--postmortem``: reason, error, engine stats at death, armed
+    fault schedule, compile table, slow-log worst-N, trace-slice
+    size — tpulab.obs.flightrec).
 
 The summary table is the serving-metrics view production TPU serving
 comparisons report (PAPERS.md, arXiv:2605.25645): p50/p90/p99 TTFT,
@@ -216,6 +225,85 @@ def summarize(metrics: dict) -> list:
     return rows
 
 
+def format_roofline(payload: dict) -> str:
+    """Render a ``compile_stats`` response as the roofline table
+    (pure function — unit-tested without a daemon)."""
+    peaks = payload.get("peaks") or {}
+    lines = [
+        f"device: {peaks.get('device_kind') or 'unknown'}  "
+        f"peak_tflops={peaks.get('peak_tflops')}  "
+        f"peak_gbps={peaks.get('peak_gbps')}",
+        f"mfu: engine={payload.get('mfu', {}).get('engine_mfu')}%  "
+        f"train={payload.get('mfu', {}).get('train_mfu')}%  "
+        f"steady_recompiles={payload.get('steady_recompiles')}  "
+        f"compile_s_total={payload.get('total_compile_seconds')}",
+    ]
+    from tpulab.obs.roofline import roofline_rows
+
+    rows = roofline_rows(payload.get("programs") or {}, peaks)
+    if not rows:
+        lines.append("(no programs compiled yet)")
+        return "\n".join(lines)
+    w = max(len(r["program"]) for r in rows)
+    lines.append(f"{'program':<{w}}  {'compiles':>8}  {'compile_s':>9}  "
+                 f"{'gflops':>9}  {'gbytes':>8}  {'f/byte':>7}  bound")
+    for r in rows:
+        gf = (f"{r['flops'] / 1e9:.3f}" if r["flops"] else "-")
+        gb = (f"{r['bytes_accessed'] / 1e9:.3f}"
+              if r["bytes_accessed"] else "-")
+        inten = (f"{r['intensity_flops_per_byte']:.2f}"
+                 if r["intensity_flops_per_byte"] is not None else "-")
+        lines.append(
+            f"{r['program']:<{w}}  {r['compiles']:>8}  "
+            f"{r['compile_seconds']:>9.3f}  {gf:>9}  {gb:>8}  "
+            f"{inten:>7}  {r['bound']}")
+    return "\n".join(lines)
+
+
+def format_postmortem(bundle: dict) -> str:
+    """Render a ``postmortem`` response (pure function, unit-tested).
+    ``{"bundles": 0}`` renders as the no-bundle message."""
+    if not bundle or not bundle.get("reason"):
+        return "no post-mortem bundles recorded"
+    err = bundle.get("error") or {}
+    eng = bundle.get("engine") or {}
+    trace = bundle.get("trace") or {}
+    slow = (bundle.get("slowlog") or {}).get("worst", [])
+    lines = [
+        f"postmortem: {bundle.get('reason')}  "
+        f"(bundle {bundle.get('path', '<inline>')}, "
+        f"{bundle.get('bundles', 1)} on disk)",
+        f"error: {err.get('type')}: {err.get('message')}" if err
+        else "error: none recorded",
+        f"engine: build_key={eng.get('build_key')} "
+        f"stamp={eng.get('build_stamp')} "
+        f"replica={eng.get('replica_index')}",
+    ]
+    st = eng.get("stats") or {}
+    if st:
+        keys = ("ticks", "tokens_out", "requests_done", "recompiles",
+                "blocks_used", "blocks_free", "preemptions")
+        lines.append("stats at death: " + " ".join(
+            f"{k}={st[k]}" for k in keys if k in st))
+    faults_ = bundle.get("faults") or {}
+    if faults_.get("rules"):
+        lines.append("armed faults: " + "; ".join(
+            f"{r['site']} {r['kind']} at={r['at']} fired={r['fired']}"
+            for r in faults_["rules"]))
+    cs = bundle.get("compile_stats") or {}
+    compiled = {k: v for k, v in cs.items() if v.get("compiles")}
+    if compiled:
+        lines.append("compiled programs: " + " ".join(
+            f"{k}x{v['compiles']}" for k, v in sorted(compiled.items())))
+    lines.append(f"trace slice: {len(trace.get('events', []))} events "
+                 f"({trace.get('dropped', 0)} dropped before capture)")
+    for e in slow[:5]:
+        lines.append(f"  slow rid={e.get('rid')} tag={e.get('tag') or '-'} "
+                     f"e2e={e.get('e2e_ms')}ms tokens={e.get('tokens')} "
+                     f"resubmits={e.get('resubmits')}")
+    return "\n".join(lines)
+
+
 def drive(sock_path: str, n: int, steps: int,
           deadline_s: float = 120.0) -> None:
     """Send ``n`` small generate requests (shared system-prompt prefix,
@@ -244,6 +332,15 @@ def main(argv=None) -> int:
                     help="also print the daemon's worst-N slow-log "
                          "entries (per-request span summaries; each "
                          "rid links to the trace_dump events)")
+    ap.add_argument("--roofline", action="store_true",
+                    help="also print the per-program compile/roofline "
+                         "table (compile counts + seconds, FLOPs, "
+                         "bytes, compute- vs bandwidth-bound) and the "
+                         "engine_mfu/train_mfu gauges")
+    ap.add_argument("--postmortem", action="store_true",
+                    help="also print the newest crash flight-recorder "
+                         "bundle (reason, error, stats at death, armed "
+                         "faults, compile table)")
     ap.add_argument("--raw", action="store_true",
                     help="print the raw Prometheus text instead of the "
                          "summary table")
@@ -276,12 +373,22 @@ def main(argv=None) -> int:
     if args.slowlog:
         slow = json.loads(request(args.socket, "slowlog",
                                   {"n": args.slowlog}))
+    roof = None
+    if args.roofline:
+        roof = json.loads(request(args.socket, "compile_stats"))
+    pm = None
+    if args.postmortem:
+        pm = json.loads(request(args.socket, "postmortem"))
     if args.json:
         out = {"latency": rows}
         if fleet is not None:
             out["fleet"] = fleet
         if slow is not None:
             out["slowlog"] = slow.get("worst", [])
+        if roof is not None:
+            out["compile_stats"] = roof
+        if pm is not None:
+            out["postmortem"] = pm
         print(json.dumps(out))
         return 0
     if not rows:
@@ -322,6 +429,10 @@ def main(argv=None) -> int:
                   f"chunks={e.get('prefill_chunks')} "
                   f"{where}"
                   f"tokens={e.get('tokens')}")
+    if roof is not None:
+        print(format_roofline(roof))
+    if pm is not None:
+        print(format_postmortem(pm))
     return 0
 
 

@@ -48,7 +48,12 @@ def _measure(cfg_kw, s: int, b: int, reps: int, train: bool,
              smoke: bool = False):
     import jax
 
-    from tpulab.bench import _mfu_fields, labformer_fwd_flops
+    # ONE shared MFU/FLOPs implementation (round 14): the probe, the
+    # bench rows, and the engine_mfu/train_mfu gauges all compute from
+    # tpulab.obs.roofline — a probe number can no longer drift from a
+    # gauge number
+    from tpulab.obs.roofline import labformer_fwd_flops
+    from tpulab.obs.roofline import mfu_fields as _mfu_fields
     from tpulab.models.labformer import forward, init_train_state
     from tpulab.runtime.device import commit, default_device
     from tpulab.runtime.timing import measure_ms
@@ -91,7 +96,8 @@ def _measure_fused(cfg_kw, s: int, b: int, reps: int, k: int = 4,
 
     import jax
 
-    from tpulab.bench import _mfu_fields, labformer_fwd_flops
+    from tpulab.obs.roofline import labformer_fwd_flops
+    from tpulab.obs.roofline import mfu_fields as _mfu_fields
     from tpulab.models.labformer import init_train_state
     from tpulab.runtime.device import default_device
     from tpulab.train import device_resident

@@ -41,44 +41,12 @@ def variance_fields(samples, meta: Dict[str, Any] | None = None) -> Dict[str, An
             for k, v in s.items()}
 
 
-def labformer_fwd_flops(cfg, b: int, s: int, causal: bool = True) -> int:
-    """Analytic model FLOPs for one labformer forward (multiply-add = 2).
-
-    The scaling-book convention: matmul FLOPs only (projections, MLP,
-    logits, attention score/value contractions; causal attention counts
-    half the score matrix).  Analytic, NOT ``compiled.cost_analysis()``:
-    the layer stack runs under ``lax.scan`` and XLA's cost model counts
-    the scan body once regardless of trip count, underreporting an
-    ``n_layers``-deep model by ~``n_layers``x.
-    """
-    d, dff = cfg.d_model, cfg.d_ff
-    per_tok = 2 * cfg.n_layers * (4 * d * d + 2 * d * dff) + 2 * d * cfg.vocab
-    attn = cfg.n_layers * 4 * s * s * d  # QK^T + PV, all heads
-    if causal:
-        attn //= 2
-    return b * (s * per_tok + attn)
-
-
-def _mfu_fields(flops: float, ms: float, device) -> Dict[str, Any]:
-    """Achieved TFLOP/s and %-of-peak for ``flops`` model FLOPs in ``ms``.
-
-    Peak comes from the device generation table (runtime.device) — bf16
-    systolic peak, the denominator of the scaling-book MFU convention.
-    """
-    from tpulab.runtime.device import generation_limits
-
-    peak = generation_limits(getattr(device, "device_kind", "")).get(
-        "bf16_peak_tflops_per_chip"
-    )
-    if flops <= 0 or not peak:
-        return {}
-    achieved = flops / (ms / 1e3) / 1e12
-    return {
-        "model_flops": float(flops),
-        "achieved_tflops": round(achieved, 2),
-        "mfu_pct_of_bf16_peak": round(100.0 * achieved / peak, 2),
-        "peak_tflops": peak,
-    }
+# MFU/FLOPs math lives in tpulab.obs.roofline since round 14 — ONE
+# shared implementation feeds the bench rows, tools/train_mfu_probe.py,
+# and the engine_mfu/train_mfu gauges.  Re-exported here under the
+# historical names every existing consumer imports.
+from tpulab.obs.roofline import labformer_fwd_flops  # noqa: F401
+from tpulab.obs.roofline import mfu_fields as _mfu_fields  # noqa: F401
 
 
 def bench_lab1(n: int = 1000, dtype: str = "float64", reps: int = 20) -> Dict[str, Any]:
@@ -705,6 +673,66 @@ def bench_fault_overhead(
     }
 
 
+def bench_decode_recompiles(
+    slots: int = 4, steps: int = 64, spec_k: int = 2
+) -> Dict[str, Any]:
+    """The recompile-tripwire PROBE (round 14): a steady-state decode
+    window — speculative verify + interleaved chunked prefill + the
+    async overlap window all ON, the full serving configuration — must
+    trigger ZERO fresh XLA compiles after warmup.  A nonzero value
+    means the fixed-shape program discipline drifted and production
+    decode would stall mid-wave behind the compiler; the committed
+    baselines.json row (``decode_steady_recompiles``, expected 0, tol
+    0) turns that into a mechanical gate, ratcheted by
+    tools/onchip_queue_r14.sh next to the throughput rows.  Not a
+    timing bench — deterministic by construction, no reps needed."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpulab.models.labformer import LabformerConfig, init_params
+    from tpulab.models.paged import PagedEngine
+    from tpulab.obs.compilestats import COMPILESTATS
+    from tpulab.runtime.device import default_device
+
+    cfg = LabformerConfig(d_model=64, n_heads=4, n_layers=2, d_ff=128,
+                          max_seq=256, dtype=jnp.float32)
+    device = default_device()
+    params = jax.device_put(init_params(cfg, seed=0), device)
+    rng = np.random.default_rng(0)
+    eng = PagedEngine(params, cfg, slots=slots, n_blocks=64, block_size=16,
+                      max_seq=256, prefill_chunk=16, interleave=True,
+                      overlap=1, spec_k=spec_k)
+    warm = 10
+    for i in range(slots):
+        # budget sized so NO request finishes inside the window: a
+        # speculating slot commits up to spec_k+1 tokens per tick, and
+        # a mid-window completion would legitimately switch the batch
+        # onto a program warmup never exercised (which is a real
+        # recompile — the thing this probe certifies the steady mix
+        # avoids, not the thing it should manufacture)
+        eng.submit(rng.integers(0, cfg.vocab, (8 + i,)).astype(np.int32),
+                   max_new=min((warm + steps + 4) * (spec_k + 1),
+                               256 - 16),
+                   spec="lookup" if i % 2 == 0 else "off")
+    for _ in range(warm):  # admission + every program compile
+        eng.step()
+    c0 = COMPILESTATS.seq()
+    r0 = eng.counters["recompiles"]
+    for _ in range(steps):
+        eng.step()
+    recompiles = eng.counters["recompiles"] - r0
+    return {
+        "metric": "decode_steady_recompiles",
+        "value": recompiles,
+        "unit": "recompiles",
+        "vs_baseline": None,
+        "steady_steps": steps,
+        "compile_events_window": COMPILESTATS.seq() - c0,
+        "programs_compiled_total": COMPILESTATS.total_compiles(),
+        "device": device.platform,
+    }
+
+
 def bench_train_step(
     steps: int = 48, k: int = 8, reps: int = 5, b: int = 1, s: int = 16
 ) -> Dict[str, Any]:
@@ -969,6 +997,7 @@ def run_benchmarks(only: Optional[str] = None, yield_markers: bool = False,
         "prefill_interleave": bench_prefill_interleave,
         "obs_overhead": bench_obs_overhead,
         "fault_overhead": bench_fault_overhead,
+        "decode_recompiles": bench_decode_recompiles,
         "train_step_overhead": bench_train_step,
         "labvision_train": bench_labvision_train,
         "hw2_sort": bench_sort,

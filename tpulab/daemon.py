@@ -262,6 +262,23 @@ _C_DRAINS = _obs.counter(
     "daemon_drains",
     "replica drain operations accepted (placement stopped; replica "
     "rebuilds once quiesced)")
+#: crash flight recorder (round 14): post-mortem bundles persisted
+#: under results/postmortems/ at engine/replica quarantine
+_C_POSTMORTEMS = _obs.counter(
+    "daemon_postmortems",
+    "crash post-mortem bundles persisted by the flight recorder "
+    "(engine quarantines + replica failures)")
+
+
+def _record_postmortem(reason: str, engine, err) -> None:
+    """Failure-path flight-recorder hook: persist the bundle, count it,
+    never raise (tpulab.obs.flightrec already swallows IO failures —
+    this wrapper only spares call sites the import + None check)."""
+    from tpulab.obs import flightrec
+
+    if flightrec.record_postmortem(reason, engine=engine, err=err
+                                   ) is not None:
+        _C_POSTMORTEMS.inc()
 
 
 class ShedError(RuntimeError):
@@ -674,6 +691,11 @@ class _GenerateService:
         import numpy as np
 
         _C_RESTARTS.inc()
+        # flight recorder FIRST: the bundle must snapshot the trace
+        # ring / metrics / engine stats BEFORE the replay machinery
+        # below starts overwriting them (the engine object itself is
+        # still intact — quarantine only delists it)
+        _record_postmortem("engine_quarantine", engine, err)
         self._quarantine(engine)
         rebuild = getattr(engine, "_rebuild", None)
         with st.cond:
@@ -1147,6 +1169,9 @@ class _FleetService:
 
         fleet = replica.fleet
         _C_RESTARTS.inc()
+        # bundle the evidence before the harvest mutates the engine
+        # (same discipline as the single-engine supervisor)
+        _record_postmortem("replica_quarantine", eng, err)
         with fleet.cv:
             replica.restarts += 1
             replica.health.note_crash()
@@ -2152,17 +2177,29 @@ def _handle_metrics(header: dict) -> bytes:
         # render below copy-on-read (no torn histograms)
         for k, v in _engine_stats(eng).items():
             total[k] = total.get(k, 0) + v
+    all_engines = list(engines)
     for fleet in fleets:
         for r in fleet.replicas:
             with r.cond:  # engine pointer read only — short-held
                 eng = None if r.dead else r.engine
             if eng is None:
                 continue
+            all_engines.append(eng)
             st = _engine_stats(eng)
             agg = per_replica.setdefault(r.index, {})
             for k, v in st.items():
                 total[k] = total.get(k, 0) + v
                 agg[k] = agg.get(k, 0) + v
+    # round-14 device tier: the summed engine footprint estimate the
+    # HBM gauges fall back to on backends without memory_stats
+    from tpulab.obs import roofline as _roofline
+
+    estimate = 0
+    for eng in all_engines:
+        try:
+            estimate += eng.device_bytes_estimate()
+        except Exception:
+            pass
     # gauge rewrite + render under ONE scrape lock: the stale-suffix
     # zeroing below is not atomic with the re-publish, so a concurrent
     # scrape rendering mid-rewrite would report a healthy fleet as
@@ -2190,7 +2227,48 @@ def _handle_metrics(header: dict) -> bytes:
             for name in obs.REGISTRY.names():
                 if name.startswith("engine_"):
                     obs.REGISTRY.get(name).set(0)
+        # device-tier gauges refresh AFTER the engine_* zero/publish
+        # pass (the zero loop above matches the engine_ prefix, and a
+        # no-warm-engine TPU daemon still holds real allocations the
+        # memory_stats-backed gauges must keep reporting)
+        _roofline.update_device_memory_gauges(estimate)
+        _roofline.update_mfu_gauges()
         return obs.render_prometheus().encode("utf-8")
+
+
+def _handle_compile_stats(header: dict) -> bytes:
+    """``compile_stats`` request: the process compile ledger
+    (tpulab.obs.compilestats — per-program compiles / compile-seconds /
+    cost snapshots), the device's roofline peaks, and the current
+    engine_mfu/train_mfu gauge values as JSON.
+    ``tools/obs_report.py --roofline`` renders the table."""
+    from tpulab.obs import COMPILESTATS
+    from tpulab.obs import roofline as _roofline
+
+    peaks = _roofline.device_peaks()
+    return json.dumps({
+        "programs": COMPILESTATS.snapshot(),
+        "peaks": peaks,
+        "mfu": _roofline.update_mfu_gauges(peaks),
+        "steady_recompiles": COMPILESTATS.steady_recompiles,
+        "total_compile_seconds": round(
+            COMPILESTATS.total_compile_seconds(), 6),
+    }).encode("utf-8")
+
+
+def _handle_postmortem(header: dict) -> bytes:
+    """``postmortem`` request: the NEWEST flight-recorder bundle
+    (tpulab.obs.flightrec) as JSON, with its on-disk ``path`` and the
+    total bundle count; ``{"bundles": 0}`` when none exist.
+    ``tools/obs_report.py --postmortem`` pretty-prints it."""
+    from tpulab.obs import flightrec
+
+    bundle = flightrec.latest_postmortem()
+    n = len(flightrec.list_bundles())
+    if bundle is None:
+        return json.dumps({"bundles": 0}).encode("utf-8")
+    bundle["bundles"] = n
+    return json.dumps(bundle).encode("utf-8")
 
 
 def _handle_trace_dump(header: dict) -> bytes:
@@ -2305,6 +2383,10 @@ def handle_request(header: dict, payload: bytes,
         return _handle_metrics(header)
     if header.get("lab") == "trace_dump":
         return _handle_trace_dump(header)
+    if header.get("lab") == "compile_stats":
+        return _handle_compile_stats(header)
+    if header.get("lab") == "postmortem":
+        return _handle_postmortem(header)
     if header.get("lab") == "slowlog":
         return _handle_slowlog(header)
     if header.get("lab") == "fleet":

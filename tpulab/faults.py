@@ -162,6 +162,21 @@ class FaultInjector:
         with self._lock:
             return self._hits.get(site, 0)
 
+    def describe(self) -> Dict:
+        """Serializable snapshot of the armed state — the flight
+        recorder (tpulab/obs/flightrec.py) persists this into every
+        post-mortem bundle so a chaos failure records WHICH schedule
+        was active and how far each site had counted."""
+        with self._lock:
+            return {
+                "enabled": self.enabled,
+                "rules": [
+                    {"site": r.site, "kind": r.kind, "at": r.at,
+                     "count": r.count, "arg": r.arg, "fired": r.fired}
+                    for r in self._rules],
+                "hits": dict(self._hits),
+            }
+
     def fired(self) -> Dict[str, int]:
         """{site: rules-fired count} — chaos tests assert the schedule
         actually executed (a test whose fault never fired proves
@@ -234,6 +249,11 @@ def configure(schedule, seed: int = 0) -> None:
 
 def disable() -> None:
     INJECTOR.disable()
+
+
+def describe() -> Dict:
+    """Module-level :meth:`FaultInjector.describe` (post-mortem use)."""
+    return INJECTOR.describe()
 
 
 def fire(site: str, scope: Optional[str] = None) -> Optional[_Rule]:

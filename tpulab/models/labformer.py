@@ -658,8 +658,16 @@ def _finalize_step(body, donate: bool):
     bit-identical to K sequential ``step`` calls (asserted by
     tests/test_train_overlap.py for K in {1, 4}).
     """
+    from tpulab.obs import compilestats as _cstats
+
     donate_argnums = (0, 1) if donate else ()
-    step = jax.jit(body, donate_argnums=donate_argnums)
+    # the trainer's TWO compiled programs report into the process
+    # compile ledger (tpulab.obs.compilestats) under stable names —
+    # compile counts / seconds / cost snapshots next to the engine's
+    # four programs; re-building a step for a new config accumulates
+    # into the same rows (one ledger per program name by design)
+    step = _cstats.instrument(
+        "train_step", jax.jit(body, donate_argnums=donate_argnums))
 
     def k_body(params, opt_state, blocks):
         def one(carry, data):
@@ -670,7 +678,8 @@ def _finalize_step(body, donate: bool):
             one, (params, opt_state), blocks)
         return params, opt_state, losses
 
-    step.step_k = jax.jit(k_body, donate_argnums=donate_argnums)
+    step.step_k = _cstats.instrument(
+        "train_step_k", jax.jit(k_body, donate_argnums=donate_argnums))
     return step
 
 

@@ -113,6 +113,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from tpulab import faults as _faults
+from tpulab.obs import compilestats as _cstats
 from tpulab.obs import tracer as _obs_tracer
 from tpulab.obs.registry import gauge as _obs_gauge
 from tpulab.obs.registry import histogram as _obs_histogram
@@ -212,6 +213,14 @@ def _pool_gather(pool, idx, dtype):
         return (data[idx].astype(jnp.float32)
                 * scale[idx][..., None]).astype(dtype)
     return pool[idx]
+
+
+def _pool_nbytes(pool) -> int:
+    """Device bytes one pool occupies (int8 pools: data + scale) — the
+    KV-occupancy gauge's static size term."""
+    if isinstance(pool, tuple):
+        return int(pool[0].nbytes) + int(pool[1].nbytes)
+    return int(pool.nbytes)
 
 
 def _rope_at(x, pos, theta: float):
@@ -331,9 +340,11 @@ def _decode_core(params, tokens, kpool, vpool, tables, lengths,
 #: standalone decode-step program (prefill's first-token path, direct
 #: callers); the engine's steady state runs _decode_core fused inside
 #: :func:`paged_tick` instead
-paged_decode_step = functools.partial(
-    jax.jit, static_argnames=("cfg", "block_size", "attn"),
-    donate_argnums=(2, 3))(_decode_core)
+paged_decode_step = _cstats.instrument(
+    "paged_decode_step",
+    functools.partial(
+        jax.jit, static_argnames=("cfg", "block_size", "attn"),
+        donate_argnums=(2, 3))(_decode_core))
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "block_size", "W"),
@@ -623,6 +634,35 @@ def _spec_commit(state, adv, last_tok, new_keys, marks):
         keys=new_keys,
         seen=state["seen"].at[jnp.arange(S)[:, None], marks].max(valid),
     )
+
+
+# ------------------------------------------------- compile observability
+# Every jitted program the engine dispatches reports into the process
+# compile ledger (tpulab.obs.compilestats) under a stable program name:
+# compile counts / compile-seconds / first-compile cost_analysis per
+# program, and the executable-cache delta that backs the engine's
+# steady-state RECOMPILE TRIPWIRE (see PagedEngine.step).  The wrappers
+# forward calls verbatim — donation, statics, and sharding behavior are
+# untouched — and cost one C++ cache-size read per call on the hot
+# path (inside the obs_overhead/paged_tick bench budgets).  The dense
+# prefill itself runs EAGERLY (generate._prefill), so the dense
+# admission path is accounted through its jitted _scatter_prefill (and
+# the compile-bucket census below).
+paged_verify = _cstats.instrument("paged_verify", paged_verify)
+paged_extend = _cstats.instrument("paged_extend", paged_extend)
+paged_tick = _cstats.instrument("paged_tick", paged_tick)
+_scatter_prefill = _cstats.instrument("scatter_prefill", _scatter_prefill)
+_draft_extend = _cstats.instrument("draft_extend", _draft_extend)
+_slot_write = _cstats.instrument("slot_write", _slot_write)
+_table_trash = _cstats.instrument("table_trash", _table_trash)
+_spec_commit = _cstats.instrument("spec_commit", _spec_commit)
+_sample_tokens = _cstats.instrument("sample_tokens", _sample_tokens)
+_advance_key = _cstats.instrument("advance_key", _advance_key)
+# the engine-side bindings of the speculative module's shared programs
+# (speculative.py's own standalone loop keeps its uninstrumented names)
+_prefill_jit = _cstats.instrument("draft_prefill", _prefill_jit)
+_draft_propose_slots = _cstats.instrument("draft_propose",
+                                          _draft_propose_slots)
 
 
 def publish_engine_stats(st: Dict[str, int], suffix: str = "") -> None:
@@ -932,10 +972,14 @@ class PagedEngine:
         # the synchronous whole-prefill admission under a drain barrier
         # (the bit-equality oracle the interleave tests compare against)
         self.interleave = bool(interleave)
-        # dense-prefill compile-bucket census: each distinct power-of-two
-        # prompt bucket is one more compiled program — warn once past 4
-        # (prefill_chunk > 0 bounds this at the single chunk bucket)
+        # prefill compile-bucket census, kept PER PROGRAM (round-14
+        # satellite: the sets back the engine_compile_buckets_dense /
+        # engine_compile_buckets_extend gauges): each distinct
+        # power-of-two bucket is one more compiled program — warn once
+        # past 4 combined (prefill_chunk > 0 bounds this at the single
+        # chunk bucket)
         self._dense_buckets: set = set()
+        self._extend_buckets: set = set()
         self._dense_warned = False
         # per-step stall accounting scratch (reset by step()):
         # dispatches = prefill programs issued this step; credit = how
@@ -974,6 +1018,13 @@ class PagedEngine:
             # request was evicted under KV pressure (blocks released,
             # request requeued to resume from its committed prefix)
             "preemptions": 0,
+            # compile observability (round 14): fresh XLA compiles that
+            # landed inside a STEADY-STATE step — warmup compiles never
+            # count; a nonzero value means the fixed-shape discipline
+            # drifted mid-wave (new prefill bucket, shape drift) and a
+            # multi-second stall hit live traffic.  The tripwire raises
+            # instead under tpulab.obs.compilestats.strict() (tests).
+            "recompiles": 0,
         }
         # bounded admission queue (0 = unbounded): submit raises
         # QueueFullError past the bound — backpressure the daemon maps
@@ -1021,6 +1072,25 @@ class PagedEngine:
         # schedules can target ONE replica out of N identical engines
         self.replica_index: Optional[int] = None
         self.fault_scope: Optional[str] = None
+        # compile/device observability (round 14): the engine is STEADY
+        # once a step has dispatched device work without compiling —
+        # later compiles inside a step are RECOMPILES (counter above +
+        # the strict() tripwire).  Pool byte sizes are static (the
+        # donated pools change identity per tick, never shape), so the
+        # occupancy gauges come from sizes captured here; the analytic
+        # per-tick matmul FLOPs registration feeds the engine_mfu gauge
+        # (tpulab.obs.roofline — last engine wins, the one-serving-
+        # config common case; attention reads are bandwidth, excluded
+        # by the documented convention).
+        self._steady = False
+        self._kv_pool_bytes = (_pool_nbytes(self.kpool)
+                               + _pool_nbytes(self.vpool))
+        self._block_bytes = self._kv_pool_bytes // n_blocks
+        self._dev_bytes_est: Optional[int] = None
+        from tpulab.obs.roofline import per_token_flops as _ptf
+
+        _cstats.COMPILESTATS.set_model_flops(
+            "paged_tick", float(slots * _ptf(cfg)))
 
     def _init_dev_state(self):
         # DEVICE-allocated (jnp.zeros/ones, never jnp.asarray of a
@@ -1095,6 +1165,7 @@ class PagedEngine:
                  cfg.kv_heads, cfg.head_dim)
         self.d_kc = jnp.zeros(shape, cfg.dtype)
         self.d_vc = jnp.zeros(shape, cfg.dtype)
+        self._dev_bytes_est = None  # the footprint just grew: re-sum
 
     # ------------------------------------------------------------- admission
     def submit(self, prompt, max_new: int, *, temperature: float = 0.0,
@@ -1425,7 +1496,7 @@ class PagedEngine:
             # unchunked engine) bucket by the variable tail length —
             # one compiled extend program per distinct bucket, the same
             # unbounded-compile concern as dense prefill: census them
-            self._note_dense_bucket(bucket)
+            self._note_dense_bucket(bucket, "extend")
         padded = np.zeros((1, bucket), np.int32)
         padded[0, :len(tail)] = tail
         # begin/end rather than the cached span handle: the B record
@@ -1445,23 +1516,29 @@ class PagedEngine:
         self._stall_prefill_dispatches += 1
         return start + len(tail)
 
-    def _note_dense_bucket(self, bucket: int):
+    def _note_dense_bucket(self, bucket: int, program: str = "dense"):
         """Census of the unchunked engine's prefill compile buckets —
-        dense whole-prompt programs AND chunk-0 whole-tail extend
-        windows: every distinct power-of-two bucket is one more
-        compiled program, and a fresh compile mid-wave stalls every
-        decoding slot.  One-line warning past 4 — the serving surfaces
-        (daemon/CLI) default ``prefill_chunk`` to a fixed window
-        exactly so this set stays at one extend program."""
-        self._dense_buckets.add(bucket)
-        if len(self._dense_buckets) > 4 and not self._dense_warned:
+        dense whole-prompt programs (``program="dense"``) AND chunk-0
+        whole-tail extend windows (``program="extend"``), counted per
+        program since round 14 (the ``engine_compile_buckets_dense`` /
+        ``engine_compile_buckets_extend`` stats gauges): every distinct
+        power-of-two bucket is one more compiled program, and a fresh
+        compile mid-wave stalls every decoding slot.  One-line warning
+        past 4 COMBINED (the pre-split behavior, warn-once preserved) —
+        the serving surfaces (daemon/CLI) default ``prefill_chunk`` to
+        a fixed window exactly so this set stays at one extend
+        program."""
+        (self._extend_buckets if program == "extend"
+         else self._dense_buckets).add(bucket)
+        census = self._dense_buckets | self._extend_buckets
+        if len(census) > 4 and not self._dense_warned:
             self._dense_warned = True
             import warnings
 
             warnings.warn(
                 f"unchunked prefill has compiled "
-                f"{len(self._dense_buckets)} prompt-length buckets "
-                f"{sorted(self._dense_buckets)}; set prefill_chunk > 0 "
+                f"{len(census)} prompt-length buckets "
+                f"{sorted(census)}; set prefill_chunk > 0 "
                 f"to bound the program count",
                 RuntimeWarning, stacklevel=3)
 
@@ -1863,7 +1940,35 @@ class PagedEngine:
         as ``paged_tick``, so decoding slots keep emitting a token
         every tick while another slot prefills.  The one remaining
         admission sync is block reclamation: the head request needs
-        blocks held by a request finishing inside the window."""
+        blocks held by a request finishing inside the window.
+
+        RECOMPILE TRIPWIRE (round 14): the step is bracketed by the
+        process compile ledger (tpulab.obs.compilestats).  The engine
+        turns STEADY at the first step that dispatched device work
+        without compiling anything; a later step that DOES compile —
+        filtered to compiles this thread triggered, so a peer
+        replica's warmup on another stepper thread can never trip it —
+        increments the ``recompiles`` counter (``engine_recompiles``
+        in every scrape) and, under ``compilestats.strict()`` (tests),
+        raises :class:`~tpulab.obs.compilestats.RecompileError` at the
+        offending tick.  The steady no-compile path costs two list-
+        length reads — no lock, no allocation."""
+        cs = _cstats.COMPILESTATS
+        c0 = cs.seq()
+        t0 = self.counters["ticks"]
+        p0 = self.counters["prefill_chunks"]
+        finished = self._step_inner()
+        names = cs.names_since(c0) if cs.seq() != c0 else ()
+        if names:
+            if self._steady:
+                self.counters["recompiles"] += len(names)
+                cs.note_steady_recompile(names)
+        elif (self.counters["ticks"] != t0
+                or self.counters["prefill_chunks"] != p0):
+            self._steady = True
+        return finished
+
+    def _step_inner(self) -> List[int]:
         finished: List[int] = []
         if _faults.ACTIVE:
             rule = _faults.fire("paged.step", self.fault_scope)
@@ -2202,12 +2307,31 @@ class PagedEngine:
     def stats(self) -> Dict[str, int]:
         """Serving observability: counters plus live pool occupancy and
         the async window's current depth (``inflight_depth``: device
-        ticks dispatched but not yet drained by the host)."""
+        ticks dispatched but not yet drained by the host).
+
+        Round 14 adds the CAPACITY signals item §3's spill tier will
+        regulate on — KV blocks used next to free, the pools' static
+        byte size, the prefix cache's block bytes — and the compile
+        census per program.  Every value here is DETERMINISTIC for a
+        given request history (live ``memory_stats()`` readings go to
+        the ``engine_hbm_*`` gauges on the scrape path instead), so
+        the obs-on/off stats bit-equality contract is unaffected."""
         return {
             **self.counters,
             "blocks_free": len(self.free),
+            "blocks_used": self.n_usable_blocks - len(self.free),
             "blocks_total": self.n_usable_blocks,
             "cache_entries": len(self.prefix_cache),
+            # bytes the cache's entries span (block-granular; shared
+            # blocks counted once per entry referencing them — the
+            # eviction-pressure view, like the refcounts themselves)
+            "cache_bytes": self._block_bytes * sum(
+                len(b) for b in self.prefix_cache.values()),
+            # static device footprint of the K+V pools (int8 pools
+            # include their scale planes)
+            "kv_pool_bytes": self._kv_pool_bytes,
+            "compile_buckets_dense": len(self._dense_buckets),
+            "compile_buckets_extend": len(self._extend_buckets),
             "inflight_depth": self.inflight_depth,
             # gauge: slots whose interleaved admission still owes
             # prefill chunks (0 in steady state and for sync engines)
@@ -2216,6 +2340,20 @@ class PagedEngine:
                 if r is not None and r.phase == "prefill"),
         }
 
+    def device_bytes_estimate(self) -> int:
+        """Estimated device bytes this engine holds (params + KV pools
+        + draft caches + per-slot decode state) — the CPU-proxy stand-
+        in for ``memory_stats()['bytes_in_use']`` the ``engine_hbm_*``
+        gauges fall back to (tpulab.obs.roofline).  Sizes are static
+        per engine, so the sum is computed once and cached."""
+        if self._dev_bytes_est is None:
+            leaves = jax.tree_util.tree_leaves(
+                (self.params, self.draft_params, self.d_kc, self.d_vc,
+                 list(self._dev.values())))
+            self._dev_bytes_est = self._kv_pool_bytes + int(sum(
+                int(getattr(x, "nbytes", 0)) for x in leaves))
+        return self._dev_bytes_est
+
     def publish_metrics(self) -> Dict[str, int]:
         """Mirror :meth:`stats` into the process-global registry as
         ``engine_<key>`` gauges and return the snapshot.  Scrape-path
@@ -2223,9 +2361,18 @@ class PagedEngine:
         engines must aggregate before publishing (the daemon's
         ``metrics`` handler sums stats() across engines and calls
         :func:`publish_engine_stats` once) — the gauges are unlabeled,
-        so concurrent per-engine publishes would overwrite each other."""
+        so concurrent per-engine publishes would overwrite each other.
+
+        Also refreshes the round-14 device-tier gauges: ``engine_hbm_
+        bytes_in_use``/``_limit`` (live ``memory_stats()`` where the
+        backend has it, this engine's byte estimate on the CPU proxy)
+        and the ``engine_mfu``/``train_mfu`` roofline gauges."""
+        from tpulab.obs import roofline as _roofline
+
         st = self.stats()
         publish_engine_stats(st)
+        _roofline.update_device_memory_gauges(self.device_bytes_estimate())
+        _roofline.update_mfu_gauges()
         return st
 
     def run(self) -> Dict[int, np.ndarray]:

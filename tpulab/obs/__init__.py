@@ -13,15 +13,39 @@ Two stdlib-only primitives the whole stack records into:
   summaries (the daemon's ``slowlog`` request), rid-linked to the
   tracer's event stream.
 
-Both are safe on the serving/training hot paths by construction (O(1),
-allocation-free, no device syncs); the ``obs_overhead`` bench holds the
-combined cost under 3% of steady-state engine ticks/s.  Consumers:
-``tpulab.models.paged`` (per-request latency histograms + engine trace
-events), ``tpulab.daemon`` (``metrics``/``trace_dump`` requests),
-``tpulab.train`` (dispatch/loss-lag histograms), ``tools/obs_report.py``
-(percentile summaries from a scrape).
+The round-14 compiler/device tier sits on top of them:
+
+* :mod:`tpulab.obs.compilestats` — the compile-event recorder every
+  jitted engine/trainer program reports into (compiles,
+  compile-seconds, ``cost_analysis`` snapshots) and the steady-state
+  **recompile tripwire** (``engine_recompiles`` in production,
+  :func:`~tpulab.obs.compilestats.strict` raises in tests).
+* :mod:`tpulab.obs.roofline` — the ONE copy of the MFU/roofline math
+  (analytic model FLOPs, device peak lookup, ``engine_mfu`` /
+  ``train_mfu`` gauges, per-program compute- vs bandwidth-bound rows).
+* :mod:`tpulab.obs.flightrec` — the crash flight recorder: one JSON
+  post-mortem bundle per engine/replica failure under
+  ``results/postmortems/`` (the daemon's ``postmortem`` request).
+* :mod:`tpulab.obs.profiler` — the opt-in heavy tier (JAX device
+  profiler + ``[tag]`` event log), folded in from the legacy
+  ``tpulab/runtime/trace.py`` (which remains as a re-exporting shim).
+
+All hot-path pieces are safe on the serving/training paths by
+construction (O(1), allocation-free, no device syncs); the
+``obs_overhead`` bench holds the combined cost under 3% of
+steady-state engine ticks/s.  Consumers: ``tpulab.models.paged``
+(per-request latency histograms + engine trace events + instrumented
+programs), ``tpulab.daemon`` (``metrics``/``trace_dump``/
+``compile_stats``/``postmortem`` requests), ``tpulab.train``
+(dispatch/loss-lag histograms + train MFU), ``tools/obs_report.py``
+(percentile/roofline/post-mortem views from a scrape).
 """
 
+from tpulab.obs.compilestats import (COMPILESTATS, CompileStats,
+                                     RecompileError, instrument, strict)
+from tpulab.obs.flightrec import (configure_flightrec, latest_postmortem,
+                                  record_postmortem)
+from tpulab.obs.profiler import EventLog, annotate, maybe_trace
 from tpulab.obs.registry import (DEFAULT_BUCKETS, REGISTRY, Counter, Gauge,
                                  Histogram, Registry, counter, gauge,
                                  histogram, percentile_from_buckets,
@@ -31,9 +55,12 @@ from tpulab.obs.tracer import (DEFAULT_CAPACITY, NULL, TRACER, Tracer,
                                configure_tracer, event, next_rid, span)
 
 __all__ = [
-    "DEFAULT_BUCKETS", "DEFAULT_CAPACITY", "REGISTRY", "SLOWLOG", "Counter",
-    "Gauge", "Histogram", "NULL", "Registry", "SlowLog", "TRACER", "Tracer",
-    "configure_slowlog", "configure_tracer", "counter", "event", "gauge",
-    "histogram", "next_rid", "percentile_from_buckets", "render_prometheus",
-    "span",
+    "COMPILESTATS", "DEFAULT_BUCKETS", "DEFAULT_CAPACITY", "REGISTRY",
+    "SLOWLOG", "CompileStats", "Counter", "EventLog", "Gauge", "Histogram",
+    "NULL", "RecompileError", "Registry", "SlowLog", "TRACER", "Tracer",
+    "annotate", "configure_flightrec", "configure_slowlog",
+    "configure_tracer", "counter", "event", "gauge", "histogram",
+    "instrument", "latest_postmortem", "maybe_trace", "next_rid",
+    "percentile_from_buckets", "record_postmortem", "render_prometheus",
+    "span", "strict",
 ]

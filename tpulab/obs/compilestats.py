@@ -1,0 +1,337 @@
+"""Compile-event recorder: what XLA compiled, when, and how big it is.
+
+The serving and training tiers run on a FIXED-SHAPE program discipline
+(the engine's four programs + scatter-updaters, the trainer's two
+programs) precisely so that XLA compiles a bounded set of executables
+up front and the steady state never stalls behind a fresh compile.
+Until this module existed that discipline was enforced only by
+convention (and a census warning for prefill buckets): a mid-wave
+recompile — a new prompt bucket, a shape drift, an accidentally
+re-traced closure — was invisible until tokens/s dropped for seconds.
+The Julia→TPU AOT paper (PAPERS.md, arXiv:1810.09868) and the
+Gemma-serving comparison (arXiv:2605.25645) both treat compile count /
+compile seconds / per-program cost as first-class production numbers;
+this module gives the repo that ledger.
+
+Three pieces:
+
+* :func:`instrument` wraps a jitted callable under a stable **program
+  name**.  Detection is the executable-cache delta (``_cache_size()``
+  on the PjitFunction — one cheap C++ call per invocation): a call
+  that grew the cache was a compile; its wall time is charged to the
+  program's ``compile_seconds`` (trace + lower + backend compile +
+  first run — the stall a rider actually experiences).  At the FIRST
+  compile the wrapper snapshots XLA's ``cost_analysis()`` from the
+  lowered module (FLOPs, bytes accessed — HLO-level, no second backend
+  compile) and, when :data:`CAPTURE_MEMORY` is on (env
+  ``TPULAB_COMPILESTATS_MEMORY=1``; off by default because it costs
+  one extra backend compile per program), ``memory_analysis()`` (arg /
+  output / temp bytes — the HBM footprint ledger).
+* every compile appends ``(name, thread_id)`` to a process-global
+  **event log**; :meth:`CompileStats.seq` / :meth:`names_since` let a
+  caller bracket a region and ask "did MY thread compile anything in
+  there?"  — that is the engine's recompile tripwire
+  (``PagedEngine`` counts compiles that land inside a steady-state
+  tick into its ``recompiles`` counter, and under :func:`strict`
+  raises :class:`RecompileError` — the test mode).
+* ``set_model_flops``/``model_flops`` carry the ANALYTIC per-dispatch
+  FLOPs a subsystem registers for its hot program (the engine's
+  per-tick matmul FLOPs, the trainer's per-block step FLOPs) — XLA's
+  own cost model counts a ``lax.scan`` body ONCE regardless of trip
+  count (see ``tpulab.obs.roofline.labformer_fwd_flops``), so MFU
+  gauges use the analytic number and the roofline table reports both.
+
+Hot-path contract: a steady-state (cache-hit) call through an
+instrumented program costs two ``perf_counter`` reads, one
+``_cache_size()`` C++ call and one integer compare — no allocation, no
+locking, no device sync; the ``obs_overhead``/``paged_tick`` benches
+bound it inside their existing budgets.  The cost/memory snapshot and
+the event-log append run only on the (rare, already multi-ms) compile
+path.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+#: capture ``memory_analysis()`` at first compile — costs one EXTRA
+#: backend compile per program, so it is opt-in (the cost_analysis
+#: snapshot is HLO-level and always on)
+CAPTURE_MEMORY = os.environ.get("TPULAB_COMPILESTATS_MEMORY", "") not in (
+    "", "0", "false")
+
+
+class RecompileError(RuntimeError):
+    """A steady-state tick triggered a fresh XLA compile while the
+    tripwire was armed (:func:`strict`).  In production the same event
+    only increments the engine's ``recompiles`` counter — raising is
+    the test mode that turns "the fixed-shape discipline drifted" into
+    a red test instead of a tokens/s dip."""
+
+
+def _sds_like(x):
+    """jax.ShapeDtypeStruct twin of an array-ish leaf (safe on DONATED
+    /deleted jax Arrays — aval metadata outlives the buffer); anything
+    without both shape and dtype (python scalars, configs) passes
+    through untouched."""
+    import jax
+
+    if hasattr(x, "shape") and hasattr(x, "dtype") and not isinstance(
+            x, jax.ShapeDtypeStruct):
+        try:
+            import numpy as np
+
+            return jax.ShapeDtypeStruct(np.shape(x), x.dtype)
+        except Exception:
+            return x
+    return x
+
+
+class ProgramStats:
+    """One named program's ledger (guarded by the registry lock for
+    writes; reads are GIL-consistent ints/floats)."""
+
+    __slots__ = ("name", "compiles", "compile_seconds", "last_compile_s",
+                 "cost", "memory", "model_flops", "first_compile_unix")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.compiles = 0
+        self.compile_seconds = 0.0
+        self.last_compile_s = 0.0
+        self.cost: Optional[Dict[str, float]] = None
+        self.memory: Optional[Dict[str, int]] = None
+        self.model_flops: Optional[float] = None
+        self.first_compile_unix: Optional[float] = None
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {
+            "compiles": self.compiles,
+            "compile_seconds": round(self.compile_seconds, 6),
+            "last_compile_seconds": round(self.last_compile_s, 6),
+            "flops": (self.cost or {}).get("flops"),
+            "bytes_accessed": (self.cost or {}).get("bytes accessed"),
+            "model_flops": self.model_flops,
+            "memory": dict(self.memory) if self.memory else None,
+            "first_compile_unix": self.first_compile_unix,
+        }
+
+
+class CompileStats:
+    """Process-global compile ledger (:data:`COMPILESTATS`); tests may
+    build private instances and instrument their own functions."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._programs: Dict[str, ProgramStats] = {}
+        #: append-only (name, thread_id) per compile event — compiles
+        #: are bounded by the fixed-shape discipline this module
+        #: polices, so the log stays small by construction
+        self._log: List[Tuple[str, int]] = []
+        self.strict = False
+        self.steady_recompiles = 0
+        #: {program: reason} for best-effort analysis snapshots that
+        #: failed — surfaced in snapshot() instead of raised
+        self._analysis_errors: Dict[str, str] = {}
+
+    # -------------------------------------------------------- recording
+    def _program(self, name: str) -> ProgramStats:
+        with self._lock:
+            p = self._programs.get(name)
+            if p is None:
+                p = self._programs[name] = ProgramStats(name)
+            return p
+
+    def _note_compile(self, prog: ProgramStats, dt: float, n: int,
+                      args, kwargs, fn) -> None:
+        first = False
+        with self._lock:
+            prog.compiles += n
+            prog.compile_seconds += dt
+            prog.last_compile_s = dt
+            if prog.first_compile_unix is None:
+                prog.first_compile_unix = time.time()
+                first = True
+            tid = threading.get_ident()
+            self._log.extend([(prog.name, tid)] * n)
+        if first and args is not None:
+            self._snapshot_analysis(prog, args, kwargs, fn)
+
+    def _snapshot_analysis(self, prog: ProgramStats, args, kwargs, fn):
+        """Best-effort cost/memory snapshot from the program's lowered
+        module (abstract twins of the compiling call's args, so donated
+        buffers are never touched).  NEVER raises into the caller — a
+        failed snapshot records its reason instead of killing a tick."""
+        try:
+            import jax
+
+            sds_args = jax.tree_util.tree_map(_sds_like, args)
+            sds_kw = jax.tree_util.tree_map(_sds_like, kwargs)
+            lowered = fn.lower(*sds_args, **sds_kw)
+            ca = lowered.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            with self._lock:
+                prog.cost = {k: float(v) for k, v in (ca or {}).items()
+                             if isinstance(v, (int, float))}
+            if CAPTURE_MEMORY:
+                ma = lowered.compile().memory_analysis()
+                if ma is not None:
+                    mem = {k: int(getattr(ma, k)) for k in (
+                        "argument_size_in_bytes", "output_size_in_bytes",
+                        "temp_size_in_bytes", "alias_size_in_bytes",
+                        "generated_code_size_in_bytes")
+                        if hasattr(ma, k)}
+                    with self._lock:
+                        prog.memory = mem
+        except Exception as e:  # noqa: BLE001 — observability must not
+            # take down the program it observes
+            with self._lock:
+                self._analysis_errors[prog.name] = (
+                    f"{type(e).__name__}: {e}")
+
+    # -------------------------------------------------------- tripwire
+    def seq(self) -> int:
+        """Monotonic compile-event count — bracket a region with
+        ``c0 = seq()`` ... ``names_since(c0)`` to see what compiled
+        inside it."""
+        return len(self._log)
+
+    def names_since(self, c0: int,
+                    thread_id: Optional[int] = None) -> List[str]:
+        """Program names compiled since event ``c0``; ``thread_id``
+        (default: the calling thread) restricts to compiles that
+        thread triggered — concurrent warmup on another engine's
+        stepper must not trip a steady engine's wire."""
+        tid = threading.get_ident() if thread_id is None else thread_id
+        with self._lock:
+            return [n for n, t in self._log[c0:] if t == tid]
+
+    def note_steady_recompile(self, names: List[str]) -> None:
+        """A steady-state region compiled ``names``: count it, and
+        raise under :func:`strict` (the test mode)."""
+        with self._lock:
+            self.steady_recompiles += len(names)
+            raise_now = self.strict
+        if raise_now:
+            raise RecompileError(
+                f"steady-state recompile: {sorted(set(names))} compiled "
+                f"inside a post-warmup tick (fixed-shape discipline "
+                f"violated — new prefill bucket? shape drift?)")
+
+    # ------------------------------------------------------- model flops
+    def set_model_flops(self, name: str, flops: float) -> None:
+        """Register the ANALYTIC per-dispatch FLOPs for ``name`` (see
+        module docstring: XLA's cost model undercounts scan bodies, so
+        MFU uses the analytic number)."""
+        self._program(name).model_flops = float(flops)
+
+    def model_flops(self, name: str) -> Optional[float]:
+        with self._lock:
+            p = self._programs.get(name)
+        return p.model_flops if p is not None else None
+
+    # --------------------------------------------------------- reporting
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """Copy-on-read {program: ledger} — the ``compile_stats``
+        daemon request and the flight recorder both serialize this."""
+        with self._lock:
+            programs = list(self._programs.items())
+            errors = dict(self._analysis_errors)
+        out = {name: p.snapshot() for name, p in sorted(programs)}
+        for name, err in errors.items():
+            if name in out:
+                out[name]["analysis_error"] = err
+        return out
+
+    def total_compiles(self) -> int:
+        return len(self._log)
+
+    def total_compile_seconds(self) -> float:
+        with self._lock:
+            return sum(p.compile_seconds for p in self._programs.values())
+
+    def reset(self) -> None:
+        """Tests only: forget every ledger and the event log (the
+        instrumented wrappers keep working — they re-create their
+        program rows on the next compile)."""
+        with self._lock:
+            self._programs.clear()
+            self._log.clear()
+            self.steady_recompiles = 0
+            self._analysis_errors.clear()
+
+    # ------------------------------------------------------ instrumenting
+    def instrument(self, name: str, fn):
+        """Wrap jitted ``fn`` so its compiles land in this ledger under
+        ``name``.  The wrapper forwards calls verbatim (donation,
+        static argnames and sharding behavior unchanged) and proxies
+        attribute access to the wrapped function (``lower``,
+        ``clear_cache``, ...); re-instrumenting the same name
+        accumulates into one row (the trainer builds a fresh jitted
+        step per config)."""
+        self._program(name)  # register the row eagerly (snapshot shape)
+        return _Instrumented(self, name, fn)
+
+
+class _Instrumented:
+    """Callable proxy around one jitted function.  NOT __slots__: the
+    trainer attaches ``step.step_k`` to its step object.  The program
+    row is resolved BY NAME on the (rare) compile path, never cached:
+    a cached ProgramStats would be orphaned by ``reset()`` and silently
+    swallow every later compile's ledger entry."""
+
+    def __init__(self, cs: CompileStats, name: str, fn):
+        self._cs = cs
+        self._name = name
+        self._fn = fn
+        # missing on non-pjit callables (tests instrument plain
+        # functions): fall back to first-call-only accounting
+        self._cache_size = getattr(fn, "_cache_size", None)
+        self.__wrapped__ = fn
+
+    def __call__(self, *args, **kwargs):
+        size = self._cache_size
+        n0 = size() if size is not None else None
+        t0 = time.perf_counter()
+        out = self._fn(*args, **kwargs)
+        if size is not None:
+            grown = size() - n0
+            if grown > 0:
+                self._cs._note_compile(
+                    self._cs._program(self._name),
+                    time.perf_counter() - t0, grown,
+                    args, kwargs, self._fn)
+        elif self._cs._program(self._name).compiles == 0:
+            self._cs._note_compile(self._cs._program(self._name),
+                                   time.perf_counter() - t0,
+                                   1, None, None, self._fn)
+        return out
+
+    def __getattr__(self, item):
+        return getattr(self._fn, item)
+
+
+#: the process-global ledger every instrumented program records into
+COMPILESTATS = CompileStats()
+
+
+def instrument(name: str, fn):
+    return COMPILESTATS.instrument(name, fn)
+
+
+@contextlib.contextmanager
+def strict():
+    """Arm the tripwire's RAISE mode (tests): any steady-state
+    recompile noted while inside raises :class:`RecompileError` at the
+    engine tick that triggered it."""
+    prior = COMPILESTATS.strict
+    COMPILESTATS.strict = True
+    try:
+        yield COMPILESTATS
+    finally:
+        COMPILESTATS.strict = prior

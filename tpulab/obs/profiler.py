@@ -1,0 +1,84 @@
+"""Device-level profiling + bracketed event logging (the heavy tier).
+
+tpulab has exactly TWO tracing surfaces, and this module is the
+boundary between them (the round-14 fold of the legacy
+``tpulab/runtime/trace.py`` into the observability package):
+
+* **Always-on host timeline** — :mod:`tpulab.obs.tracer`: preallocated
+  ring buffer, one tuple append per event, cheap enough for production
+  serving.  Use it for request-scoped spans and engine boundaries.
+* **Opt-in device profiling (this module)** — the JAX profiler (XLA op
+  timeline, HBM usage; a dedicated profiling run's worth of overhead)
+  plus the reference-harness ``[tag]`` event log.  Use it when the
+  host timeline says WHERE the time went and you need the device to
+  say WHY.
+
+The reference frame: the reference's tracing is cudaEvent kernel
+brackets plus ``[Tag]`` print logging (SURVEY.md section 5.1, 5.5);
+:func:`maybe_trace` and :class:`EventLog` are their TPU-native
+equivalents.  ``tpulab/runtime/trace.py`` remains as a thin
+re-exporting shim so historical imports keep working — new code
+imports from ``tpulab.obs``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from typing import Iterator, Optional
+
+
+@contextlib.contextmanager
+def maybe_trace(trace_dir: Optional[str]) -> Iterator[None]:
+    """JAX profiler trace when ``trace_dir`` is set; no-op otherwise.
+    Output loads in TensorBoard/Perfetto."""
+    if not trace_dir:
+        yield
+        return
+    import jax
+
+    with jax.profiler.trace(trace_dir):
+        yield
+
+
+@contextlib.contextmanager
+def annotate(name: str) -> Iterator[None]:
+    """Named region visible in profiler timelines (TraceAnnotation)."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+class EventLog:
+    """Bracketed-tag event log (`[Experiment]`-style, reference
+    tester.py:197-293) with optional JSONL persistence."""
+
+    def __init__(self, path: Optional[str] = None, echo: bool = True):
+        self.path = path
+        self.echo = echo
+        self._fh = open(path, "a") if path else None
+
+    def event(self, tag: str, message: str = "", **fields) -> None:
+        rec = {"t": time.time(), "tag": tag, "message": message, **fields}
+        if self.echo:
+            extra = " ".join(f"{k}={v}" for k, v in fields.items())
+            print(f"[{tag}] {message}{(' ' + extra) if extra else ''}")
+        if self._fh:
+            self._fh.write(json.dumps(rec) + "\n")
+            self._fh.flush()
+
+    @contextlib.contextmanager
+    def timed(self, tag: str, message: str = "") -> Iterator[None]:
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.event(tag, message,
+                       elapsed_ms=round((time.perf_counter() - t0) * 1e3, 3))
+
+    def close(self) -> None:
+        if self._fh:
+            self._fh.close()
+            self._fh = None

@@ -1,0 +1,267 @@
+"""MFU and roofline accounting — the ONE copy of the math.
+
+Until this round the repo computed model FLOPs and %-of-peak in
+``tpulab/bench.py`` and re-imported the same helpers from
+``tools/train_mfu_probe.py``, and had no serving-side MFU at all.
+This module owns the shared implementation:
+
+* :func:`labformer_fwd_flops` / :func:`per_token_flops` — analytic
+  matmul FLOPs (the scaling-book convention: projections, MLP, logits,
+  attention contractions; multiply-add = 2).  Analytic, NOT XLA's
+  ``cost_analysis()``: the layer stack runs under ``lax.scan`` and
+  XLA's cost model counts the scan body ONCE regardless of trip count,
+  underreporting an ``n_layers``-deep model by ~``n_layers``x.  The
+  per-program roofline table therefore reports BOTH numbers — XLA's
+  (per compiled module, from ``tpulab.obs.compilestats``) and the
+  registered analytic one — and the MFU gauges use the analytic one.
+* :func:`mfu_fields` — achieved TFLOP/s and %-of-bf16-peak for a
+  measured dispatch (the bench/probe row fields; ``tpulab.bench``
+  re-exports it as ``_mfu_fields``).
+* :func:`device_peaks` — peak FLOPs AND peak HBM bandwidth for the
+  attached device generation (``runtime.device.TPU_GENERATION_LIMITS``;
+  both ``None`` on the CPU proxy — every consumer reports the caveat
+  instead of a fabricated number).
+* :func:`roofline_rows` — per-program compute- vs bandwidth-bound
+  classification: arithmetic intensity (FLOPs / bytes accessed, XLA's
+  ledger) against the device ridge point (peak_flops / peak_bw).
+  ``tools/obs_report.py --roofline`` renders it.
+* :func:`update_mfu_gauges` — the ``engine_mfu`` / ``train_mfu``
+  gauges: analytic per-dispatch FLOPs (registered via
+  ``compilestats.set_model_flops``) over the PR-5 latency histograms
+  (``itl_seconds`` mean as the steady-state tick time;
+  ``train_dispatch_seconds``-tracked wall time for the trainer), as a
+  percent of bf16 peak.  On CPU both gauges publish 0 (no meaningful
+  peak) — the CPU-proxy caveat is part of the metric's documented
+  contract, not a silent wrong number.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+#: gauge names (registered on import so a scrape always carries them,
+#: zero before the first update)
+G_ENGINE_MFU = "engine_mfu"
+G_TRAIN_MFU = "train_mfu"
+
+#: train-MFU accumulator: {dispatched analytic FLOPs, wall seconds} —
+#: ``tpulab.train`` adds to it at its metrics barriers via
+#: :func:`note_train_window`; process-cumulative like the registry
+_TRAIN_ACCUM = {"flops": 0.0, "wall": 0.0}
+
+
+def note_train_window(flops: float, wall_seconds: float) -> None:
+    """Accumulate one training window's dispatched analytic FLOPs and
+    wall time into the train-MFU ledger (train.py's metrics barriers)."""
+    _TRAIN_ACCUM["flops"] += float(flops)
+    _TRAIN_ACCUM["wall"] += float(wall_seconds)
+
+
+def labformer_fwd_flops(cfg, b: int, s: int, causal: bool = True) -> int:
+    """Analytic model FLOPs for one labformer forward (multiply-add = 2).
+
+    The scaling-book convention: matmul FLOPs only (projections, MLP,
+    logits, attention score/value contractions; causal attention counts
+    half the score matrix).  See the module docstring for why this is
+    analytic rather than ``cost_analysis()``.
+    """
+    d, dff = cfg.d_model, cfg.d_ff
+    per_tok = 2 * cfg.n_layers * (4 * d * d + 2 * d * dff) + 2 * d * cfg.vocab
+    attn = cfg.n_layers * 4 * s * s * d  # QK^T + PV, all heads
+    if causal:
+        attn //= 2
+    return b * (s * per_tok + attn)
+
+
+def per_token_flops(cfg) -> int:
+    """Matmul FLOPs to decode ONE token (projections + MLP + logits;
+    the context-dependent attention reads are bandwidth, not matmul —
+    excluded by the same convention the fwd number uses for its
+    per-token term)."""
+    d, dff = cfg.d_model, cfg.d_ff
+    return 2 * cfg.n_layers * (4 * d * d + 2 * d * dff) + 2 * d * cfg.vocab
+
+
+def device_peaks(device=None, device_kind: Optional[str] = None
+                 ) -> Dict[str, Optional[float]]:
+    """{"peak_tflops", "peak_gbps", "device_kind"} for the attached (or
+    named) device generation; peaks are None off-TPU — the CPU proxy
+    has no meaningful systolic peak and consumers must say so."""
+    from tpulab.runtime.device import generation_limits
+
+    if device_kind is None:
+        if device is None:
+            from tpulab.runtime.device import default_device
+
+            device = default_device()
+        device_kind = getattr(device, "device_kind", "")
+    limits = generation_limits(device_kind or "")
+    return {
+        "device_kind": device_kind,
+        "peak_tflops": limits.get("bf16_peak_tflops_per_chip"),
+        "peak_gbps": limits.get("hbm_gbps_per_chip"),
+    }
+
+
+def mfu_fields(flops: float, ms: float, device) -> Dict[str, Any]:
+    """Achieved TFLOP/s and %-of-peak for ``flops`` model FLOPs in
+    ``ms`` — the bench/probe row fields ({} when flops or the peak is
+    unknown, exactly the old ``tpulab.bench._mfu_fields`` contract)."""
+    peak = device_peaks(device)["peak_tflops"]
+    if flops <= 0 or not peak or ms <= 0:
+        return {}
+    achieved = flops / (ms / 1e3) / 1e12
+    return {
+        "model_flops": float(flops),
+        "achieved_tflops": round(achieved, 2),
+        "mfu_pct_of_bf16_peak": round(100.0 * achieved / peak, 2),
+        "peak_tflops": peak,
+    }
+
+
+def mfu_pct(flops: float, seconds: float,
+            peaks: Optional[Dict] = None) -> float:
+    """Percent of bf16 peak for ``flops`` in ``seconds`` (0.0 when the
+    peak is unknown — the CPU-proxy caveat)."""
+    peaks = peaks if peaks is not None else device_peaks()
+    peak = peaks.get("peak_tflops")
+    if not peak or flops <= 0 or seconds <= 0:
+        return 0.0
+    return 100.0 * (flops / seconds / 1e12) / peak
+
+
+def classify(flops: Optional[float], bytes_accessed: Optional[float],
+             peaks: Dict) -> Dict[str, Any]:
+    """Roofline classification of one program: arithmetic intensity vs
+    the device ridge point.  A program whose FLOPs/byte falls below
+    ``peak_flops / peak_bw`` cannot reach compute peak — it is
+    bandwidth-bound and its ceiling is ``intensity * peak_bw``."""
+    out: Dict[str, Any] = {
+        "intensity_flops_per_byte": None, "ridge_flops_per_byte": None,
+        "bound": "unknown", "ceiling_tflops": None,
+    }
+    if not flops or not bytes_accessed:
+        return out
+    intensity = flops / bytes_accessed
+    out["intensity_flops_per_byte"] = round(intensity, 3)
+    peak_tf, peak_gb = peaks.get("peak_tflops"), peaks.get("peak_gbps")
+    if not peak_tf or not peak_gb:
+        out["bound"] = "unknown (no device peaks — CPU proxy?)"
+        return out
+    ridge = (peak_tf * 1e12) / (peak_gb * 1e9)  # FLOPs per byte
+    out["ridge_flops_per_byte"] = round(ridge, 3)
+    if intensity >= ridge:
+        out["bound"] = "compute-bound"
+        out["ceiling_tflops"] = peak_tf
+    else:
+        out["bound"] = "bandwidth-bound"
+        out["ceiling_tflops"] = round(intensity * peak_gb * 1e9 / 1e12, 3)
+    return out
+
+
+def roofline_rows(compile_stats: Optional[Dict] = None,
+                  peaks: Optional[Dict] = None) -> List[Dict[str, Any]]:
+    """Per-program roofline table rows from a compile-stats snapshot
+    (live :data:`tpulab.obs.compilestats.COMPILESTATS` by default;
+    ``tools/obs_report.py --roofline`` feeds a daemon's snapshot)."""
+    if compile_stats is None:
+        from tpulab.obs.compilestats import COMPILESTATS
+
+        compile_stats = COMPILESTATS.snapshot()
+    peaks = peaks if peaks is not None else device_peaks()
+    rows = []
+    for name, p in sorted(compile_stats.items()):
+        flops = p.get("flops")
+        nbytes = p.get("bytes_accessed")
+        row = {
+            "program": name,
+            "compiles": p.get("compiles", 0),
+            "compile_seconds": p.get("compile_seconds", 0.0),
+            "flops": flops,
+            "bytes_accessed": nbytes,
+            "model_flops": p.get("model_flops"),
+            **classify(flops, nbytes, peaks),
+        }
+        rows.append(row)
+    return rows
+
+
+def update_mfu_gauges(peaks: Optional[Dict] = None,
+                      registry=None) -> Dict[str, float]:
+    """Recompute + publish the ``engine_mfu`` / ``train_mfu`` gauges
+    (percent of bf16 peak; 0.0 on the CPU proxy or before traffic).
+
+    * ``engine_mfu``: the registered per-tick analytic FLOPs
+      (``compilestats.set_model_flops("paged_tick", ...)`` —
+      LAST-ENGINE-WINS: each PagedEngine registers at construction, so
+      the gauge describes the most recently built engine config; exact
+      for the common one-serving-config process, an undercount when
+      several differently-shaped engines decode concurrently) over the
+      mean ``itl_seconds`` observation — the host-observed steady-state
+      tick time, the PR-5 histogram whose gaps ARE decode dispatches.
+    * ``train_mfu``: the trainer's accumulated dispatched FLOPs over
+      its accumulated wall time (:func:`note_train_window`, fed by
+      ``tpulab.train`` at its metrics barriers) — wall-clock MFU, the
+      honest number under the async overlap window.
+
+    Scrape-path only (the daemon's ``metrics`` handler and
+    ``PagedEngine.publish_metrics`` call it) — never per tick."""
+    from tpulab.obs.compilestats import COMPILESTATS
+    from tpulab.obs.registry import REGISTRY
+
+    reg = registry if registry is not None else REGISTRY
+    peaks = peaks if peaks is not None else device_peaks()
+    out = {"engine_mfu": 0.0, "train_mfu": 0.0}
+    # 4 SIGNIFICANT digits, not fixed decimals: a CPU-proxy smoke model
+    # has a genuinely tiny MFU and fixed rounding would print it as an
+    # impossible 0.0 (the round-4 verdict lesson, applied here)
+    sig = lambda x: float(f"{x:.4g}")
+    itl = reg.get("itl_seconds")
+    tick_flops = COMPILESTATS.model_flops("paged_tick")
+    if itl is not None and tick_flops:
+        snap = itl.snapshot()
+        if snap["count"]:
+            out["engine_mfu"] = sig(
+                mfu_pct(tick_flops, snap["sum"] / snap["count"], peaks))
+    if _TRAIN_ACCUM["flops"] and _TRAIN_ACCUM["wall"]:
+        out["train_mfu"] = sig(
+            mfu_pct(_TRAIN_ACCUM["flops"], _TRAIN_ACCUM["wall"], peaks))
+    reg.gauge(G_ENGINE_MFU,
+              "steady-state decode MFU, % of bf16 peak (0 on CPU proxy)"
+              ).set(out["engine_mfu"])
+    reg.gauge(G_TRAIN_MFU,
+              "training wall-clock MFU, % of bf16 peak (0 on CPU proxy)"
+              ).set(out["train_mfu"])
+    return out
+
+
+def update_device_memory_gauges(estimate_bytes: int = 0,
+                                registry=None) -> Dict[str, int]:
+    """Publish ``engine_hbm_bytes_in_use`` / ``engine_hbm_bytes_limit``
+    from the device runtime's ``memory_stats()`` where the backend
+    exposes it (TPU), falling back to ``estimate_bytes`` — the summed
+    pool/param/state estimate the engines report — on backends without
+    it (the CPU proxy; limit publishes 0 there).  Scrape-path only."""
+    from tpulab.obs.registry import REGISTRY
+
+    reg = registry if registry is not None else REGISTRY
+    in_use, limit = 0, 0
+    try:
+        from tpulab.runtime.device import default_device
+
+        stats = default_device().memory_stats()
+        if stats:
+            in_use = int(stats.get("bytes_in_use", 0))
+            limit = int(stats.get("bytes_limit", 0))
+    except Exception:
+        stats = None
+    if not in_use:
+        in_use = int(estimate_bytes)
+    reg.gauge("engine_hbm_bytes_in_use",
+              "device memory in use (memory_stats; pool-shape estimate "
+              "on backends without it)").set(in_use)
+    reg.gauge("engine_hbm_bytes_limit",
+              "device memory limit (0 when the backend reports none)"
+              ).set(limit)
+    return {"engine_hbm_bytes_in_use": in_use,
+            "engine_hbm_bytes_limit": limit}

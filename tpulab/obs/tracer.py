@@ -2,7 +2,7 @@
 
 The paged engine's counters say *that* a sync barrier or stall happened;
 they cannot say *when*, or what the host was doing around it.  The JAX
-profiler (``tpulab.runtime.trace.maybe_trace``) answers that for device
+profiler (``tpulab.obs.profiler.maybe_trace``) answers that for device
 ops but costs enough to be a dedicated profiling run.  This tracer is
 the always-on middle ground: host-side timeline events cheap enough to
 leave enabled in production serving.

@@ -112,22 +112,26 @@ def resolve_device(backend: str | None):
 # the compiler maps matmuls onto, and the VPU vector-register lane
 # layout.  Values from the public JAX/TPU system documentation; matched
 # against ``device_kind`` by substring.
+#: ``hbm_gbps_per_chip`` is the peak HBM bandwidth (GB/s) — with the
+#: bf16 peak it fixes the roofline ridge point (FLOPs/byte) the
+#: observability tier classifies programs against
+#: (tpulab/obs/roofline.py).  Public JAX/TPU system documentation.
 TPU_GENERATION_LIMITS = {
     "v4": {"vmem_per_core_bytes": 16 * 2**20, "mxu_shape": (128, 128),
            "vpu_lanes": 128, "vpu_sublanes": 8, "hbm_gib_per_chip": 32,
-           "bf16_peak_tflops_per_chip": 275},
+           "bf16_peak_tflops_per_chip": 275, "hbm_gbps_per_chip": 1228},
     "v5 lite": {"vmem_per_core_bytes": 128 * 2**20, "mxu_shape": (128, 128),
                 "vpu_lanes": 128, "vpu_sublanes": 8, "hbm_gib_per_chip": 16,
-                "bf16_peak_tflops_per_chip": 197},
+                "bf16_peak_tflops_per_chip": 197, "hbm_gbps_per_chip": 819},
     "v5e": {"vmem_per_core_bytes": 128 * 2**20, "mxu_shape": (128, 128),
             "vpu_lanes": 128, "vpu_sublanes": 8, "hbm_gib_per_chip": 16,
-            "bf16_peak_tflops_per_chip": 197},
+            "bf16_peak_tflops_per_chip": 197, "hbm_gbps_per_chip": 819},
     "v5p": {"vmem_per_core_bytes": 128 * 2**20, "mxu_shape": (128, 128),
             "vpu_lanes": 128, "vpu_sublanes": 8, "hbm_gib_per_chip": 95,
-            "bf16_peak_tflops_per_chip": 459},
+            "bf16_peak_tflops_per_chip": 459, "hbm_gbps_per_chip": 2765},
     "v6": {"vmem_per_core_bytes": 128 * 2**20, "mxu_shape": (256, 256),
            "vpu_lanes": 128, "vpu_sublanes": 8, "hbm_gib_per_chip": 32,
-           "bf16_peak_tflops_per_chip": 918},
+           "bf16_peak_tflops_per_chip": 918, "hbm_gbps_per_chip": 1640},
 }
 
 

@@ -1,71 +1,13 @@
-"""Tracing/profiling: JAX profiler wrapper + bracketed event logging.
+"""DEPRECATED SHIM — the one tracing surface lives in ``tpulab.obs``.
 
-The reference's tracing is cudaEvent kernel brackets plus ``[Tag]``
-print logging (SURVEY.md section 5.1, 5.5).  The TPU-native stack:
-
-* :func:`maybe_trace` — device-level tracing with the JAX profiler
-  (XLA op timeline, HBM usage); output loads in TensorBoard/Perfetto.
-* :class:`EventLog` — structured ``[tag]`` event records with wall
-  times, drop-in for the reference's bracketed prints but also
-  machine-readable (JSONL).
+Round 14 folded this module's device-profiling helpers into
+:mod:`tpulab.obs.profiler` so tpulab has exactly two documented tracing
+tiers under one package: the always-on host ring tracer
+(``tpulab.obs.tracer``) and the opt-in JAX device profiler + event log
+(``tpulab.obs.profiler``).  This file re-exports the old names so
+historical imports keep working; new code imports from ``tpulab.obs``.
 """
 
-from __future__ import annotations
+from tpulab.obs.profiler import EventLog, annotate, maybe_trace
 
-import contextlib
-import json
-import time
-from typing import Iterator, Optional
-
-
-@contextlib.contextmanager
-def maybe_trace(trace_dir: Optional[str]) -> Iterator[None]:
-    """JAX profiler trace when ``trace_dir`` is set; no-op otherwise."""
-    if not trace_dir:
-        yield
-        return
-    import jax
-
-    with jax.profiler.trace(trace_dir):
-        yield
-
-
-@contextlib.contextmanager
-def annotate(name: str) -> Iterator[None]:
-    """Named region visible in profiler timelines (TraceAnnotation)."""
-    import jax
-
-    with jax.profiler.TraceAnnotation(name):
-        yield
-
-
-class EventLog:
-    """Bracketed-tag event log (`[Experiment]`-style, reference
-    tester.py:197-293) with optional JSONL persistence."""
-
-    def __init__(self, path: Optional[str] = None, echo: bool = True):
-        self.path = path
-        self.echo = echo
-        self._fh = open(path, "a") if path else None
-
-    def event(self, tag: str, message: str = "", **fields) -> None:
-        rec = {"t": time.time(), "tag": tag, "message": message, **fields}
-        if self.echo:
-            extra = " ".join(f"{k}={v}" for k, v in fields.items())
-            print(f"[{tag}] {message}{(' ' + extra) if extra else ''}")
-        if self._fh:
-            self._fh.write(json.dumps(rec) + "\n")
-            self._fh.flush()
-
-    @contextlib.contextmanager
-    def timed(self, tag: str, message: str = "") -> Iterator[None]:
-        t0 = time.perf_counter()
-        try:
-            yield
-        finally:
-            self.event(tag, message, elapsed_ms=round((time.perf_counter() - t0) * 1e3, 3))
-
-    def close(self) -> None:
-        if self._fh:
-            self._fh.close()
-            self._fh = None
+__all__ = ["EventLog", "annotate", "maybe_trace"]

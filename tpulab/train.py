@@ -17,7 +17,7 @@ this module supplies the real thing for the model tier:
   that produced the first NaN (the TPU stand-in for compute-sanitizer,
   SURVEY.md section 5.2).
 * **Tracing** — ``--trace-dir`` wraps the loop in the JAX profiler
-  (``tpulab.runtime.trace``); view with TensorBoard or Perfetto.
+  (``tpulab.obs.profiler``); view with TensorBoard or Perfetto.
 
 Data: a deterministic synthetic byte corpus (seeded permutation of a
 repeated byte pattern) — self-contained like the reference's synthetic
@@ -351,7 +351,7 @@ def train(
         )
 
     from tpulab.parallel.mesh import make_mesh
-    from tpulab.runtime.trace import maybe_trace
+    from tpulab.obs import maybe_trace  # the one tracing surface
 
     # native-loader registry (train/eval streams): closed in the finally
     # below so worker threads and fds never outlive the loop
@@ -685,6 +685,7 @@ def train(
     # each eval/save barrier and at the end of the run.
     from tpulab.obs import TRACER as _trace
     from tpulab.obs import histogram as _histogram
+    from tpulab.obs import roofline as _roofline
 
     _h_dispatch = _histogram(
         "train_dispatch_seconds",
@@ -692,6 +693,22 @@ def train(
     _h_loss_lag = _histogram(
         "train_loss_lag_seconds",
         "dispatch -> drained loss finiteness check, per block")
+    # train MFU (round 14): analytic per-step matmul FLOPs (the shared
+    # tpulab.obs.roofline implementation, 3x-forward convention) over
+    # WALL time — accumulated per metrics window into the process
+    # ledger, published as the train_mfu gauge (0 on the CPU proxy:
+    # no meaningful peak).  Dispatched steps are counted at dispatch
+    # (replayed rollback steps included — they burned real FLOPs).
+    _step_flops = (3.0 * _roofline.labformer_fwd_flops(cfg, batch, seq)
+                   if model == "labformer" else 0.0)
+    _mfu = {"t0": time.perf_counter(), "steps": 0, "pct": 0.0}
+
+    def _note_mfu() -> None:
+        now = time.perf_counter()
+        _roofline.note_train_window(_step_flops * _mfu["steps"],
+                                    now - _mfu["t0"])
+        _mfu["t0"], _mfu["steps"] = now, 0
+        _mfu["pct"] = _roofline.update_mfu_gauges()["train_mfu"]
 
     def _metrics_line() -> str:
         # cumulative over the process (the registry is global by
@@ -701,7 +718,8 @@ def train(
                 f"dispatch_ms_p99={_h_dispatch.percentile(0.99) * 1e3:.2f} "
                 f"loss_lag_ms_p50={_h_loss_lag.percentile(0.5) * 1e3:.2f} "
                 f"loss_lag_ms_p99={_h_loss_lag.percentile(0.99) * 1e3:.2f} "
-                f"blocks={_h_dispatch.count}")
+                f"blocks={_h_dispatch.count} "
+                f"train_mfu_pct={_mfu['pct']}")
     if donate:
         # materialize the state trees as device-OWNED buffers ONCE: the
         # donated step aliases them in place forever after.  Host numpy
@@ -809,6 +827,7 @@ def train(
                             params, opt_state, block)
                         counters["fused_calls"] += 1
                 counters["dispatches"] += 1
+                _mfu["steps"] += k
                 _h_dispatch.observe(time.perf_counter() - t0)
                 pending.append((step, k, ldev, t0))
                 step += k
@@ -869,6 +888,7 @@ def train(
                 if (at_eval or at_save) and counters["dispatches"]:
                     # periodic observability line (eval/save cadence):
                     # dispatch/loss-lag percentiles from the registry
+                    _note_mfu()
                     log(_metrics_line())
     finally:
         for _ld in _box.values():
@@ -888,6 +908,7 @@ def train(
             f"fused_calls={counters['fused_calls']} "
             f"host_syncs={counters['host_syncs']} "
             f"steps_per_call={steps_per_call} overlap={overlap}")
+        _note_mfu()
         log(_metrics_line())
     if manager:
         manager.wait_until_finished()

@@ -1,0 +1,746 @@
+"""Telemetry over time, part 2: the alert rule engine
+(tpulab.obs.alerts), its fleet-health wiring, and the ops console
+rendering.
+
+Round-15 checklist covered here:
+  * the pending -> firing -> resolved state machine, ``for_s`` hold,
+    ``keep_firing_s`` flap hysteresis, pending cancellation;
+  * burn-rate arithmetic against hand-built histogram windows —
+    including the exact-threshold boundary and the two-window AND;
+  * threshold aggregate variants (gauge / ratio-with-zero-denominator
+    gating / rate / delta / windowed percentile), absence/staleness
+    rules, and probe-error containment;
+  * ``obs_alerts_*`` counters/gauges + tracer transition events +
+    page-severity flight-recorder bundles (and the bundle's firing-
+    alert set satellite + retention pruning hardening);
+  * the docs lint: every SHIPPED rule name and every ``obs_alerts_*``
+    metric has a docs/ARCHITECTURE.md entry;
+  * ``ReplicaHealth.note_alert`` (alert-wired SUSPECT: demote, hold,
+    release) and the daemon glue (``_ensure_replica_rules`` /
+    ``_apply_fleet_alerts`` / the ``alerts`` request);
+  * END-TO-END CHAOS: a scoped fault wedges one replica; the windowed
+    burn alert fires BEFORE the health machine's crash path runs, the
+    router steers placement off the suspect replica, the eventual
+    crash migrates the stream bit-identically, and the alert resolves
+    after recovery.
+"""
+
+import json
+import pathlib
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import tpulab.daemon as daemon_mod
+from tpulab import faults, obs, router
+from tpulab.models.generate import generate
+from tpulab.models.labformer import LabformerConfig
+from tpulab.models.paged import PagedEngine
+from tpulab.obs import alerts as A
+from tpulab.obs import history as H
+from tpulab.obs import flightrec
+from tpulab.obs.registry import Registry
+
+CFG = LabformerConfig(d_model=32, n_heads=4, n_layers=2, d_ff=64, max_seq=128)
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(scope="module")
+def trained(trained_small, trained_small_cfg):
+    assert CFG == trained_small_cfg  # shared-model drift fails loudly
+    return trained_small
+
+
+@pytest.fixture(autouse=True)
+def _injector_always_reset():
+    yield
+    faults.disable()
+
+
+def _cycle_prompt(p):
+    return (np.arange(p) % 7).astype(np.int32)
+
+
+class _FlagRule(A.Rule):
+    """Test rule driven by an external flag."""
+
+    def __init__(self, name="flag", **kw):
+        super().__init__(name, **kw)
+        self.active = False
+
+    def probe(self, ctx):
+        return self.active, 1.0 if self.active else 0.0, "flag"
+
+
+def _hist_with_samples(n=2, t0=0.0, dt=1.0):
+    reg = Registry()
+    hist = H.MetricsHistory(64)
+    for i in range(n):
+        hist.sample(reg, now=t0 + i * dt)
+    return hist
+
+
+# -------------------------------------------------------- state machine
+def test_state_machine_pending_firing_resolved():
+    hist = _hist_with_samples()
+    r = _FlagRule(for_s=2.0, keep_firing_s=3.0)
+    m = A.AlertManager([r])
+    m.evaluate(hist, now=10.0)
+    assert m.get_state("flag").state == A.OK
+    r.active = True
+    tr = m.evaluate(hist, now=11.0)
+    assert tr == [{"rule": "flag", "from": A.OK, "to": A.PENDING}]
+    m.evaluate(hist, now=12.0)  # 1s held < for_s
+    assert m.get_state("flag").state == A.PENDING
+    tr = m.evaluate(hist, now=13.0)  # held 2s == for_s -> fires
+    assert tr == [{"rule": "flag", "from": A.PENDING, "to": A.FIRING}]
+    st = m.get_state("flag")
+    assert st.fired_at == 13.0 and st.fires == 1
+    # condition clears: firing HOLDS through keep_firing_s...
+    r.active = False
+    m.evaluate(hist, now=14.0)
+    assert m.get_state("flag").state == A.FIRING
+    # ...a flap back to active resets the clear timer (hysteresis)
+    r.active = True
+    m.evaluate(hist, now=15.0)
+    r.active = False
+    m.evaluate(hist, now=17.0)
+    assert m.get_state("flag").state == A.FIRING  # only 2s clear
+    tr = m.evaluate(hist, now=20.0)  # 3s continuously clear
+    assert tr == [{"rule": "flag", "from": A.FIRING, "to": A.RESOLVED}]
+    # resolved is sticky until the next activation
+    m.evaluate(hist, now=21.0)
+    assert m.get_state("flag").state == A.RESOLVED
+    r.active = True
+    m.evaluate(hist, now=22.0)
+    assert m.get_state("flag").state == A.PENDING
+
+
+def test_pending_cancels_without_firing():
+    hist = _hist_with_samples()
+    r = _FlagRule(for_s=5.0)
+    m = A.AlertManager([r])
+    r.active = True
+    m.evaluate(hist, now=0.0)
+    r.active = False
+    tr = m.evaluate(hist, now=1.0)
+    assert tr == [{"rule": "flag", "from": A.PENDING, "to": A.OK}]
+    assert m.get_state("flag").fires == 0
+
+
+def test_for_s_zero_fires_in_one_pass_and_counters_move():
+    hist = _hist_with_samples()
+    r = _FlagRule(for_s=0.0, keep_firing_s=0.0)
+    m = A.AlertManager([r])
+    fired0 = A.C_FIRED.value
+    resolved0 = A.C_RESOLVED.value
+    prior = obs.TRACER.capacity
+    try:
+        obs.configure_tracer(1 << 10)
+        r.active = True
+        tr = m.evaluate(hist, now=0.0)
+        assert tr == [{"rule": "flag", "from": A.OK, "to": A.FIRING}]
+        assert A.C_FIRED.value == fired0 + 1
+        assert A.G_FIRING.value == 1
+        r.active = False
+        m.evaluate(hist, now=1.0)
+        assert A.C_RESOLVED.value == resolved0 + 1
+        assert A.G_FIRING.value == 0
+        names = [e["name"] for e in
+                 obs.TRACER.chrome_trace()["traceEvents"]]
+        assert "alert.firing" in names and "alert.resolved" in names
+    finally:
+        obs.configure_tracer(prior)
+
+
+def test_probe_error_contained_in_detail():
+    class Broken(A.Rule):
+        def probe(self, ctx):
+            raise RuntimeError("kaput")
+
+    hist = _hist_with_samples()
+    m = A.AlertManager([Broken("broken"), _FlagRule()])
+    m.evaluate(hist, now=0.0)  # does not raise
+    row = [r for r in m.snapshot()["alerts"] if r["rule"] == "broken"][0]
+    assert "kaput" in row["detail"] and row["state"] == A.OK
+
+
+# ---------------------------------------------------- burn-rate windows
+def _burn_hist(bad_long, good_long, bad_short, good_short,
+               budget=0.1):
+    """History whose 60s window holds long+short counts and whose 15s
+    window holds only the short counts ('bad' observations land at
+    4x budget, 'good' at budget/2).  The middle sample sits at EXACTLY
+    t = 60 - 15: the short window's base resolves to a sample on its
+    precise boundary — the window-boundary arithmetic the round-15
+    checklist calls out."""
+    reg = Registry()
+    h = reg.histogram("ttft_seconds", buckets=(budget, 2 * budget,
+                                               8 * budget))
+    hist = H.MetricsHistory(64)
+    hist.sample(reg, now=0.0)      # base of the 60s window
+    for _ in range(good_long):
+        h.observe(budget / 2)
+    for _ in range(bad_long):
+        h.observe(budget * 4)
+    hist.sample(reg, now=45.0)     # base of the 15s window, exactly
+    for _ in range(good_short):
+        h.observe(budget / 2)
+    for _ in range(bad_short):
+        h.observe(budget * 4)
+    hist.sample(reg, now=60.0)     # newest edge
+    return hist
+
+
+def test_burn_rate_arithmetic_exact():
+    # long window: 60 obs, 10 bad -> err 1/6; short: 15 obs, 5 bad
+    hist = _burn_hist(bad_long=5, good_long=40, bad_short=5,
+                      good_short=10)
+    r = A.BurnRateRule("b", objective=0.9, metric="ttft_seconds",
+                       budget_s=0.1, long_s=60, short_s=15, burn=1.0)
+    ctx = A._Ctx(hist, 60.0)
+    bl, bs, nl, ns = r.burn_rates(ctx)
+    assert nl == 60 and ns == 15
+    assert bl == pytest.approx((10 / 60) / 0.1)
+    assert bs == pytest.approx((5 / 15) / 0.1)
+
+
+def test_burn_rate_two_window_and_gate():
+    # long window burns, short window is CLEAN -> must not fire (the
+    # incident is over; don't page on the long tail)
+    hist = _burn_hist(bad_long=30, good_long=0, bad_short=0,
+                      good_short=20)
+    r = A.BurnRateRule("b", objective=0.9, metric="ttft_seconds",
+                       budget_s=0.1, long_s=60, short_s=15, burn=2.0,
+                       for_s=0)
+    m = A.AlertManager([r])
+    m.evaluate(hist, now=60.0)
+    assert m.get_state("b").state == A.OK
+    # both windows burning -> fires
+    hist = _burn_hist(bad_long=10, good_long=10, bad_short=10,
+                      good_short=0)
+    r2 = A.BurnRateRule("b2", objective=0.9, metric="ttft_seconds",
+                        budget_s=0.1, long_s=60, short_s=15, burn=2.0,
+                        for_s=0)
+    m2 = A.AlertManager([r2])
+    m2.evaluate(hist, now=60.0)
+    assert m2.get_state("b2").state == A.FIRING
+
+
+def test_burn_rate_exact_threshold_boundary_fires():
+    """burn == threshold is >= — firing at exactly the configured
+    rate, not one observation past it."""
+    # err 0.2 of budget 0.1 -> burn exactly 2.0 in both windows
+    hist = _burn_hist(bad_long=2, good_long=8, bad_short=2,
+                      good_short=8)
+    r = A.BurnRateRule("b", objective=0.9, metric="ttft_seconds",
+                       budget_s=0.1, long_s=60, short_s=15, burn=2.0,
+                       for_s=0)
+    ctx = A._Ctx(hist, 60.0)
+    bl, bs, _, _ = r.burn_rates(ctx)
+    assert bl == pytest.approx(2.0) and bs == pytest.approx(2.0)
+    active, _, _ = r.probe(ctx)
+    assert active
+
+
+def test_burn_rate_empty_window_never_fires():
+    hist = _hist_with_samples(n=3, dt=30.0)
+    r = A.BurnRateRule("b", objective=0.99, metric="ttft_seconds",
+                       budget_s=0.1, long_s=60, short_s=15, burn=1.0)
+    active, _, detail = r.probe(A._Ctx(hist, 60.0))
+    assert not active  # no traffic burns no budget
+
+
+def test_burn_rate_ratio_mode():
+    reg = Registry()
+    bad = reg.counter("daemon_shed_requests")
+    good = reg.counter("engine_requests_done")
+    hist = H.MetricsHistory(64)
+    hist.sample(reg, now=0.0)
+    bad.inc(5)
+    good.inc(5)
+    hist.sample(reg, now=45.0)  # the 15s window's base, exactly
+    bad.inc(5)
+    good.inc(5)
+    hist.sample(reg, now=60.0)
+    r = A.BurnRateRule("shed", objective=0.9,
+                       bad_metric="daemon_shed_requests",
+                       good_metric="engine_requests_done",
+                       long_s=60, short_s=15, burn=2.0)
+    bl, bs, nl, ns = r.burn_rates(A._Ctx(hist, 60.0))
+    assert bl == pytest.approx(0.5 / 0.1) and bs == pytest.approx(5.0)
+    assert nl == 20 and ns == 10
+
+
+def test_burn_rate_validation():
+    with pytest.raises(ValueError, match="exactly one"):
+        A.BurnRateRule("x", metric="m", budget_s=1,
+                       bad_metric="b", good_metric="g")
+    with pytest.raises(ValueError, match="short_s"):
+        A.BurnRateRule("x", metric="m", budget_s=1, long_s=10,
+                       short_s=10)
+    with pytest.raises(ValueError, match="objective"):
+        A.BurnRateRule("x", metric="m", budget_s=1, objective=1.0)
+
+
+# ----------------------------------------------------- threshold rules
+def test_threshold_agg_variants():
+    reg = Registry()
+    reg.gauge("g").set(10.0)
+    reg.gauge("lim").set(0.0)
+    c = reg.counter("c")
+    h = reg.histogram("lat_seconds", buckets=(0.1, 0.2, 0.4))
+    hist = H.MetricsHistory(8)
+    hist.sample(reg, now=0.0)
+    c.inc(30)
+    h.observe(0.3)
+    h.observe(0.3)
+    hist.sample(reg, now=10.0)
+    ctx = A._Ctx(hist, 10.0)
+    assert A.ThresholdRule("a", "g", ">", 5).probe(ctx)[0]
+    # gauge ratio with zero denominator: INACTIVE, not div-by-zero —
+    # the CPU proxy publishes engine_hbm_bytes_limit=0
+    active, v, detail = A.ThresholdRule(
+        "b", "g", ">", 0.5, denom_metric="lim").probe(ctx)
+    assert not active and v is None and "n/a" in detail
+    assert A.ThresholdRule("c1", "c", ">", 2.0, agg="rate",
+                           window_s=10).probe(ctx)[0]
+    assert A.ThresholdRule("d", "c", ">=", 30, agg="delta",
+                           window_s=10).probe(ctx)[0]
+    active, v, _ = A.ThresholdRule("e", "lat_seconds", ">", 0.2,
+                                   agg="p99", window_s=10).probe(ctx)
+    assert active and 0.2 < v <= 0.4
+    # under min_count the percentile aggregate stays inactive
+    assert not A.ThresholdRule("f", "lat_seconds", ">", 0.0, agg="p99",
+                               window_s=10, min_count=5).probe(ctx)[0]
+    with pytest.raises(ValueError, match="agg"):
+        A.ThresholdRule("x", "g", ">", 1, agg="median")
+    with pytest.raises(ValueError, match="op"):
+        A.ThresholdRule("x", "g", "!=", 1)
+
+
+def test_absence_and_staleness_rules():
+    reg = Registry()
+    c = reg.counter("heartbeat")
+    hist = H.MetricsHistory(64)
+    c.inc()
+    for i in range(6):
+        hist.sample(reg, now=float(i))
+    ctx = A._Ctx(hist, 5.0)
+    assert A.AbsenceRule("gone", "never_registered").probe(ctx)[0]
+    assert not A.AbsenceRule("here", "heartbeat").probe(ctx)[0]
+    # unchanged for 5s with stale_s=3 and the ring spanning enough
+    active, age, _ = A.AbsenceRule("stale", "heartbeat",
+                                   stale_s=3.0).probe(ctx)
+    assert active and age == pytest.approx(5.0)
+    # a change inside the threshold resets the clock
+    c.inc()
+    hist.sample(reg, now=6.0)
+    assert not A.AbsenceRule("stale", "heartbeat",
+                             stale_s=3.0).probe(A._Ctx(hist, 6.0))[0]
+    # ring too short to prove staleness: inactive
+    short = H.MetricsHistory(64)
+    short.sample(reg, now=0.0)
+    short.sample(reg, now=1.0)
+    assert not A.AbsenceRule("stale", "heartbeat",
+                             stale_s=3.0).probe(A._Ctx(short, 1.0))[0]
+
+
+def test_sampler_stale_rule():
+    hist = _hist_with_samples(n=1, t0=100.0)
+    hist.interval_s = 1.0
+    r = A.SamplerStaleRule(max_age_s=30.0, age_intervals=10.0)
+    active, age, _ = r.probe(A._Ctx(hist, 105.0))
+    assert not active
+    active, age, _ = r.probe(A._Ctx(hist, 115.0))  # 15s > 10*1s
+    assert active and age == pytest.approx(15.0)
+
+
+# ------------------------------------------- page bundles + flight rec
+def test_page_alert_records_postmortem_with_alert_row(tmp_path):
+    flightrec.configure_flightrec(tmp_path)
+    try:
+        hist = _hist_with_samples()
+        r = _FlagRule("page_probe", severity="page", for_s=0)
+        m = A.AlertManager([r], page_postmortems=True)
+        r.active = True
+        m.evaluate(hist, now=0.0)
+        bundles = flightrec.list_bundles()
+        assert len(bundles) == 1
+        b = json.loads(bundles[0].read_text())
+        assert b["reason"] == "alert_page:page_probe"
+        assert b["extra"]["alert"]["rule"] == "page_probe"
+        # without the opt-in, no bundle (the default for library users)
+        m2 = A.AlertManager([_FlagRule("quiet", severity="page")])
+        m2._rules["quiet"].active = True
+        m2.evaluate(hist, now=0.0)
+        assert len(flightrec.list_bundles()) == 1
+    finally:
+        flightrec.configure_flightrec(None)
+
+
+def test_postmortem_bundle_carries_global_firing_set(tmp_path):
+    """The round-15 flight-recorder satellite: every crash bundle
+    snapshots what was ALREADY alerting when the process died."""
+    flightrec.configure_flightrec(tmp_path)
+    r = _FlagRule("already_burning", severity="warn", for_s=0)
+    obs.ALERTS.add(r, replace=True)
+    try:
+        r.active = True
+        obs.ALERTS.evaluate(_hist_with_samples(), now=0.0)
+        path = flightrec.record_postmortem("test_crash",
+                                           err=RuntimeError("boom"))
+        b = json.loads(path.read_text())
+        assert [a["rule"] for a in b["alerts"]] == ["already_burning"]
+    finally:
+        obs.ALERTS.remove("already_burning")
+        flightrec.configure_flightrec(None)
+
+
+def test_retention_prunes_oldest_first_and_never_raises(tmp_path,
+                                                        monkeypatch):
+    flightrec.configure_flightrec(tmp_path)
+    try:
+        for i in range(6):
+            (tmp_path / f"postmortem_{1000 + i}_1_{i:04d}.json"
+             ).write_text("{}")
+        removed = flightrec.prune(keep=3)
+        assert removed == 3
+        left = [p.name for p in flightrec.list_bundles()]
+        # newest three survive (list_bundles is newest-first)
+        assert left == [f"postmortem_{1000 + i}_1_{i:04d}.json"
+                        for i in (5, 4, 3)]
+        # unlink failures are tolerated, and the count stays honest
+        monkeypatch.setattr(pathlib.Path, "unlink",
+                            lambda self: (_ for _ in ()).throw(
+                                OSError("ro")))
+        assert flightrec.prune(keep=0) == 0
+        assert len(flightrec.list_bundles()) == 3
+        monkeypatch.undo()
+        # record_postmortem itself keeps the bound
+        monkeypatch.setattr(flightrec, "KEEP", 2)
+        p = flightrec.record_postmortem("bounded")
+        assert p is not None and len(flightrec.list_bundles()) == 2
+    finally:
+        flightrec.configure_flightrec(None)
+
+
+# ------------------------------------------------------------ the lint
+def test_every_shipped_rule_and_alert_metric_documented():
+    docs = (ROOT / "docs" / "ARCHITECTURE.md").read_text()
+    for rule in A.default_rules():
+        assert f"`{rule.name}`" in docs, (
+            f"shipped alert rule {rule.name!r} has no "
+            f"docs/ARCHITECTURE.md entry")
+    # the per-replica dynamic rule documents its base name
+    assert "`replica_degraded`" in docs
+    for metric in ("obs_alerts_evals", "obs_alerts_fired",
+                   "obs_alerts_resolved", "obs_alerts_firing",
+                   "obs_alerts_pending"):
+        assert obs.REGISTRY.get(metric) is not None, metric
+        assert f"`{metric}`" in docs, (
+            f"alert-engine metric {metric!r} has no docs entry")
+    # shipped severities are the documented vocabulary
+    assert all(r.severity in A.SEVERITIES for r in A.default_rules())
+
+
+# --------------------------------------------- router note_alert wiring
+def test_replica_health_note_alert_demotes_holds_releases():
+    h = router.ReplicaHealth(slow_tick_s=0.1, suspect_after=3,
+                             recover_after=2)
+    h.note_alert(True)
+    assert h.state == router.SUSPECT and h.suspects == 1
+    # fast ticks do NOT promote while the alert holds
+    h.note_tick(0.01)
+    h.note_tick(0.01)
+    h.note_tick(0.01)
+    assert h.state == router.SUSPECT
+    # release: the normal hysteresis finishes recovery
+    h.note_alert(False)
+    h.note_tick(0.01)
+    assert h.state == router.SUSPECT  # streak restarted at release
+    h.note_tick(0.01)
+    assert h.state == router.HEALTHY
+    # crash/rebuild lifecycle clears the hold
+    h.note_alert(True)
+    h.note_crash()
+    h.note_rebuild_start()
+    h.note_rebuilt()
+    assert h.state == router.HEALTHY and not h.alert_firing
+    assert h.snapshot()["alert_firing"] is False
+
+
+# --------------------------------------------------- daemon glue + wire
+def test_daemon_alerts_request_evaluates_and_reports():
+    from tpulab.daemon import handle_request
+
+    r = _FlagRule("wire_probe", for_s=0)
+    obs.ALERTS.add(r, replace=True)
+    try:
+        r.active = True
+        snap = json.loads(handle_request({"lab": "alerts"}, b""))
+        row = [x for x in snap["alerts"]
+               if x["rule"] == "wire_probe"][0]
+        assert row["state"] == A.FIRING  # the request evaluated
+        assert snap["firing"] >= 1
+        # no_evaluate returns the table as-is
+        r.active = False
+        snap2 = json.loads(handle_request(
+            {"lab": "alerts", "config": {"no_evaluate": True}}, b""))
+        row2 = [x for x in snap2["alerts"]
+                if x["rule"] == "wire_probe"][0]
+        assert row2["state"] == A.FIRING  # unchanged without evaluate
+    finally:
+        obs.ALERTS.remove("wire_probe")
+
+
+def test_ensure_replica_rules_and_apply(trained):
+    svc = daemon_mod._FleetService()
+    fleet = daemon_mod._make_fleet(
+        lambda: (PagedEngine(trained, CFG, slots=2, n_blocks=32,
+                             block_size=8, max_seq=64), None), 2)
+    key = ("alerts-glue-test",)
+    daemon_mod._FLEETS[key] = (None, fleet)
+    f = fleet.fid
+    try:
+        daemon_mod._ensure_replica_rules()
+        names = {r.name for r in obs.ALERTS.rules}
+        # rules are FLEET-scoped: two warm fleets' same-index replicas
+        # must never share a degradation verdict
+        assert {f"fleet{f}_replica0_degraded",
+                f"fleet{f}_replica1_degraded"} <= names
+        # force replica1's alert FIRING and apply -> SUSPECT
+        st = obs.ALERTS.get_state(f"fleet{f}_replica1_degraded")
+        st.state = A.FIRING
+        daemon_mod._apply_fleet_alerts()
+        with fleet.cv:
+            assert fleet.replicas[1].health.state == router.SUSPECT
+            assert fleet.replicas[0].health.state == router.HEALTHY
+        st.state = A.RESOLVED
+        daemon_mod._apply_fleet_alerts()
+        with fleet.cv:
+            assert not fleet.replicas[1].health.alert_firing
+    finally:
+        daemon_mod._FLEETS.pop(key, None)
+        obs.ALERTS.remove(f"fleet{f}_replica0_degraded")
+        obs.ALERTS.remove(f"fleet{f}_replica1_degraded")
+
+
+# -------------------------------------------------------- console/render
+def test_render_single_engine_no_fleet_and_sparkline():
+    from tpulab.obs import render as R
+
+    reg = Registry()
+    reg.gauge("engine_ticks").set(12)
+    reg.gauge("engine_tokens_out").set(40)
+    reg.gauge("engine_requests_done").set(3)
+    metrics = R.parse_prometheus(reg.render())
+    # no fleet + engine gauges: the single-engine row (the obs_report
+    # satellite — no per-replica assumption anywhere)
+    txt = R.format_fleet({"replicas": 0, "replica": []}, metrics)
+    assert "engine (no fleet)" in txt and "tokens_out=40" in txt
+    assert "-" in txt  # absent gauges render as dashes, not KeyError
+    assert "none warm" in R.format_fleet(None, {})
+    # a fleet row missing per-replica load fields renders dashes
+    txt = R.format_fleet({"replicas": 1, "replica": [
+        {"replica": 0, "health": "rebuilding", "dead": True}]})
+    assert "pending=-" in txt and "dead" in txt
+    assert R.sparkline([], 8) == " " * 8
+    s = R.sparkline([0, 1, 2, 4], 4)
+    assert len(s) == 4 and s[-1] == "█" and s[0] == " "
+    assert len(R.sparkline(list(range(100)), 16)) == 16
+
+
+def test_console_frame_renders_all_sections():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "obs_console", ROOT / "tools" / "obs_console.py")
+    con = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(con)
+    reg = Registry()
+    h = reg.histogram("ttft_seconds", buckets=(0.01, 0.1))
+    h.observe(0.05)
+    scr = {
+        "metrics": reg.render(),
+        "fleet": {"replicas": 1, "replica": [
+            {"replica": 0, "health": "healthy", "pending": 0,
+             "active": 1, "requests_done": 5, "generation": 0,
+             "restarts": 0, "parked": 0}]},
+        "history": {"samples": 3, "capacity": 900,
+                    "sampler": {"running": True, "interval_s": 1.0},
+                    "window": {"seconds": 30.0, "rates": {},
+                               "histograms": {"ttft_seconds": {
+                                   "count": 1, "p50_ms": 50.0,
+                                   "p90_ms": 50.0, "p99_ms": 50.0}}},
+                    "series": {"engine_tokens_out": [[-1.0, 3.0],
+                                                     [0.0, 5.0]]}},
+        "alerts": {"rules": 2, "firing": 1, "pending": 0, "alerts": [
+            {"rule": "ttft_burn_fast", "severity": "page",
+             "state": "firing", "value": 20.0, "detail": "burning",
+             "fires": 1, "firing_for_s": 12.0},
+            {"rule": "sampler_stale", "severity": "warn",
+             "state": "ok", "value": 0.1, "detail": "", "fires": 0}]},
+        "slowlog": {"recorded": 1, "worst": [
+            {"rid": 7, "tag": "t", "e2e_ms": 9.0, "ttft_ms": 1.0,
+             "itl_max_ms": 2.0, "itl_max_at_token": 3,
+             "queue_wait_ms": 0.1, "prefill_chunks": 1,
+             "tokens": 8}]},
+    }
+    frame = con.render_frame(scr)
+    for needle in ("ops console", "ttft_seconds", "replica0",
+                   "ttft_burn_fast", "firing", "history:", "rid=7",
+                   "tokens_out"):
+        assert needle in frame, needle
+    # degraded daemon: every surface None still renders a frame
+    frame = con.render_frame({"metrics": None, "errors": ["metrics: x"]})
+    assert "unavailable" in frame and "scrape errors" in frame
+
+
+# ----------------------------------------------------- end-to-end chaos
+def _quiesce(fleet, timeout=60):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        busy = False
+        for r in fleet.replicas:
+            with r.cond:
+                eng = r.engine
+                if (r.dead or r.stepper_alive or eng.pending
+                        or eng.inflight_depth
+                        or any(a is not None for a in eng.active)):
+                    busy = True
+            with fleet.cv:
+                if r.health.state in (router.QUARANTINED,
+                                      router.REBUILDING):
+                    busy = True
+        if not busy:
+            return
+        time.sleep(0.02)
+    raise AssertionError("fleet never quiesced")
+
+
+def test_chaos_alert_fires_before_crash_steers_then_resolves(trained):
+    """THE round-15 acceptance: a scoped fault wedges replica1 (slow
+    ticks), the windowed replica-degradation alert fires while the
+    replica is merely degraded — BEFORE the health machine's crash
+    path ever runs — placement steers off it, the eventual injected
+    crash migrates the stream bit-identically to a fault-free run, and
+    after recovery the alert resolves and the replica returns to
+    placement."""
+    svc = daemon_mod._FleetService()
+    fleet = daemon_mod._make_fleet(
+        lambda: (PagedEngine(trained, CFG, slots=2, n_blocks=32,
+                             block_size=8, max_seq=64), None), 2)
+    key = ("alerts-chaos-test",)
+    daemon_mod._FLEETS[key] = (None, fleet)
+    # tight windows so resolve happens inside the test: 2 s of tick
+    # evidence, >= 2 ticks, half slow; hold firing 0.3 s after clear
+    f = fleet.fid
+    rule1 = f"fleet{f}_replica1_degraded"
+    obs.ALERTS.add(A.ReplicaStallRule(1, fleet_id=f, window_s=2.0,
+                                      min_ticks=2, slow_frac=0.5,
+                                      for_s=0, keep_firing_s=0.3),
+                   replace=True)
+    obs.ALERTS.add(A.ReplicaStallRule(0, fleet_id=f, window_s=2.0,
+                                      min_ticks=2, slow_frac=0.5,
+                                      for_s=0, keep_firing_s=0.3),
+                   replace=True)
+    obs.HISTORY.clear()
+    prompt_a = _cycle_prompt(5)
+    prompt_b = _cycle_prompt(6)
+    # deterministic per-replica schedule: replica1's engine ticks run
+    # 300 ms slow (>= the router's 0.25 s slow-tick threshold, so each
+    # one is ALSO windowed slow-tick evidence) for its first 10 ticks,
+    # then its 12th tick CRASHES.  replica0 is untouched.
+    faults.configure([
+        {"site": "paged.tick@replica1", "kind": "slow_ms", "at": 1,
+         "count": 10, "arg": 300.0},
+        {"site": "paged.tick@replica1", "kind": "raise", "at": 12},
+    ])
+    results = {}
+
+    def run(name, prompt, steps):
+        results[name] = svc.generate(fleet, prompt, steps)
+
+    ta = threading.Thread(target=run, args=("a", prompt_a, 40))
+    ta.start()
+    # wait until replica0 is busy so the next request places on 1
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        with fleet.replicas[0].cond:
+            if any(x is not None for x in
+                   fleet.replicas[0].engine.active):
+                break
+        time.sleep(0.005)
+    tb = threading.Thread(target=run, args=("b", prompt_b, 24))
+    tb.start()
+    # the sampler loop (what the daemon's _HistorySampler does), driven
+    # here for determinism: sample -> evaluate -> apply to fleet health
+    fired_at = None
+    crashes_at_fire = None
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        obs.HISTORY.sample()
+        obs.ALERTS.evaluate(obs.HISTORY)
+        daemon_mod._apply_fleet_alerts()
+        st = obs.ALERTS.get_state(rule1)
+        if st is not None and st.state == A.FIRING:
+            fired_at = time.monotonic()
+            with fleet.cv:
+                crashes_at_fire = fleet.replicas[1].health.crashes
+                state_at_fire = fleet.replicas[1].health.state
+            break
+        time.sleep(0.1)
+    assert fired_at is not None, "degradation alert never fired"
+    # BEFORE the crash path: zero crashes when the alert fired, and the
+    # alert-wired SUSPECT demotion is in place
+    assert crashes_at_fire == 0
+    assert state_at_fire == router.SUSPECT
+    # placement steers off the suspect replica even for a prompt whose
+    # prefix lives there (non-SUSPECT is strictly preferred)
+    placed = svc._place(fleet, prompt_b)
+    assert placed is not None and placed.index == 0
+    # let the crash land and both requests finish — the migrated stream
+    # is bit-identical to a fault-free run
+    ta.join(timeout=120)
+    tb.join(timeout=120)
+    assert not ta.is_alive() and not tb.is_alive()
+    want_a = generate(trained, prompt_a[None, :], CFG, steps=40,
+                      temperature=0.0)[0]
+    want_b = generate(trained, prompt_b[None, :], CFG, steps=24,
+                      temperature=0.0)[0]
+    assert np.array_equal(results["a"], want_a)
+    assert np.array_equal(results["b"], want_b)
+    assert faults.INJECTOR.fired().get("paged.tick@replica1", 0) >= 11
+    with fleet.cv:
+        assert fleet.replicas[1].health.crashes == 1
+    faults.disable()
+    _quiesce(fleet)
+    # recovery: keep sampling until the alert resolves (slow ticks age
+    # out of the 2 s window) and the hold on replica1 releases
+    deadline = time.monotonic() + 30
+    resolved = False
+    while time.monotonic() < deadline:
+        obs.HISTORY.sample()
+        obs.ALERTS.evaluate(obs.HISTORY)
+        daemon_mod._apply_fleet_alerts()
+        st = obs.ALERTS.get_state(rule1)
+        if st.state in (A.RESOLVED, A.OK):
+            resolved = True
+            break
+        time.sleep(0.1)
+    assert resolved, "alert never resolved after recovery"
+    with fleet.cv:
+        assert fleet.replicas[1].health.placeable
+        assert not fleet.replicas[1].health.alert_firing
+    # replica1 is back in rotation: an idle fleet places on it once
+    # replica0 carries load again
+    out = svc.generate(fleet, prompt_b, 4)
+    assert len(out) == 4
+    _quiesce(fleet)
+    daemon_mod._FLEETS.pop(key, None)
+    obs.ALERTS.remove(f"fleet{f}_replica0_degraded")
+    obs.ALERTS.remove(rule1)
+    obs.HISTORY.clear()

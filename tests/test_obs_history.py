@@ -1,0 +1,418 @@
+"""Telemetry over time, part 1: the metrics history ring
+(tpulab.obs.history) and its daemon wiring.
+
+Round-15 checklist covered here:
+  * windowed histogram-bucket differencing — including counter resets
+    (a cleared registry / an evicted engine's re-zeroed gauge mirror:
+    the new counts ARE the delta) — so ``percentile_from_buckets``
+    works over "the last 30 s" instead of process lifetime;
+  * window selection at exact sample boundaries, windows longer than
+    the ring's span, wraparound, and the single-sample degenerate case;
+  * ``fraction_le`` (the SLO error-rate input) edge cases;
+  * the background :class:`~tpulab.obs.history.Sampler` (tick cadence,
+    error containment, stop);
+  * the daemon's ``history`` request and the WINDOWED shed signal —
+    ``_queue_wait_p99_ms`` reads a live-edged history window when the
+    sampler is active and decays past congestion, and falls back to
+    the legacy two-mark path (behavior-compatible) when not;
+  * standing contracts re-certified with the sampler RUNNING: engine
+    streams/stats bit-identical obs on/off, and the transfer-guard
+    flat-``h2d_ticks`` steady window.
+"""
+
+import json
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from tpulab import obs
+from tpulab.models.generate import generate
+from tpulab.models.labformer import LabformerConfig
+from tpulab.models.paged import PagedEngine
+from tpulab.obs import history as H
+from tpulab.obs.registry import Registry
+
+CFG = LabformerConfig(d_model=32, n_heads=4, n_layers=2, d_ff=64, max_seq=128)
+
+
+@pytest.fixture(scope="module")
+def trained(trained_small, trained_small_cfg):
+    assert CFG == trained_small_cfg  # shared-model drift fails loudly
+    return trained_small
+
+
+def _cycle_prompt(p):
+    return (np.arange(p) % 7).astype(np.int32)
+
+
+# ----------------------------------------------------------- delta math
+def test_counts_delta_basic_and_scratch_reuse():
+    out = H.counts_delta([5, 3, 1], [2, 3, 0])
+    assert out == [3, 0, 1]
+    # scratch reuse: same list object comes back, contents replaced
+    same = H.counts_delta([9, 9, 9], [1, 2, 3], out)
+    assert same is out and out == [8, 7, 6]
+
+
+def test_counts_delta_reset_rules():
+    # any bucket going backwards == restart: new counts ARE the delta
+    assert H.counts_delta([2, 0, 0], [5, 0, 0]) == [2, 0, 0]
+    assert H.counts_delta([7, 1, 0], [7, 2, 0]) == [7, 1, 0]
+    # absent-from-old (metric created inside the window) == reset
+    assert H.counts_delta([4, 4], None) == [4, 4]
+    # length mismatch (bucket layout changed) == reset, not ValueError
+    assert H.counts_delta([1, 2, 3], [1, 2]) == [1, 2, 3]
+
+
+def test_value_delta_reset_clamp():
+    assert H.value_delta(10.0, 4.0) == 6.0
+    assert H.value_delta(3.0, 7.0) == 3.0   # went backwards: restart
+    assert H.value_delta(3.0, None) == 3.0
+
+
+def test_fraction_le_edges():
+    bounds = (0.1, 0.2, 0.4)
+    # empty window: no observations -> no violations
+    assert H.fraction_le(bounds, [0, 0, 0, 0], 0.2) == 1.0
+    # all mass in one bucket, x at its exact upper boundary
+    assert H.fraction_le(bounds, [4, 0, 0, 0], 0.1) == 1.0
+    # interpolation inside the first bucket (lo=0)
+    assert H.fraction_le(bounds, [4, 0, 0, 0], 0.05) == pytest.approx(0.5)
+    # x below every bound with mass above it
+    assert H.fraction_le(bounds, [0, 4, 0, 0], 0.1) == 0.0
+    # interpolation inside an inner bucket
+    assert H.fraction_le(bounds, [2, 2, 0, 0], 0.15) == pytest.approx(
+        (2 + 2 * 0.5) / 4)
+    # overflow mass: x past the last finite bound clamps to 1.0
+    assert H.fraction_le(bounds, [0, 0, 0, 3], 0.4) == 1.0
+
+
+# ------------------------------------------------------------- the ring
+def _mk(capacity=8):
+    reg = Registry()
+    c = reg.counter("reqs")
+    h = reg.histogram("lat_seconds", buckets=(0.1, 0.2, 0.4))
+    g = reg.gauge("depth")
+    return reg, c, h, g, H.MetricsHistory(capacity)
+
+
+def test_ring_wraparound_keeps_newest():
+    reg, c, _, _, hist = _mk(capacity=4)
+    for i in range(7):
+        c.inc()
+        hist.sample(reg, now=float(i))
+    assert hist.samples == 4 and hist.total_samples == 7
+    times = [t for t, _ in hist.retained()]
+    assert times == [3.0, 4.0, 5.0, 6.0]  # oldest first, newest kept
+    assert hist.latest()[0] == 6.0
+
+
+def test_window_boundary_selection_is_exact():
+    reg, c, _, _, hist = _mk()
+    for i in range(8):
+        c.inc(2)
+        hist.sample(reg, now=float(i))
+    # newest sample t=7 is the end; target 7-3=4 hits a sample exactly
+    w = hist.window(3.0)
+    assert (w.t0, w.t1) == (4.0, 7.0)
+    assert w.delta("reqs") == 6 and w.rate("reqs") == pytest.approx(2.0)
+    # a window BETWEEN samples bases on the newest sample at/before it
+    w = hist.window(2.5)
+    assert w.t0 == 4.0  # 7-2.5=4.5 -> sample at 4.0
+    # longer than the ring's span: falls back to the oldest retained
+    w = hist.window(100.0)
+    assert w.t0 == 0.0 and w.delta("reqs") == 14
+
+
+def test_single_sample_window_and_empty():
+    reg, c, _, _, hist = _mk()
+    assert hist.window(10.0) is None
+    c.inc(5)
+    hist.sample(reg, now=1.0)
+    w = hist.window(10.0)
+    assert w.old is None and w.delta("reqs") == 5  # since-start view
+
+
+def test_histogram_differencing_across_reset():
+    """The engine-eviction / registry-restart case: bucket counts go
+    BACKWARDS between samples, and the window must report the new
+    life's counts instead of negative garbage."""
+    reg, _, h, _, hist = _mk()
+    for v in (0.05, 0.05, 0.3):
+        h.observe(v)
+    hist.sample(reg, now=1.0)
+    # a fresh registry under the same names == the evicted-engine shape
+    reg2 = Registry()
+    h2 = reg2.histogram("lat_seconds", buckets=(0.1, 0.2, 0.4))
+    reg2.counter("reqs").inc()
+    h2.observe(0.15)
+    hist.sample(reg2, now=2.0)
+    w = hist.window(1.0)
+    assert w.count("lat_seconds") == 1
+    assert w.percentile("lat_seconds", 0.5) == pytest.approx(0.15, abs=0.05)
+    assert w.delta("reqs") == 1  # counter reset-clamped, not negative
+
+
+def test_window_percentile_matches_direct_math():
+    reg, _, h, _, hist = _mk()
+    for v in (0.05,) * 10:
+        h.observe(v)
+    hist.sample(reg, now=0.0)
+    for v in (0.3,) * 10:  # only these land inside the window
+        h.observe(v)
+    hist.sample(reg, now=10.0)
+    w = hist.window(5.0)
+    assert w.count("lat_seconds") == 10
+    # all windowed mass in the (0.2, 0.4] bucket
+    assert 0.2 < w.percentile("lat_seconds", 0.5) <= 0.4
+    # lifetime percentile would say the p50 is in the first bucket —
+    # the whole point of windowing
+    assert h.percentile(0.5) <= 0.1
+    assert w.fraction_le("lat_seconds", 0.2) == 0.0
+
+
+def test_absent_metric_accessors_are_tolerant():
+    reg, c, _, _, hist = _mk()
+    c.inc()
+    hist.sample(reg, now=0.0)
+    hist.sample(reg, now=1.0)
+    w = hist.window(1.0)
+    assert w.delta("nope") == 0.0 and w.rate("nope") == 0.0
+    assert w.percentile("nope", 0.99) == 0.0
+    assert w.hist_delta("nope") is None
+    assert w.fraction_le("nope", 1.0) == 1.0
+    assert w.gauge("nope", default=7.0) == 7.0
+
+
+def test_series_rates_and_reset():
+    reg, c, _, _, hist = _mk()
+    for i, inc in enumerate((2, 2, 2, 2)):
+        c.inc(inc)
+        hist.sample(reg, now=float(i))
+    s = hist.series("reqs", 10.0, rate=True)
+    assert [v for _, v in s] == pytest.approx([2.0, 2.0, 2.0])
+    # restart mid-series: rate clamps to the new value, never negative
+    reg2 = Registry()
+    reg2.counter("reqs").inc(1)
+    hist.sample(reg2, now=4.0)
+    s = hist.series("reqs", 10.0, rate=True)
+    assert s[-1][1] == pytest.approx(1.0)
+    assert all(v >= 0 for _, v in s)
+
+
+def test_report_shape():
+    reg, c, h, _, hist = _mk()
+    c.inc(4)
+    h.observe(0.05)
+    hist.sample(reg, now=0.0)
+    c.inc(4)
+    h.observe(0.3)
+    hist.sample(reg, now=2.0)
+    rep = hist.report(2.0, series=["reqs"])
+    assert rep["samples"] == 2 and rep["capacity"] == 8
+    assert rep["window"]["rates"]["reqs"] == pytest.approx(2.0)
+    hrow = rep["window"]["histograms"]["lat_seconds"]
+    assert hrow["count"] == 1 and hrow["p99_ms"] > 100
+    assert rep["series"]["reqs"][-1][1] == pytest.approx(2.0)
+    json.dumps(rep)  # wire-serializable as-is
+
+
+def test_live_window_counts_post_sample_observations():
+    reg, _, h, _, hist = _mk()
+    hist.sample(reg, now=time.monotonic())
+    h.observe(0.3)  # lands AFTER the newest ring sample
+    w = hist.live_window(60.0, reg)
+    assert w.count("lat_seconds") == 1
+
+
+# ------------------------------------------------------------- sampler
+def test_sampler_thread_ticks_and_stops():
+    reg = Registry()
+    reg.counter("x").inc()
+    hist = H.MetricsHistory(16)
+    hooks = {"n": 0}
+
+    def boom():
+        hooks["n"] += 1
+        if hooks["n"] == 1:
+            raise RuntimeError("one bad tick")
+
+    s = H.Sampler(hist, 0.01, on_sample=boom, registry=reg)
+    s.start()
+    deadline = time.monotonic() + 5.0
+    while hist.total_samples < 3 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    s.stop()
+    assert hist.total_samples >= 3
+    assert s.errors >= 1 and hooks["n"] >= 3  # survived the bad tick
+    n = hist.total_samples
+    time.sleep(0.05)
+    assert hist.total_samples == n  # actually stopped
+    assert not s.running
+    with pytest.raises(ValueError, match="interval_s"):
+        H.Sampler(hist, 0.0)
+
+
+# ------------------------------------------------------- daemon wiring
+def test_daemon_history_request_reports_window():
+    from tpulab.daemon import handle_request
+
+    obs.HISTORY.clear()
+    try:
+        obs.REGISTRY.counter("hist_req_probe").inc(3)
+        obs.HISTORY.sample(now=time.monotonic() - 5.0)
+        obs.REGISTRY.counter("hist_req_probe").inc(3)
+        obs.HISTORY.sample()
+        rep = json.loads(handle_request(
+            {"lab": "history",
+             "config": {"seconds": 30, "series": ["hist_req_probe"]}},
+            b""))
+        assert rep["samples"] == 2
+        assert rep["window"]["rates"]["hist_req_probe"] > 0
+        assert rep["series"]["hist_req_probe"]
+        assert rep["sampler"]["running"] is False  # none started here
+        with pytest.raises(ValueError, match="seconds"):
+            handle_request({"lab": "history",
+                            "config": {"seconds": -1}}, b"")
+    finally:
+        obs.HISTORY.clear()
+
+
+def test_shed_p99_uses_history_window_and_decays(monkeypatch):
+    """The round-15 shed upgrade: with an active sampler the
+    queue-wait p99 comes from a live-edged history window — old
+    congestion DECAYS out once it leaves the window — and without one
+    the legacy two-mark path still answers (behavior compatibility)."""
+    import tpulab.daemon as daemon_mod
+
+    svc = daemon_mod._GenerateService()
+    qw = obs.REGISTRY.histogram("queue_wait_seconds")
+    obs.HISTORY.clear()
+    monkeypatch.setattr(daemon_mod, "_sampler_active", lambda: True)
+    try:
+        # congestion BEFORE the window base: must not shed forever
+        for _ in range(50):
+            qw.observe(3.0)
+        obs.HISTORY.sample(
+            now=time.monotonic() - daemon_mod.QUEUE_WAIT_WINDOW_S - 5)
+        obs.HISTORY.sample()  # fresh edge: congestion is outside
+        assert svc._queue_wait_p99_ms() == 0.0
+        # fresh congestion INSIDE the window (after the newest sample:
+        # the live edge must see it without waiting for the sampler)
+        for _ in range(50):
+            qw.observe(1.0)
+        p99 = svc._queue_wait_p99_ms()
+        assert 500.0 <= p99 <= 2000.0
+    finally:
+        obs.HISTORY.clear()
+    # sampler inactive -> legacy marks path (fresh service: the first
+    # call primes the mark at current cumulative counts, so the old
+    # observations above are invisible — same decay discipline)
+    monkeypatch.setattr(daemon_mod, "_sampler_active", lambda: False)
+    svc2 = daemon_mod._GenerateService()
+    svc2.prime_queue_wait()
+    assert svc2._queue_wait_p99_ms() == 0.0
+
+
+def test_start_sampler_clamps_bad_capacity_and_zero_interval():
+    """TPULAB_DAEMON_HISTORY=0 (or any < 1) must degrade to the
+    smallest ring, not kill the daemon before it binds its socket;
+    interval 0 disables cleanly."""
+    import tpulab.daemon as daemon_mod
+    from tpulab.obs import alerts as A2
+
+    prior_cap = obs.HISTORY.capacity
+    assert daemon_mod.start_sampler(interval_s=0) is None
+    s = daemon_mod.start_sampler(interval_s=0.05, capacity=0)
+    try:
+        assert s is not None and s.running
+        assert obs.HISTORY.capacity == 1
+    finally:
+        daemon_mod.stop_sampler()
+        # start_sampler installed the default catalog + page bundles on
+        # the GLOBAL manager: restore a clean slate for later tests
+        A2.ALERTS.clear()
+        A2.ALERTS.page_postmortems = False
+        obs.configure_history(prior_cap)
+        obs.HISTORY.clear()
+
+
+def test_sampler_active_requires_fresh_samples(monkeypatch):
+    import tpulab.daemon as daemon_mod
+
+    class FakeSampler:
+        interval_s = 0.5
+        running = True
+
+    obs.HISTORY.clear()
+    monkeypatch.setattr(daemon_mod, "_SAMPLER", None)
+    assert not daemon_mod._sampler_active()
+    monkeypatch.setattr(daemon_mod, "_SAMPLER", FakeSampler())
+    assert not daemon_mod._sampler_active()  # no samples yet
+    obs.HISTORY.sample()
+    try:
+        assert daemon_mod._sampler_active()
+    finally:
+        obs.HISTORY.clear()
+
+
+@pytest.mark.slow
+def test_obs_history_overhead_bench_under_budget():
+    """The round-15 overhead A/B: obs + history sampler + full alert
+    catalog ON vs everything OFF, asserting the <3% budget internally
+    (wall-clock sensitive — slow tier; the committed baselines row
+    gates the CPU-proxy number round over round)."""
+    from tpulab.bench import bench_obs_history_overhead
+
+    row = bench_obs_history_overhead(reps=2)
+    assert row["metric"] == "obs_history_overhead_4slots_ticks_per_s"
+    assert row["value"] > 0 and row["off_ticks_per_s"] > 0
+    assert row["history_samples"] > 0 and row["alert_rules"] >= 10
+    assert "overhead_pct_best" in row
+
+
+# --------------------------------------- standing contracts, sampler ON
+def _run_wave(params, obs_on):
+    eng = PagedEngine(params, CFG, slots=2, n_blocks=32, block_size=8,
+                      max_seq=64, obs=obs_on)
+    r1 = eng.submit(_cycle_prompt(4), max_new=10)
+    r2 = eng.submit(_cycle_prompt(6), max_new=8, temperature=1.5, seed=3)
+    out = eng.run()
+    return (out[r1], out[r2]), eng.stats()
+
+
+def test_bit_equality_and_transfer_guard_with_sampler_running(trained):
+    """The obs on/off bit-equality AND the zero-transfer steady window,
+    re-certified while a real sampler thread hammers the registry at
+    10 ms cadence: history is a pure READER of state the hot paths
+    already write, so neither contract may move."""
+    hist = H.MetricsHistory(64)
+    s = H.Sampler(hist, 0.01).start()
+    try:
+        (a1, a2), st_on = _run_wave(trained, True)
+        (b1, b2), st_off = _run_wave(trained, False)
+        assert np.array_equal(a1, b1) and np.array_equal(a2, b2)
+        assert st_on == st_off
+        assert np.array_equal(a1, generate(
+            trained, _cycle_prompt(4)[None, :], CFG, steps=10,
+            temperature=0.0)[0])
+        eng = PagedEngine(trained, CFG, slots=2, n_blocks=32,
+                          block_size=8, max_seq=64, obs=True)
+        eng.submit(_cycle_prompt(4), max_new=30)
+        eng.submit(_cycle_prompt(5), max_new=30, repetition_penalty=4.0)
+        for _ in range(4):  # admission + compile outside the guard
+            eng.step()
+        before = eng.stats()
+        with jax.transfer_guard("disallow"):
+            for _ in range(8):
+                eng.step()
+        st = eng.stats()
+        assert st["ticks"] == before["ticks"] + 8
+        assert st["h2d_ticks"] == before["h2d_ticks"]
+        assert st["host_syncs"] == before["host_syncs"]
+        eng.run()
+        assert hist.total_samples > 0  # the sampler really ran
+    finally:
+        s.stop()

@@ -22,6 +22,18 @@ socket and issues the observability requests this layer added:
     (``--postmortem``: reason, error, engine stats at death, armed
     fault schedule, compile table, slow-log worst-N, trace-slice
     size — tpulab.obs.flightrec).
+  * ``alerts`` — the round-15 rule-engine state table (``--alerts``:
+    SLO burn rates, tripwires, staleness; firing first —
+    tpulab.obs.alerts).
+  * ``history`` — the metrics-history windowed report (``--history S``
+    to print rates + windowed percentiles over the last S seconds,
+    ``--history-out FILE`` to capture the raw JSON —
+    tpulab.obs.history; populated by the daemon's
+    ``--metrics-interval`` sampler).
+
+For a live-refresh view of all of the above, use the ops console
+(``tools/obs_console.py``) — it shares this tool's rendering through
+``tpulab/obs/render.py``.
 
 The summary table is the serving-metrics view production TPU serving
 comparisons report (PAPERS.md, arXiv:2605.25645): p50/p90/p99 TTFT,
@@ -46,7 +58,6 @@ from __future__ import annotations
 import argparse
 import json
 import pathlib
-import re
 import socket
 import struct
 import sys
@@ -55,7 +66,16 @@ ROOT = pathlib.Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(ROOT))
 
 from tpulab.loadgen import SHED_RE as _SHED_RE  # noqa: E402
-from tpulab.obs.registry import percentile_from_buckets  # noqa: E402
+# the shared rendering module (tpulab/obs/render.py — the round-15
+# dedup satellite): the percentile/parse/table code used to live here
+# and is now ONE copy this tool and tools/obs_console.py both import.
+# Re-exported under the historical names so existing consumers (tests,
+# capture scripts) keep working.
+from tpulab.obs.render import (LATENCY_METRICS as _LATENCY_METRICS,  # noqa: E402,F401
+                               format_alerts, format_fleet,
+                               format_history, format_latency_table,
+                               format_slowlog, histogram_percentile,
+                               parse_prometheus, summarize)
 
 #: _SHED_RE (tpulab.loadgen.SHED_RE — the ONE copy of the client-side
 #: shed contract): an error frame whose body matches is BACKPRESSURE,
@@ -65,16 +85,6 @@ from tpulab.obs.registry import percentile_from_buckets  # noqa: E402
 #: ``rebuilding retry_after_ms=N`` (the fleet's whole-fleet drain/
 #: rebuild park — e.g. mid rolling-restart), so a capture or drive
 #: riding :func:`request_with_retry` survives a rolling restart
-
-#: histograms the summary table reports, in display order
-_LATENCY_METRICS = ("ttft_seconds", "itl_seconds", "e2e_seconds",
-                    "queue_wait_seconds", "prefill_seconds")
-
-_BUCKET_RE = re.compile(
-    r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)_bucket\{le="(?P<le>[^"]+)"\}'
-    r"\s+(?P<v>\S+)$")
-_PLAIN_RE = re.compile(
-    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)\s+(?P<v>\S+)$")
 
 
 def request(sock_path: str, lab: str, config: dict | None = None,
@@ -161,68 +171,6 @@ def request_with_retry(sock_path: str, lab: str, config: dict | None = None,
                     raise ShedResponse(int(shed.group(2)), str(e)) from e
                 raise
             time.sleep(wait)
-
-
-def parse_prometheus(text: str) -> dict:
-    """Prometheus text -> {name: {"type", "value"|"buckets"/"sum"/
-    "count"}}.  ``buckets`` are (upper_bound, CUMULATIVE count) pairs in
-    exposition order, +Inf last — exactly what the text carries."""
-    out: dict = {}
-    for line in text.splitlines():
-        line = line.strip()
-        if not line:
-            continue
-        if line.startswith("# TYPE "):
-            _, _, name, mtype = line.split(None, 3)
-            out.setdefault(name, {"type": mtype})
-            continue
-        if line.startswith("#"):
-            continue
-        m = _BUCKET_RE.match(line)
-        if m:
-            h = out.setdefault(m["name"], {"type": "histogram"})
-            le = float("inf") if m["le"] == "+Inf" else float(m["le"])
-            h.setdefault("buckets", []).append((le, int(float(m["v"]))))
-            continue
-        m = _PLAIN_RE.match(line)
-        if not m:
-            raise ValueError(f"unparseable exposition line: {line!r}")
-        name, v = m["name"], float(m["v"])
-        if name.endswith("_sum"):
-            out.setdefault(name[:-4], {"type": "histogram"})["sum"] = v
-        elif name.endswith("_count"):
-            out.setdefault(name[:-6], {"type": "histogram"})["count"] = int(v)
-        else:
-            out.setdefault(name, {"type": "untyped"})["value"] = v
-    return out
-
-
-def histogram_percentile(metric: dict, q: float) -> float:
-    """Quantile estimate from scraped CUMULATIVE buckets (converts to
-    per-bucket counts and defers to the registry's shared rule)."""
-    pairs = metric.get("buckets") or []
-    if not pairs or pairs[-1][0] != float("inf"):
-        raise ValueError("histogram is missing its +Inf bucket")
-    bounds = tuple(le for le, _ in pairs[:-1])
-    cums = [c for _, c in pairs]
-    counts = [cums[0]] + [b - a for a, b in zip(cums, cums[1:])]
-    return percentile_from_buckets(bounds, counts, q)
-
-
-def summarize(metrics: dict) -> list:
-    rows = []
-    for name in _LATENCY_METRICS:
-        m = metrics.get(name)
-        if not m or m.get("type") != "histogram":
-            continue
-        rows.append({
-            "metric": name,
-            "count": m.get("count", 0),
-            "p50_ms": round(histogram_percentile(m, 0.50) * 1e3, 3),
-            "p90_ms": round(histogram_percentile(m, 0.90) * 1e3, 3),
-            "p99_ms": round(histogram_percentile(m, 0.99) * 1e3, 3),
-        })
-    return rows
 
 
 def format_roofline(payload: dict) -> str:
@@ -332,6 +280,18 @@ def main(argv=None) -> int:
                     help="also print the daemon's worst-N slow-log "
                          "entries (per-request span summaries; each "
                          "rid links to the trace_dump events)")
+    ap.add_argument("--alerts", action="store_true",
+                    help="also print the daemon's alert state table "
+                         "(tpulab.obs.alerts — SLO burn rates, "
+                         "tripwires, staleness; firing first)")
+    ap.add_argument("--history", type=float, default=0, metavar="S",
+                    help="also print the metrics-history windowed "
+                         "summary over the last S seconds (rates + "
+                         "windowed percentiles from the daemon's "
+                         "--metrics-interval sampler ring)")
+    ap.add_argument("--history-out", default=None, metavar="FILE",
+                    help="write the raw 'history' response JSON to "
+                         "FILE (the round-15 capture artifact)")
     ap.add_argument("--roofline", action="store_true",
                     help="also print the per-program compile/roofline "
                          "table (compile counts + seconds, FLOPs, "
@@ -373,6 +333,20 @@ def main(argv=None) -> int:
     if args.slowlog:
         slow = json.loads(request(args.socket, "slowlog",
                                   {"n": args.slowlog}))
+    alerts = None
+    if args.alerts:
+        alerts = json.loads(request(args.socket, "alerts"))
+    hist = None
+    if args.history or args.history_out:
+        hist = json.loads(request(
+            args.socket, "history",
+            {"seconds": args.history or 30.0,
+             "series": ["engine_tokens_out", "engine_requests_done"]}))
+        if args.history_out:
+            pathlib.Path(args.history_out).write_text(
+                json.dumps(hist, indent=1) + "\n")
+            print(f"[obs_report] history written to {args.history_out}",
+                  file=sys.stderr)
     roof = None
     if args.roofline:
         roof = json.loads(request(args.socket, "compile_stats"))
@@ -385,50 +359,27 @@ def main(argv=None) -> int:
             out["fleet"] = fleet
         if slow is not None:
             out["slowlog"] = slow.get("worst", [])
+        if alerts is not None:
+            out["alerts"] = alerts
+        if hist is not None:
+            out["history"] = hist
         if roof is not None:
             out["compile_stats"] = roof
         if pm is not None:
             out["postmortem"] = pm
         print(json.dumps(out))
         return 0
-    if not rows:
-        print("no latency histograms populated yet "
-              "(drive some generate traffic, or --drive N)")
-    else:
-        w = max(len(r["metric"]) for r in rows)
-        print(f"{'metric':<{w}}  {'count':>7}  {'p50_ms':>9}  "
-              f"{'p90_ms':>9}  {'p99_ms':>9}")
-        for r in rows:
-            print(f"{r['metric']:<{w}}  {r['count']:>7}  "
-                  f"{r['p50_ms']:>9.3f}  {r['p90_ms']:>9.3f}  "
-                  f"{r['p99_ms']:>9.3f}")
-    if fleet is not None and fleet.get("replicas"):
-        print(f"fleet: {fleet['replicas']} replica(s)")
-        for r in fleet.get("replica", []):
-            print(f"  replica{r['replica']} {r['health']:<11} "
-                  f"{'draining ' if r.get('draining') else ''}"
-                  f"pending={r.get('pending', '-')} "
-                  f"active={r.get('active', '-')} "
-                  f"done={r.get('requests_done', '-')} "
-                  f"gen={r.get('generation', 0)} "
-                  f"restarts={r.get('restarts', 0)}")
+    # the shared renderers (tpulab.obs.render) — format_fleet degrades
+    # gracefully on a single-engine/no-fleet daemon by synthesizing a
+    # row from the engine_* gauges instead of assuming replicas exist
+    print(format_latency_table(rows))
+    print(format_fleet(fleet, metrics))
+    if hist is not None and args.history:
+        print(format_history(hist))
+    if alerts is not None:
+        print(format_alerts(alerts))
     if slow is not None:
-        print(f"slowlog: worst {len(slow.get('worst', []))} of "
-              f"{slow.get('recorded', 0)} recorded")
-        for e in slow.get("worst", []):
-            hops = e.get("replica_hops") or []
-            where = ("replicas=" + ">".join(str(h) for h in hops)
-                     + f" first_tok@r{e.get('replica_first_token')} "
-                     f"migrations={e.get('migrations', 0)} "
-                     if hops else "")
-            print(f"  rid={e.get('rid')} tag={e.get('tag') or '-'} "
-                  f"e2e={e.get('e2e_ms')}ms ttft={e.get('ttft_ms')}ms "
-                  f"itl_max={e.get('itl_max_ms')}ms"
-                  f"@tok{e.get('itl_max_at_token')} "
-                  f"queue={e.get('queue_wait_ms')}ms "
-                  f"chunks={e.get('prefill_chunks')} "
-                  f"{where}"
-                  f"tokens={e.get('tokens')}")
+        print(format_slowlog(slow))
     if roof is not None:
         print(format_roofline(roof))
     if pm is not None:

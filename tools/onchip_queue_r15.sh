@@ -1,0 +1,173 @@
+#!/bin/bash
+# Round-15 sequential on-chip evidence queue (single chip -- no contention).
+#
+# Claim discipline (docs/tpu_runs.md + .claude/skills/verify): TPU-claiming
+# processes are WAITED on, never killed -- a killed claim wedges the relay
+# for every later process.  wait_relay comes from tools/relay_lib.sh.
+#
+# Round-15 ordering: the TELEMETRY-OVER-TIME evidence lands FIRST and is
+# HOST-ONLY (CPU backend, private spawned daemons), so a wedged relay
+# cannot block the round's headline evidence:
+#   * obs_time_fast: tests/test_obs_history.py + tests/test_obs_alerts.py
+#     -- the history ring's windowed bucket differencing (counter resets
+#     included), burn-rate window arithmetic, the alert state machine
+#     with flap hysteresis, the alert-wired fleet health chaos
+#     acceptance (alert fires BEFORE the crash path, placement steers
+#     off, resolve after recovery), retention pruning, and the
+#     rule-catalog docs lint.
+#   * obs_history_overhead: bench.py obs_history_overhead re-certifies
+#     the <3% obs budget with the history sampler (50 ms cadence, 20x
+#     production) + full alert-catalog evaluation ON, ratcheting the
+#     signed obs_history_overhead_4slots_ticks_per_s baselines row.
+#   * obs_capture_host: a live CPU-daemon capture of the new surfaces
+#     -- the `history` request (windowed rates/percentiles + rate
+#     series) committed as results/obs_history_r15.json, and a
+#     FIRING-ALERT DEMO under a scoped fault (paged.tick@replica0
+#     slow_ms wedges the engine; the replica_degraded / burn-rate
+#     rules must show "firing" in the captured alerts table,
+#     results/obs_alerts_r15.json).
+# Only then the relay-gated tail (r14 ordering preserved), which
+# re-captures the obs scrape ON-CHIP.
+cd /root/repo || exit 1
+L=results/logs
+mkdir -p "$L"
+
+. "$(dirname "$0")/relay_lib.sh"
+
+stage() {  # stage <name> <cmd...>
+  name=$1; shift
+  echo "== $name wait-relay $(date)" >> $L/queue.status
+  if ! wait_relay; then
+    echo "== $name SKIPPED (relay unreachable) $(date)" >> $L/queue.status
+    return 1
+  fi
+  echo "== $name start $(date)" >> $L/queue.status
+  "$@" > "$L/$name.log" 2>&1
+  echo "== $name rc=$? $(date)" >> $L/queue.status
+}
+
+obs_capture_host() {
+  # HOST-ONLY live capture of the round-15 surfaces.  Daemon 1 (clean):
+  # sampler at 200 ms, driven traffic, then the history report with
+  # rate series -> results/obs_history_r15.json.  Connection budget is
+  # EXACT: 6 drives + metrics + fleet + alerts + history = 10, then a
+  # raw metrics pass (1) that must carry the obs_alerts_* gauges.
+  SOCK=/tmp/tpulab_obs_r15.sock
+  rm -f "$SOCK"
+  env JAX_PLATFORMS=cpu python -m tpulab.daemon --socket "$SOCK" \
+      --metrics-interval 0.2 --slowlog 64 --max-requests 11 &
+  DPID=$!
+  for _ in $(seq 60); do [ -S "$SOCK" ] && break; sleep 2; done
+  env JAX_PLATFORMS=cpu python tools/obs_report.py --socket "$SOCK" \
+      --drive 6 --steps 32 --alerts --history 30 \
+      --history-out results/obs_history_r15.json \
+      > results/logs/obs_report_r15_host.txt 2>&1
+  env JAX_PLATFORMS=cpu python tools/obs_report.py --socket "$SOCK" \
+      --raw > results/obs_metrics_r15_host.prom \
+      2>>results/logs/obs_report_r15_host.txt
+  wait $DPID
+  for g in obs_alerts_firing obs_alerts_pending obs_alerts_evals \
+           fleet0_replica0_ticks; do
+    grep -q "^$g " results/obs_metrics_r15_host.prom \
+      || echo "MISSING METRIC $g" >> $L/queue.status
+  done
+  python - <<'EOF' >> $L/queue.status
+import json
+h = json.load(open("results/obs_history_r15.json"))
+ok = (h.get("samples", 0) >= 2 and h.get("window")
+      and h["window"].get("histograms", {}).get("ttft_seconds", {})
+      .get("count", 0) > 0)
+print("history capture:", "ok" if ok else "MISSING WINDOW DATA")
+EOF
+  # Daemon 2 (the firing-alert demo): a scoped fault wedges the one
+  # replica's engine ticks at 300 ms; the windowed replica_degraded
+  # rule (and the TTFT burn ladder, cold compile included) must be
+  # FIRING in the captured alerts table.  3 drives + metrics + fleet
+  # + alerts = 6 connections.
+  rm -f "$SOCK"
+  env JAX_PLATFORMS=cpu \
+      TPULAB_FAULTS='[{"site":"paged.tick@replica0","kind":"slow_ms","at":1,"count":64,"arg":300.0}]' \
+      python -m tpulab.daemon --socket "$SOCK" \
+      --metrics-interval 0.2 --max-requests 6 &
+  DPID=$!
+  for _ in $(seq 60); do [ -S "$SOCK" ] && break; sleep 2; done
+  env JAX_PLATFORMS=cpu python tools/obs_report.py --socket "$SOCK" \
+      --drive 3 --steps 16 --alerts --json \
+      > results/obs_alerts_r15.json \
+      2>>results/logs/obs_report_r15_host.txt
+  wait $DPID
+  python - <<'EOF' >> $L/queue.status
+import json
+a = json.load(open("results/obs_alerts_r15.json")).get("alerts", {})
+firing = [r["rule"] for r in a.get("alerts", []) if r["state"] == "firing"]
+print("alert demo firing:", firing if firing else "NO ALERT FIRED")
+assert firing, "firing-alert demo captured no firing alert"
+EOF
+  echo "== alert demo rc=$? $(date)" >> $L/queue.status
+}
+
+date > $L/queue.status
+# -- telemetry-over-time tier: HOST-ONLY, no relay gate --
+echo "== obs_time_fast start $(date)" >> $L/queue.status
+env JAX_PLATFORMS=cpu python -m pytest tests/test_obs_history.py \
+    tests/test_obs_alerts.py -q -m 'not slow' -p no:cacheprovider \
+    > "$L/obs_time_fast.log" 2>&1
+echo "== obs_time_fast rc=$? $(date)" >> $L/queue.status
+echo "== obs_history_overhead start $(date)" >> $L/queue.status
+env JAX_PLATFORMS=cpu python -c "
+import json
+from tpulab.bench import bench_obs_history_overhead
+print(json.dumps(bench_obs_history_overhead()))" \
+    > "$L/obs_history_overhead.log" 2>&1
+echo "== obs_history_overhead rc=$? $(date)" >> $L/queue.status
+grep '"metric"' "$L/obs_history_overhead.log" \
+    > results/obs_overhead_rows_r15.jsonl 2>/dev/null || true
+python tools/check_regression.py results/obs_overhead_rows_r15.jsonl \
+    --update --date "round 15 (onchip_queue_r15, host telemetry tier)" \
+    > "$L/regression_obs_history.log" 2>&1
+echo "== obs_history regression+ratchet rc=$? $(date)" >> $L/queue.status
+echo "== obs_capture_host start $(date)" >> $L/queue.status
+obs_capture_host
+echo "== obs_capture_host rc=$? $(date)" >> $L/queue.status
+obs_capture_chip() {
+  # the on-chip re-capture (r14 shape + the round-15 surfaces): a
+  # 2-replica fleet with the sampler at the production 1 s cadence;
+  # history/alerts land with real device timings behind them
+  SOCK=/tmp/tpulab_obs_r15.sock
+  rm -f "$SOCK"
+  python -m tpulab.daemon --socket "$SOCK" --replicas 2 \
+      --metrics-interval 1.0 --trace-buffer 65536 --slowlog 64 \
+      --max-requests 11 &
+  DPID=$!
+  for _ in $(seq 120); do [ -S "$SOCK" ] && break; sleep 5; done
+  python tools/obs_report.py --socket "$SOCK" --drive 6 --steps 48 \
+      --alerts --history 30 \
+      --history-out results/obs_history_r15_chip.json \
+      > results/logs/obs_report_r15.txt 2>&1
+  python tools/obs_report.py --socket "$SOCK" --raw \
+      > results/obs_metrics_r15.prom 2>>results/logs/obs_report_r15.txt
+  wait $DPID
+}
+
+# -- the relay-gated tail, round-14 ordering preserved
+stage obs_capture    obs_capture_chip
+stage serving_int    python tools/serving_tpu.py
+stage bench_r15      python bench.py --skip-probe
+grep -h '"metric"' $L/bench_r15.log 2>/dev/null \
+    | awk '!seen[$0]++' > results/bench_r15.jsonl || true
+stage parity         python tools/pallas_tpu_parity.py
+stage flash_train    python tools/flash_train_proof.py
+stage mfu_probe      python tools/train_mfu_probe.py
+stage ref_harness2   python tools/run_reference_harness.py --backend tpu --lab lab2 --k-times 5
+stage ref_harness3   python tools/run_reference_harness.py --backend tpu --lab lab3 --k-times 5
+# mechanical regression verdict + ratchet in ONE pass, ungated like the
+# re-sign below (host-only JSON diff)
+python tools/check_regression.py results/bench_r15.jsonl --update \
+    --date "round 15 (onchip_queue_r15)" > "$L/regression.log" 2>&1
+echo "== regression+ratchet rc=$? $(date)" >> $L/queue.status
+# re-sign: stages above rewrite signed artifacts (baselines.json under
+# the --update; pallas_tpu_parity.json) -- signatures must track them
+# or tests/test_signing.py reds.  No relay gate: signing is host-only.
+python tools/sign_artifacts.py sign > "$L/resign.log" 2>&1
+echo "== resign rc=$? $(date)" >> $L/queue.status
+echo "QUEUE DONE $(date)" >> $L/queue.status

@@ -583,6 +583,113 @@ def bench_obs_overhead(
     }
 
 
+def bench_obs_history_overhead(
+    slots: int = 4, steps: int = 96, reps: int = 5,
+    sampler_interval_s: float = 0.05
+) -> Dict[str, Any]:
+    """The round-15 telemetry-over-time tax: steady-state engine
+    ticks/s with EVERYTHING on — latency histograms + tracer (the
+    ``obs_overhead`` configuration) PLUS a live history sampler thread
+    and full default-catalog alert evaluation — vs everything off.
+
+    The sampler runs at ``sampler_interval_s`` (50 ms — 20x the
+    production 1 s cadence) so the timed ~100 ms window provably
+    overlaps sample+evaluate passes instead of sneaking between them;
+    production pays proportionally less, and a cadence much hotter
+    than this measures GIL contention between the sampler thread and
+    the sub-ms engine ticks rather than the layer's intrinsic cost
+    (20 ms measured ~2.5-9% depending on box load).  The budget stays the
+    ISSUE's <3% (best-of-reps, same retry-merge discipline as
+    ``bench_obs_overhead``); the reported value is the everything-on
+    ticks/s, gated in baselines.json
+    (``obs_history_overhead_4slots_ticks_per_s``)."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpulab import obs
+    from tpulab.models.labformer import LabformerConfig, init_params
+    from tpulab.models.paged import PagedEngine
+    from tpulab.obs import alerts as _alerts
+    from tpulab.obs import history as _history
+    from tpulab.runtime.device import default_device
+
+    cfg = LabformerConfig(d_model=64, n_heads=4, n_layers=2, d_ff=128,
+                          max_seq=256, dtype=jnp.float32)
+    device = default_device()
+    params = jax.device_put(init_params(cfg, seed=0), device)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab, (8,)).astype(np.int32)
+               for _ in range(slots)]
+    warm = 6
+    prior_capacity = obs.TRACER.capacity
+    # PRIVATE history + manager: the bench must not pollute (or race)
+    # the process-global ring/rule states a daemon in the same process
+    # would own
+    hist = _history.MetricsHistory(256)
+    mgr = _alerts.AlertManager(_alerts.default_rules())
+    sampler = _history.Sampler(
+        hist, sampler_interval_s,
+        on_sample=lambda: mgr.evaluate(hist))
+
+    def window(on: bool):
+        obs.configure_tracer(obs.DEFAULT_CAPACITY if on else 0)
+        eng = PagedEngine(params, cfg, slots=slots, n_blocks=64,
+                          block_size=16, max_seq=256, obs=on)
+        for p in prompts:  # budget outlives warm + timed window
+            eng.submit(p, max_new=warm + steps + 4)
+        for _ in range(warm):  # admission + compile outside the window
+            eng.step()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            eng.step()
+        return time.perf_counter() - t0
+
+    try:
+        for on in (False, True):
+            window(on)  # compile prefill bucket + paged_tick
+        times = {False: [], True: []}
+        for attempt in range(3):
+            for _ in range(max(reps, 3)):
+                window(False)  # sampler genuinely off for the off arm
+                times[False].append(window(False))
+                sampler.start()
+                try:
+                    window(True)  # sampler warm before the timed rep
+                    times[True].append(window(True))
+                finally:
+                    sampler.stop()
+            best_overhead = min(times[True]) / min(times[False]) - 1.0
+            if best_overhead < 0.03:
+                break  # retry-merge as in bench_obs_overhead: more
+                # samples only sharpen a NOISY failure
+    finally:
+        sampler.stop()
+        obs.configure_tracer(prior_capacity)
+    t_on = float(np.median(times[True]))
+    t_off = float(np.median(times[False]))
+    assert best_overhead < 0.03, (
+        f"obs+history+alerts overhead {best_overhead * 100:.2f}% exceeds "
+        f"the 3% budget (on={min(times[True]):.4f}s "
+        f"off={min(times[False]):.4f}s)")
+    assert hist.total_samples > 0, "sampler never ticked inside the run"
+    return {
+        "metric": f"obs_history_overhead_{slots}slots_ticks_per_s",
+        "value": round(steps / t_on, 1),
+        "unit": "ticks/s",
+        "vs_baseline": None,
+        "off_ticks_per_s": round(steps / t_off, 1),
+        "overhead_pct_median": round((t_on / t_off - 1.0) * 100, 2),
+        "overhead_pct_best": round(best_overhead * 100, 2),
+        "sampler_interval_ms": sampler_interval_s * 1e3,
+        "history_samples": hist.total_samples,
+        "alert_rules": len(mgr.rules),
+        "device": device.platform,
+        **variance_fields([t * 1e3 for t in times[True]]),
+    }
+
+
 def bench_fault_overhead(
     slots: int = 4, steps: int = 96, reps: int = 5
 ) -> Dict[str, Any]:
@@ -996,6 +1103,7 @@ def run_benchmarks(only: Optional[str] = None, yield_markers: bool = False,
         "paged_tick_overhead": bench_paged_tick,
         "prefill_interleave": bench_prefill_interleave,
         "obs_overhead": bench_obs_overhead,
+        "obs_history_overhead": bench_obs_history_overhead,
         "fault_overhead": bench_fault_overhead,
         "decode_recompiles": bench_decode_recompiles,
         "train_step_overhead": bench_train_step,

@@ -36,6 +36,18 @@ on — size with ``--slowlog N``), each entry rid-linked to its
 label echoed in the slow-log entry — load generators key it to their
 trace rows).
 
+Telemetry over time (round 15): a background sampler
+(``--metrics-interval``, default 1 s) appends one registry snapshot per
+tick to a fixed-capacity history ring and evaluates a declarative alert
+catalog over its windows.  ``history`` returns windowed rates /
+percentiles (+ optional per-metric rate series for sparklines);
+``alerts`` returns the rule state table (pending/firing/resolved, SLO
+burn rates, tripwires).  The sampler also wires per-replica degradation
+alerts into fleet placement (``ReplicaHealth.note_alert``) and upgrades
+the shed check's queue-wait p99 to a true history window.
+``tools/obs_console.py`` renders all of it as a live terminal
+dashboard.
+
 Fault tolerance (round 11): a generate request may carry
 ``deadline_ms`` (queue-wait-based load shedding: once a queue exists
 and the observed ``queue_wait`` p99 blows the budget, the daemon
@@ -230,6 +242,18 @@ REBUILD_PARK_S = float(os.environ.get("TPULAB_DAEMON_REBUILD_PARK_S", "30"))
 #: must not shed deadline traffic against an idle daemon forever
 QUEUE_WAIT_WINDOW_S = float(
     os.environ.get("TPULAB_DAEMON_QUEUE_WAIT_WINDOW_S", "60"))
+
+#: history sampler cadence (round 15): every interval the daemon
+#: refreshes the engine gauge mirror, appends one registry snapshot to
+#: the history ring (tpulab.obs.history — the ``history`` request), and
+#: evaluates the alert rule catalog (tpulab.obs.alerts — the ``alerts``
+#: request).  ``--metrics-interval`` overrides; 0 disables the sampler
+#: (history/alerts requests still answer, from whatever was sampled).
+METRICS_INTERVAL_S = float(
+    os.environ.get("TPULAB_DAEMON_METRICS_INTERVAL_S", "1.0"))
+
+#: history ring capacity in samples (15 min at the 1 s default cadence)
+HISTORY_CAPACITY = int(os.environ.get("TPULAB_DAEMON_HISTORY", "900"))
 
 #: fault-tolerance counters (process-global registry, in every
 #: ``metrics`` scrape): engine step loops quarantined+rebuilt, requests
@@ -451,18 +475,37 @@ class _GenerateService:
 
     def _queue_wait_p99_ms(self) -> float:
         """Queue-wait p99 over (roughly) the last
-        ``QUEUE_WAIT_WINDOW_S`` — computed by differencing the
-        cumulative histogram against a rolling snapshot mark, so the
-        estimate DECAYS: a congestion spell long past cannot shed
-        deadline traffic against an idle daemon forever (the
-        process-lifetime p99 never comes back down).  The base mark is
-        between one and two windows old; 0.0 when nothing was observed
-        inside it."""
+        ``QUEUE_WAIT_WINDOW_S`` — the WINDOWED signal admission sheds
+        on, so the estimate DECAYS: a congestion spell long past cannot
+        shed deadline traffic against an idle daemon forever (the
+        process-lifetime p99 never comes back down).
+
+        With the round-15 history sampler running, the window is the
+        real thing: a live-ending ``Window`` over the history ring
+        (newest edge = a fresh snapshot taken HERE, so requests
+        recorded since the last sampler tick count), histogram-bucket
+        differencing with reset handling included.  Without a sampler
+        (legacy daemons, direct-service tests, ``--metrics-interval
+        0``) the pre-round-15 two-mark rolling snapshot below gives the
+        same roughly-one-window estimate — behavior-compatible by
+        construction, and the chaos goodput gate certifies the two
+        paths shed equivalently."""
         from tpulab.obs.registry import percentile_from_buckets
 
         h = _obs.REGISTRY.get("queue_wait_seconds")
         if h is None:
             return 0.0
+        if _sampler_active():
+            # the live end sample carries ONLY this one histogram —
+            # this runs under the engine admission condition per
+            # deadline-carrying request, and a full Registry.snapshot
+            # here would copy every metric in the process per submit
+            w = _obs.HISTORY.window(
+                QUEUE_WAIT_WINDOW_S,
+                end=(time.monotonic(),
+                     {"queue_wait_seconds": h.snapshot()}))
+            if w is not None:
+                return w.percentile("queue_wait_seconds", 0.99) * 1e3
         snap = h.snapshot()
         now = time.monotonic()
         with self.lock:
@@ -906,6 +949,37 @@ class _Replica:
         self.generation = 0           # completed rebuilds
         self.restarts = 0             # failure-driven rebuilds
         self.parked: list = []        # tickets awaiting this rebuild
+        # per-replica windowed health evidence (round 15): the stepper
+        # counts every tick and every slow/stalled tick into these
+        # registry counters, and the alert engine's ReplicaStallRule
+        # differences them over its window — the telemetry the
+        # alert-wired SUSPECT transition (ReplicaHealth.note_alert)
+        # consumes.  Keyed by the FLEET's process-unique fid AND the
+        # replica index: up to four warm fleets coexist in the LRU, and
+        # index-only counters would mix fleet A's wedged replica 0 with
+        # fleet B's healthy replica 0 — suspecting the healthy one and
+        # diluting the wedged one's slow fraction.  get-or-create: a
+        # rebuilt replica keeps its slot's counters (cumulative, like
+        # every other registry counter).
+        self.c_ticks = _obs.counter(
+            f"fleet{fleet.fid}_replica{index}_ticks",
+            f"stepper ticks completed by replica {index} of fleet "
+            f"{fleet.fid}")
+        self.c_slow_ticks = _obs.counter(
+            f"fleet{fleet.fid}_replica{index}_slow_ticks",
+            f"fleet {fleet.fid} replica {index} stepper ticks that "
+            f"were slow or stalled (the router's degradation "
+            f"evidence, windowed by the replica_degraded alert rule)")
+
+
+#: process-unique fleet ids: the per-replica health counters and alert
+#: rules are keyed ``fleet<fid>_replica<i>`` so two warm fleets' same-
+#: index replicas never share a degradation signal (an evicted fleet's
+#: id is never reused — its counters simply stop moving and its rules
+#: go inactive)
+import itertools as _itertools
+
+_FLEET_FID = _itertools.count()
 
 
 class _Fleet:
@@ -918,6 +992,7 @@ class _Fleet:
         self.builder = builder
         self.key = key
         self.stamp = stamp
+        self.fid = next(_FLEET_FID)
         self.cv = threading.Condition()
         self.replicas: list = []
         self.tok = None
@@ -1136,6 +1211,21 @@ class _FleetService:
                     stall = eng.counters["stall_ticks"]
                     stalled = stall != last_stall
                     last_stall = stall
+                    # compile-driven slowness is EXPECTED (cold start,
+                    # a new prefill bucket) and separately watched by
+                    # the recompile tripwire — only steady-state slow
+                    # ticks count as degradation evidence, or every
+                    # fresh replica would open its life SUSPECT
+                    steady = getattr(eng, "_steady", True)
+                # windowed health evidence: one counter add per tick
+                # (self-locked, no condition held) — the alert engine
+                # differences these over its window, so degradation is
+                # visible to placement even when the consecutive-tick
+                # streak below never trips
+                replica.c_ticks.inc()
+                if steady and (stalled
+                               or dt >= replica.health.slow_tick_s):
+                    replica.c_slow_ticks.inc()
                 with fleet.cv:
                     # health evidence + publication + the per-tick
                     # wakeup streaming waiters ride (the fleet.cv is a
@@ -2147,21 +2237,23 @@ def _handle_generate_stats(header: dict) -> bytes:
 
 
 #: serializes the engine-gauge rewrite + render inside a ``metrics``
-#: scrape (see _handle_metrics) — scrapes only, never the serving path
-_METRICS_RENDER_LOCK = threading.Lock()
+#: scrape (see _handle_metrics) — scrapes and the history sampler
+#: only, never the serving path.  Re-entrant: the scrape handler holds
+#: it across refresh + render so a concurrent sampler's rewrite cannot
+#: tear the exposition, while the refresh helper takes it for its own
+#: standalone (sampler-tick) callers.
+_METRICS_RENDER_LOCK = threading.RLock()
 
 
-def _handle_metrics(header: dict) -> bytes:
-    """``metrics`` request: Prometheus text exposition of the process-
-    global registry (tpulab.obs) — the serving latency histograms
-    (ttft_seconds / itl_seconds / e2e_seconds / queue_wait_seconds /
-    prefill_seconds), the trainer's histograms when this process also
-    trains, and a fresh ``engine_*`` gauge mirror of the warm engines'
+def _refresh_engine_gauges() -> None:
+    """Publish a fresh ``engine_*`` gauge mirror of the warm engines'
     stats() — SUMMED across engines (process-wide totals; identical to
-    the single engine's stats in the common case), published through
-    the one gauge-writing site so two warm engines can never overwrite
-    each other into a mixed exposition.  Scrape with
-    ``tools/obs_report.py`` or any Prometheus-format consumer."""
+    the single engine's stats in the common case) plus the per-replica
+    ``engine_*_replica<i>`` breakdown — through the one gauge-writing
+    site, so two warm engines can never overwrite each other into a
+    mixed exposition.  Shared by the ``metrics`` scrape handler and the
+    round-15 history sampler (every history sample must carry LIVE
+    engine stats, not whatever the last scrape left behind)."""
     from tpulab import obs
     from tpulab.models.paged import publish_engine_stats
 
@@ -2233,6 +2325,23 @@ def _handle_metrics(header: dict) -> bytes:
         # memory_stats-backed gauges must keep reporting)
         _roofline.update_device_memory_gauges(estimate)
         _roofline.update_mfu_gauges()
+
+
+def _handle_metrics(header: dict) -> bytes:
+    """``metrics`` request: Prometheus text exposition of the process-
+    global registry (tpulab.obs) — the serving latency histograms
+    (ttft_seconds / itl_seconds / e2e_seconds / queue_wait_seconds /
+    prefill_seconds), the trainer's histograms when this process also
+    trains, and a fresh ``engine_*`` gauge mirror of the warm engines
+    (``_refresh_engine_gauges``).  Scrape with ``tools/obs_report.py``
+    or any Prometheus-format consumer."""
+    from tpulab import obs
+
+    with _METRICS_RENDER_LOCK:
+        # refresh + render under ONE acquisition (the lock is
+        # re-entrant): a sampler tick rewriting the per-replica gauges
+        # mid-render would otherwise tear the exposition
+        _refresh_engine_gauges()
         return obs.render_prometheus().encode("utf-8")
 
 
@@ -2301,6 +2410,162 @@ def _handle_slowlog(header: dict) -> bytes:
     return json.dumps(
         obs.SLOWLOG.snapshot(n, clear=bool(config.get("clear")))
     ).encode("utf-8")
+
+
+# ---------------------------------------------------------------- sampler
+#
+# Round 15: the TIME dimension.  One background sampler per daemon
+# process drives the whole telemetry-over-time layer — every
+# ``METRICS_INTERVAL_S`` it (1) refreshes the engine gauge mirror so
+# the snapshot carries live stats, (2) appends one registry snapshot to
+# the history ring, (3) evaluates the alert catalog over the ring's
+# windows, and (4) maps each replica's ``replica_degraded`` alert state
+# onto the router's health machine — closing the telemetry->control
+# loop: a degraded replica is steered away from BEFORE its crash path
+# runs.  The sampler never touches an engine condition or the device;
+# everything it reads is either the registry (per-metric locks) or the
+# fleet table under fleet.cv.
+
+#: the live sampler (serve() starts it; tests drive _sampler_tick
+#: directly for determinism)
+_SAMPLER = None
+
+
+def _sampler_active() -> bool:
+    """Whether windowed consumers (the shed check) may trust the
+    history ring: a sampler is running AND its newest sample is recent
+    enough that the window edge is meaningful (a wedged sampler thread
+    falls back to the legacy path instead of shedding on stale data)."""
+    s = _SAMPLER
+    if s is None or not s.running:
+        return False
+    age = _obs.HISTORY.age_s()
+    return age is not None and age < max(5.0, 5.0 * s.interval_s)
+
+
+def _ensure_replica_rules() -> None:
+    """Lazily install one ``fleet<f>_replica<i>_degraded`` rule per
+    replica of every warm fleet (AlertManager.add is idempotent by
+    name; rules are fleet-id-scoped so two warm fleets' same-index
+    replicas never share a verdict).  Rules for evicted fleets stay —
+    their counters stop moving, so the rule goes inactive on its
+    own."""
+    from tpulab.obs.alerts import ALERTS, ReplicaStallRule
+
+    with _FLEET_SERVICE.lock:
+        fleets = [v[1] for v in _FLEETS.values()]
+    for fleet in fleets:
+        for r in fleet.replicas:
+            ALERTS.add(ReplicaStallRule(r.index, fleet_id=fleet.fid))
+
+
+def _apply_fleet_alerts() -> None:
+    """Map each replica's ``replica_degraded`` alert state onto its
+    health machine (``ReplicaHealth.note_alert`` under fleet.cv) — the
+    alert-wired SUSPECT transition.  FIRING demotes/holds SUSPECT so
+    placement steers off the replica; resolution releases the hold and
+    the normal clean-tick hysteresis finishes recovery."""
+    from tpulab.obs import alerts as _alerts
+
+    with _FLEET_SERVICE.lock:
+        fleets = [v[1] for v in _FLEETS.values()]
+    for fleet in fleets:
+        for r in fleet.replicas:
+            st = _alerts.ALERTS.get_state(
+                f"fleet{fleet.fid}_replica{r.index}_degraded")
+            firing = st is not None and st.state == _alerts.FIRING
+            with fleet.cv:
+                r.health.note_alert(firing)
+
+
+def _sampler_tick() -> None:
+    """One sampler iteration's POST-sample hook (the gauge refresh runs
+    as the before-hook so the sample itself is fresh): evaluate alerts
+    over the ring, then wire the verdicts into fleet health."""
+    _ensure_replica_rules()
+    _obs.ALERTS.evaluate(_obs.HISTORY)
+    _apply_fleet_alerts()
+
+
+def start_sampler(interval_s: Optional[float] = None,
+                  capacity: Optional[int] = None):
+    """Build + start the daemon's history sampler (serve() calls this;
+    exposed for benches/tests).  Installs the default alert catalog
+    with page-severity flight-recorder bundles enabled.  Returns the
+    sampler, or None when the interval is 0 (disabled)."""
+    global _SAMPLER
+    from tpulab.obs import alerts as _alerts
+    from tpulab.obs import history as _history
+
+    iv = METRICS_INTERVAL_S if interval_s is None else float(interval_s)
+    if iv <= 0:
+        return None
+    cap = max(1, int(capacity if capacity is not None
+                     else HISTORY_CAPACITY))  # a misconfigured env
+    # (TPULAB_DAEMON_HISTORY=0) degrades to the smallest ring instead
+    # of killing the daemon before it binds its socket
+    if _obs.HISTORY.capacity != cap:
+        _obs.configure_history(cap)
+    _alerts.install_default_rules()
+    _alerts.ALERTS.page_postmortems = True
+    if _SAMPLER is not None:
+        _SAMPLER.stop()
+    _SAMPLER = _history.Sampler(
+        _obs.HISTORY, iv, on_sample=_sampler_tick,
+        before_sample=_refresh_engine_gauges).start()
+    return _SAMPLER
+
+
+def stop_sampler() -> None:
+    global _SAMPLER
+    if _SAMPLER is not None:
+        _SAMPLER.stop()
+        _SAMPLER = None
+
+
+def _handle_history(header: dict) -> bytes:
+    """``history`` request: the metrics-over-time report from the ring
+    (tpulab.obs.history) as JSON — ring occupancy, one windowed summary
+    (per-counter rates, per-histogram windowed counts + percentiles),
+    and optional per-metric rate series for sparklines.  Config:
+    ``seconds`` (window, default 30), ``series`` (metric names to
+    return rate series for), ``series_seconds`` (series span; defaults
+    to ``seconds``).  ``tools/obs_console.py`` renders it live;
+    ``tools/obs_report.py --history-out`` captures it."""
+    config = header.get("config") or {}
+    seconds = float(config.get("seconds", 30.0))
+    if seconds <= 0:
+        raise ValueError(f"seconds must be > 0, got {seconds}")
+    series = config.get("series") or ()
+    if not isinstance(series, (list, tuple)):
+        raise ValueError("series must be a list of metric names")
+    ss = config.get("series_seconds")
+    report = _obs.HISTORY.report(
+        seconds, series=[str(s) for s in series],
+        series_seconds=None if ss is None else float(ss))
+    s = _SAMPLER
+    report["sampler"] = {
+        "running": bool(s is not None and s.running),
+        "interval_s": None if s is None else s.interval_s,
+        "errors": 0 if s is None else s.errors,
+    }
+    return json.dumps(report).encode("utf-8")
+
+
+def _handle_alerts(header: dict) -> bytes:
+    """``alerts`` request: the alert engine's state table as JSON
+    (firing first).  Evaluates the catalog FIRST by default — so
+    staleness/absence rules stay live even when the sampler thread
+    itself is wedged (exactly the failure ``sampler_stale`` exists
+    for); transitions are edge-triggered, so an extra evaluation from
+    a request thread never double-counts.  Config ``{"no_evaluate":
+    true}`` returns the table as the last sampler tick left it."""
+    from tpulab.obs import alerts as _alerts
+
+    config = header.get("config") or {}
+    if not config.get("no_evaluate"):
+        _alerts.ALERTS.evaluate(_obs.HISTORY)
+    return json.dumps(_alerts.ALERTS.snapshot()).encode("utf-8")
 
 
 def _resolve_fleet(config: dict) -> Optional[_Fleet]:
@@ -2389,6 +2654,10 @@ def handle_request(header: dict, payload: bytes,
         return _handle_postmortem(header)
     if header.get("lab") == "slowlog":
         return _handle_slowlog(header)
+    if header.get("lab") == "history":
+        return _handle_history(header)
+    if header.get("lab") == "alerts":
+        return _handle_alerts(header)
     if header.get("lab") == "fleet":
         return _handle_fleet(header)
     if header.get("lab") == "drain":
@@ -2438,7 +2707,13 @@ def serve(socket_path: str, *, max_requests: Optional[int] = None) -> None:
     import jax
 
     jax.devices()
-    print(f"[tpulab.daemon] serving on {socket_path}", flush=True)
+    # the telemetry-over-time layer: gauge refresh + history sample +
+    # alert evaluation + fleet-health application, every
+    # METRICS_INTERVAL_S (0 = disabled)
+    sampler = start_sampler()
+    print(f"[tpulab.daemon] serving on {socket_path}"
+          + (f" (metrics sampler @ {sampler.interval_s:g}s)"
+             if sampler is not None else ""), flush=True)
 
     import threading
 
@@ -2574,6 +2849,7 @@ def serve(socket_path: str, *, max_requests: Optional[int] = None) -> None:
     except KeyboardInterrupt:
         pass
     finally:
+        stop_sampler()
         srv.close()
         try:
             os.unlink(socket_path)
@@ -2582,7 +2858,7 @@ def serve(socket_path: str, *, max_requests: Optional[int] = None) -> None:
 
 
 def main(argv=None) -> int:
-    global PREFILL_CHUNK, REPLICAS, HEDGE_MS
+    global PREFILL_CHUNK, REPLICAS, HEDGE_MS, METRICS_INTERVAL_S
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--socket", default=os.environ.get("TPULAB_DAEMON_SOCKET", "/tmp/tpulab.sock"))
     ap.add_argument("--max-requests", type=int, default=None, help="exit after N requests (tests)")
@@ -2601,6 +2877,16 @@ def main(argv=None) -> int:
                     help="default prefill window for the serving engines "
                          "(chunked+interleaved admission; 0 = whole-prompt "
                          "dense prefill, the single-request oracle path)")
+    ap.add_argument("--metrics-interval", type=float,
+                    default=METRICS_INTERVAL_S, metavar="S",
+                    help="history sampler cadence in seconds (default "
+                         "1.0; 0 disables): every tick appends one "
+                         "registry snapshot to the history ring "
+                         "('history' request — windowed rates and "
+                         "percentiles), evaluates the alert rule "
+                         "catalog ('alerts' request), and wires "
+                         "replica-degradation alerts into fleet "
+                         "placement")
     ap.add_argument("--trace-buffer", type=int, default=None, metavar="N",
                     help="ring-buffer tracer capacity in events (default "
                          "32768; 0 disables tracing).  Dump the retained "
@@ -2623,9 +2909,12 @@ def main(argv=None) -> int:
         ap.error("--trace-buffer must be >= 0")
     if args.slowlog is not None and args.slowlog < 0:
         ap.error("--slowlog must be >= 0")
+    if args.metrics_interval < 0:
+        ap.error("--metrics-interval must be >= 0 (0 disables)")
     PREFILL_CHUNK = args.prefill_chunk
     REPLICAS = args.replicas
     HEDGE_MS = args.hedge_ms
+    METRICS_INTERVAL_S = args.metrics_interval
     if args.trace_buffer is not None:
         from tpulab import obs
 

@@ -13,6 +13,20 @@ Two stdlib-only primitives the whole stack records into:
   summaries (the daemon's ``slowlog`` request), rid-linked to the
   tracer's event stream.
 
+The round-15 time dimension sits directly on the registry:
+
+* :mod:`tpulab.obs.history` — a fixed-capacity ring of periodic
+  registry snapshots with windowed delta/rate math (counter rates,
+  histogram-bucket differencing with counter-reset handling, windowed
+  percentiles) — the daemon's ``--metrics-interval`` sampler feeds it
+  and the ``history`` request reports from it.
+* :mod:`tpulab.obs.alerts` — the declarative rule engine evaluated on
+  each sampler tick: threshold/absence/staleness rules and SRE-style
+  multi-window burn-rate rules over SLO budgets, with a
+  pending→firing→resolved state machine, ``obs_alerts_*``
+  counters/gauges, tracer transition events, and flight-recorder
+  bundles on page-severity fires (the daemon's ``alerts`` request).
+
 The round-14 compiler/device tier sits on top of them:
 
 * :mod:`tpulab.obs.compilestats` — the compile-event recorder every
@@ -41,8 +55,14 @@ programs), ``tpulab.daemon`` (``metrics``/``trace_dump``/
 (percentile/roofline/post-mortem views from a scrape).
 """
 
+from tpulab.obs.alerts import (ALERTS, AlertManager, BurnRateRule, Rule,
+                               ThresholdRule, default_rules,
+                               install_default_rules)
 from tpulab.obs.compilestats import (COMPILESTATS, CompileStats,
                                      RecompileError, instrument, strict)
+from tpulab.obs.history import (HISTORY, MetricsHistory, Sampler, Window,
+                                configure_history, counts_delta,
+                                fraction_le)
 from tpulab.obs.flightrec import (configure_flightrec, latest_postmortem,
                                   record_postmortem)
 from tpulab.obs.profiler import EventLog, annotate, maybe_trace
@@ -55,12 +75,15 @@ from tpulab.obs.tracer import (DEFAULT_CAPACITY, NULL, TRACER, Tracer,
                                configure_tracer, event, next_rid, span)
 
 __all__ = [
-    "COMPILESTATS", "DEFAULT_BUCKETS", "DEFAULT_CAPACITY", "REGISTRY",
-    "SLOWLOG", "CompileStats", "Counter", "EventLog", "Gauge", "Histogram",
-    "NULL", "RecompileError", "Registry", "SlowLog", "TRACER", "Tracer",
-    "annotate", "configure_flightrec", "configure_slowlog",
-    "configure_tracer", "counter", "event", "gauge", "histogram",
-    "instrument", "latest_postmortem", "maybe_trace", "next_rid",
-    "percentile_from_buckets", "record_postmortem", "render_prometheus",
-    "span", "strict",
+    "ALERTS", "COMPILESTATS", "DEFAULT_BUCKETS", "DEFAULT_CAPACITY",
+    "HISTORY", "REGISTRY", "SLOWLOG", "AlertManager", "BurnRateRule",
+    "CompileStats", "Counter", "EventLog", "Gauge", "Histogram",
+    "MetricsHistory", "NULL", "RecompileError", "Registry", "Rule",
+    "Sampler", "SlowLog", "TRACER", "ThresholdRule", "Tracer", "Window",
+    "annotate", "configure_flightrec", "configure_history",
+    "configure_slowlog", "configure_tracer", "counter", "counts_delta",
+    "default_rules", "event", "fraction_le", "gauge", "histogram",
+    "install_default_rules", "instrument", "latest_postmortem",
+    "maybe_trace", "next_rid", "percentile_from_buckets",
+    "record_postmortem", "render_prometheus", "span", "strict",
 ]

@@ -22,6 +22,7 @@ Bundle schema (version 1)::
       "compile_stats": COMPILESTATS.snapshot(),
       "metrics": REGISTRY.snapshot(),      # every counter/gauge/histogram
       "slowlog": SLOWLOG worst-N,
+      "alerts": [...alert rows FIRING at the time of death...],
       "trace": {"events": [...last-N chrome events...],
                 "recorded": int, "dropped": int},
     }
@@ -118,6 +119,17 @@ def record_postmortem(reason: str, *, engine=None, err=None,
 
         dump = TRACER.chrome_trace()
         events = dump["traceEvents"][-int(trace_events):]
+        try:
+            # the firing-alert set at the time of death: a crash that
+            # happened UNDER an already-burning SLO reads differently
+            # from one out of a clear sky (import is lazy + guarded —
+            # alerts itself records bundles on page fires, and a broken
+            # alert engine must not break crash recording)
+            from tpulab.obs.alerts import ALERTS
+
+            firing = ALERTS.firing()
+        except Exception:
+            firing = []
         bundle = {
             "schema": 1,
             "reason": str(reason),
@@ -130,6 +142,7 @@ def record_postmortem(reason: str, *, engine=None, err=None,
             "compile_stats": _jsonable(COMPILESTATS.snapshot()),
             "metrics": _jsonable(REGISTRY.snapshot()),
             "slowlog": _jsonable(SLOWLOG.snapshot(slow_n)),
+            "alerts": _jsonable(firing),
             "trace": {
                 "events": _jsonable(events),
                 "recorded": dump["otherData"]["recorded"],
@@ -149,15 +162,38 @@ def record_postmortem(reason: str, *, engine=None, err=None,
             path = d / name
             path.write_text(json.dumps(bundle, indent=1,
                                        default=repr) + "\n")
-            for old in list_bundles()[KEEP:]:
-                try:
-                    old.unlink()
-                except OSError:
-                    pass
+            prune()
         return path
     except Exception:  # noqa: BLE001 — the recorder must never turn a
         # recovered crash into an unrecovered one
         return None
+
+
+def prune(keep: Optional[int] = None) -> int:
+    """Bounded retention: delete every bundle past the newest ``keep``
+    (default :data:`KEEP`) — strictly OLDEST first, and never raises
+    (a bundle deleted underneath us by a concurrent pruner, a
+    permission error, a vanished directory all just skip).  Returns how
+    many bundles were actually removed.  Called on every
+    :func:`record_postmortem`; directly tested so a crash-looping
+    daemon provably cannot fill the disk."""
+    keep = KEEP if keep is None else max(0, int(keep))
+    removed = 0
+    try:
+        excess = list_bundles()[keep:] if keep else list_bundles()
+        # list_bundles is newest-first, so the slice IS oldest-last;
+        # delete from the very oldest up so an interrupted prune leaves
+        # the newest evidence intact
+        for old in reversed(excess):
+            try:
+                old.unlink()
+                removed += 1
+            except OSError:
+                pass
+    except Exception:  # noqa: BLE001 — retention must never raise into
+        # the failure path that invoked it
+        return removed
+    return removed
 
 
 def list_bundles() -> List[pathlib.Path]:

@@ -76,6 +76,11 @@ class ReplicaHealth:
         self.state = HEALTHY
         self._slow = 0
         self._fast = 0
+        #: True while a replica-degradation alert is FIRING for this
+        #: replica (tpulab.obs.alerts.ReplicaStallRule, applied by the
+        #: daemon's sampler): telemetry-driven suspicion that both
+        #: demotes and HOLDS the replica SUSPECT — see note_alert
+        self.alert_firing = False
         #: lifetime transition counts (the ``fleet`` response surfaces
         #: them so an operator can see a replica flapping)
         self.suspects = 0
@@ -103,8 +108,39 @@ class ReplicaHealth:
         else:
             self._fast += 1
             self._slow = 0
-            if self.state == SUSPECT and self._fast >= self.recover_after:
+            if (self.state == SUSPECT and self._fast >= self.recover_after
+                    and not self.alert_firing):
+                # a firing degradation alert HOLDS suspicion: the
+                # windowed evidence outranks a streak of fast ticks
+                # (the wedge signature alternates), and recovery waits
+                # for the alert's own resolve hysteresis
                 self.state = HEALTHY
+
+    def note_alert(self, firing: bool) -> None:
+        """Telemetry-driven SUSPECT (round 15, "alert-wired fleet
+        health"): the daemon's sampler maps each replica's
+        ``replica_degraded`` alert state here every tick.  A FIRING
+        alert demotes HEALTHY -> SUSPECT immediately (windowed
+        slow-tick evidence — the replica is steered away from BEFORE
+        its crash path runs) and resets any recovery streak; while it
+        stays firing, :meth:`note_tick`'s fast-tick promotion is held
+        off.  Resolution does NOT instantly promote — the normal
+        ``recover_after`` clean-tick hysteresis finishes the job, so a
+        flapping alert cannot flap placement.  Ignored outside
+        HEALTHY/SUSPECT (quarantine/rebuild own those states)."""
+        if not firing:
+            if self.alert_firing:
+                # release edge: restart the clean-tick streak — ticks
+                # that ran UNDER the firing alert are not recovery
+                # evidence (the windowed rule just said otherwise)
+                self._fast = 0
+            self.alert_firing = False
+            return
+        self.alert_firing = True
+        self._fast = 0
+        if self.state == HEALTHY:
+            self.state = SUSPECT
+            self.suspects += 1
 
     def note_crash(self) -> None:
         """The replica's step loop died (dispatch exception or an
@@ -123,13 +159,16 @@ class ReplicaHealth:
 
     def note_rebuilt(self) -> None:
         """A fresh engine was swapped in: fully healthy, counters
-        reset (the new engine has produced no evidence yet)."""
+        reset (the new engine has produced no evidence yet; a stale
+        alert against the DEAD engine's window does not transfer)."""
         self.state = HEALTHY
         self._slow = self._fast = 0
+        self.alert_firing = False
 
     def snapshot(self) -> dict:
         return {"state": self.state, "suspects": self.suspects,
-                "crashes": self.crashes}
+                "crashes": self.crashes,
+                "alert_firing": self.alert_firing}
 
 
 @dataclass(frozen=True)

@@ -165,12 +165,14 @@ def counter_deltas(before: dict, after: dict) -> dict:
 
 
 def _spawn_daemon(sock: str, slowlog: int, trace_buffer: int,
-                  replicas: int = 1, extra_env: dict | None = None):
+                  replicas: int = 1, extra_env: dict | None = None,
+                  extra_args: list | None = None):
     """Host-only convenience: spawn a private daemon for the replay and
     SIGTERM it afterwards.  CPU-tier only — an on-chip daemon holds the
     relay claim and must be driven, not owned, by this gate.
     ``replicas`` sizes the serving fleet; ``extra_env`` injects e.g.
-    the TPULAB_FAULTS chaos schedule."""
+    the TPULAB_FAULTS chaos schedule; ``extra_args`` appends daemon
+    flags (e.g. ``--journal`` for the kill scenario)."""
     # a stale socket file from a killed earlier run would satisfy the
     # readiness poll before the child ever binds (skipping its crash
     # detection); the daemon unlinks on bind, so pre-clear it here too
@@ -182,7 +184,7 @@ def _spawn_daemon(sock: str, slowlog: int, trace_buffer: int,
     proc = subprocess.Popen(
         [sys.executable, "-m", "tpulab.daemon", "--socket", sock,
          "--slowlog", str(slowlog), "--trace-buffer", str(trace_buffer),
-         "--replicas", str(replicas)],
+         "--replicas", str(replicas)] + list(extra_args or ()),
         env=env, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
     deadline = time.monotonic() + 120
     while time.monotonic() < deadline:
@@ -193,8 +195,28 @@ def _spawn_daemon(sock: str, slowlog: int, trace_buffer: int,
         if os.path.exists(sock):
             return proc
         time.sleep(0.1)
-    proc.send_signal(signal.SIGTERM)
+    # orphan guard: never leave the stuck child running — SIGTERM alone
+    # left a zombie/orphan when the socket never appeared (the raise
+    # below abandons the handle without reaping it)
+    _reap(proc)
     raise RuntimeError("spawned daemon socket never appeared")
+
+
+def _reap(proc) -> None:
+    """Make absolutely sure a spawned daemon is dead AND reaped: polite
+    SIGTERM with a bounded wait, then SIGKILL + wait.  Every gate exit
+    path — success, assertion failure, crash mid-trace — funnels
+    through this, so no run can leak an orphaned daemon process."""
+    if proc is None or proc.poll() is not None:
+        if proc is not None:
+            proc.wait()  # already exited: reap the zombie
+        return
+    proc.send_signal(signal.SIGTERM)
+    try:
+        proc.wait(timeout=30)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
 
 
 def rolling_restart(rep, sock: str, n_replicas: int, log) -> dict:
@@ -281,8 +303,8 @@ def compare_streams(ref_results: list, chaos_results: list):
     return compared, mismatches
 
 
-def run_replay(args, rep, trace, *, extra_env=None, rolling=False,
-               label=""):
+def run_replay(args, rep, trace, *, extra_env=None, extra_args=None,
+               rolling=False, label=""):
     """One full replay window against a (possibly spawned) daemon:
     warmup outside the window, before/after scrapes, trace replay,
     slowlog + fleet captures, optional rolling-restart phase.  Returns
@@ -291,7 +313,8 @@ def run_replay(args, rep, trace, *, extra_env=None, rolling=False,
     if args.spawn_daemon:
         daemon_proc = _spawn_daemon(
             args.socket, max(args.slowlog, 16), 1 << 16,
-            replicas=args.replicas, extra_env=extra_env)
+            replicas=args.replicas, extra_env=extra_env,
+            extra_args=extra_args)
     log = lambda m: print(m, file=sys.stderr, flush=True)  # noqa: E731
     try:
         # warmup OUTSIDE the measured window: the first request pays
@@ -319,14 +342,93 @@ def run_replay(args, rep, trace, *, extra_env=None, rolling=False,
         if rolling:
             roll = rolling_restart(rep, args.socket, args.replicas, log)
     finally:
-        if daemon_proc is not None:
-            daemon_proc.send_signal(signal.SIGTERM)
-            try:
-                daemon_proc.wait(timeout=30)
-            except subprocess.TimeoutExpired:
-                daemon_proc.kill()
+        _reap(daemon_proc)
     return {"results": results, "wall_s": wall_s, "before": before,
             "after": after, "slow": slow, "fleet": fleet, "roll": roll}
+
+
+def run_kill_replay(args, rep, trace, ref_wall_s: float,
+                    label="[kill] "):
+    """The crash-durability scenario (round 16): replay the trace
+    against a journal-armed daemon, SIGKILL the daemon PROCESS
+    mid-trace (``proc.kill()`` — no signal handler, no cleanup, the
+    spot-preemption/OOM stand-in), restart it on the SAME socket and
+    journal, and let the clients' reconnect-with-resume path carry
+    every stream across the crash.  The restarted daemon replays
+    incomplete journaled requests through ``PagedEngine.resubmit``, so
+    surviving outputs must be bit-identical to the fault-free
+    reference.  Returns the standard run captures plus the kill
+    bookkeeping; counters scraped AFTER are absolute values from the
+    restarted process (its registry starts at zero — deltas against the
+    pre-kill scrape would be meaningless)."""
+    import threading
+
+    log = lambda m: print(m, file=sys.stderr, flush=True)  # noqa: E731
+    fd, journal = tempfile.mkstemp(suffix=".journal.jsonl")
+    os.close(fd)
+    os.unlink(journal)  # the daemon creates it; mkstemp just named it
+    holder = {"proc": None}
+    kill_err = []
+    killed = {"n": 0}
+    kill_after_s = max(1.0, ref_wall_s * args.kill_at)
+
+    def killer():
+        try:
+            time.sleep(kill_after_s)
+            p = holder["proc"]
+            p.kill()  # SIGKILL: the journal's whole reason to exist
+            p.wait()
+            killed["n"] += 1
+            log(f"{label}[goodput_gate] SIGKILLed daemon pid={p.pid} "
+                f"at t+{kill_after_s:.1f}s; restarting on the same "
+                f"socket + journal")
+            holder["proc"] = _spawn_daemon(
+                args.socket, max(args.slowlog, 16), 1 << 16,
+                replicas=args.replicas,
+                extra_args=["--journal", journal])
+        except BaseException as e:  # surfaced after the replay joins
+            kill_err.append(e)
+
+    holder["proc"] = _spawn_daemon(
+        args.socket, max(args.slowlog, 16), 1 << 16,
+        replicas=args.replicas, extra_args=["--journal", journal])
+    try:
+        for _ in range(args.warmup):
+            rep.request_with_retry(args.socket, "generate", {"steps": 4},
+                                   b"goodput gate warmup",
+                                   deadline_s=300.0)
+        before = rep.parse_prometheus(
+            rep.request(args.socket, "metrics").decode("utf-8"))
+        th = threading.Thread(target=killer, daemon=True)
+        th.start()
+        results, wall_s = loadgen.replay(
+            trace, args.socket, time_scale=args.time_scale,
+            timeout_s=args.timeout_s,
+            log=lambda m: log(f"{label}{m}"))
+        th.join(timeout=180)
+        if kill_err:
+            raise RuntimeError(
+                f"kill/restart thread failed: {kill_err[0]!r}"
+            ) from kill_err[0]
+        # scrapes come from the RESTARTED process: absolute values
+        after = rep.parse_prometheus(
+            rep.request_with_retry(args.socket, "metrics",
+                                   deadline_s=120.0).decode("utf-8"))
+        slow = json.loads(rep.request(args.socket, "slowlog",
+                                      {"n": args.slowlog}))
+        try:
+            fleet = json.loads(rep.request(args.socket, "fleet"))
+        except Exception:
+            fleet = None
+    finally:
+        _reap(holder["proc"])
+        try:
+            os.unlink(journal)
+        except OSError:
+            pass
+    return {"results": results, "wall_s": wall_s, "before": before,
+            "after": after, "slow": slow, "fleet": fleet, "roll": None,
+            "killed": killed["n"], "kill_after_s": kill_after_s}
 
 
 def main(argv=None) -> int:
@@ -371,6 +473,20 @@ def main(argv=None) -> int:
                          "streamed chunks reassemble exactly, and "
                          "completed outputs are bit-identical to the "
                          "reference (zero lost/duplicated tokens)")
+    ap.add_argument("--kill-daemon", action="store_true",
+                    help="crash-durability certification (round 16): "
+                         "replay FAULT-FREE first against a journal-"
+                         "armed daemon (reference outputs), then again "
+                         "while SIGKILLing the daemon process "
+                         "mid-trace; the restarted daemon recovers "
+                         "from the write-ahead journal and clients "
+                         "resume streams by rid — gate on every "
+                         "non-cancelled request completing "
+                         "bit-identical to the reference with zero "
+                         "lost/duplicated tokens client-side")
+    ap.add_argument("--kill-at", type=float, default=0.4, metavar="F",
+                    help="when to SIGKILL, as a fraction of the "
+                         "reference replay's wall time (default 0.4)")
     ap.add_argument("--rolling-restart", action="store_true",
                     help="after the replay, roll every replica "
                          "(drain -> rebuild -> undrain) under steady "
@@ -411,7 +527,42 @@ def main(argv=None) -> int:
     if args.replicas < 1:
         ap.error("--replicas must be >= 1")
     chaos = None
-    if args.chaos:
+    kill = None
+    if args.kill_daemon:
+        if not args.spawn_daemon:
+            ap.error("--kill-daemon needs --spawn-daemon (the gate "
+                     "must own the process it kills)")
+        if args.chaos:
+            ap.error("--kill-daemon and --chaos are separate "
+                     "scenarios: run them as separate invocations")
+        if not 0.0 < args.kill_at < 1.0:
+            ap.error("--kill-at must be in (0, 1)")
+        # metric rows get their own name: the kill run's attainment is
+        # NOT comparable to the chaos baselines (a full process restart
+        # sits inside the measured window)
+        name = "kill"
+        # fault-free reference first, SAME journal-armed config: its
+        # shas are what every stream resumed across the crash must
+        # equal bit-for-bit
+        fd, ref_journal = tempfile.mkstemp(suffix=".journal.jsonl")
+        os.close(fd)
+        os.unlink(ref_journal)
+        try:
+            ref = run_replay(args, rep, trace, label="[ref] ",
+                             extra_args=["--journal", ref_journal])
+        finally:
+            try:
+                os.unlink(ref_journal)
+            except OSError:
+                pass
+        run = run_kill_replay(args, rep, trace, ref["wall_s"])
+        compared, mismatches = compare_streams(ref["results"],
+                                               run["results"])
+        kill = {"compared": compared, "mismatches": mismatches,
+                "killed": run["killed"],
+                "kill_after_s": round(run["kill_after_s"], 3),
+                "reference_wall_s": round(ref["wall_s"], 3)}
+    elif args.chaos:
         if not args.spawn_daemon:
             ap.error("--chaos needs --spawn-daemon (the reference and "
                      "chaos replays each own a private daemon)")
@@ -451,6 +602,8 @@ def main(argv=None) -> int:
     }
     if chaos is not None:
         report["chaos"] = chaos
+    if kill is not None:
+        report["kill"] = kill
     if run["roll"] is not None:
         report["rolling_restart"] = run["roll"]
     if args.out:
@@ -517,6 +670,58 @@ def main(argv=None) -> int:
               f"bit-compared vs reference, "
               f"{counters.get('daemon_engine_restarts', 0)} restart(s), "
               f"{counters.get('daemon_migrations', 0)} migration(s)",
+              file=sys.stderr, flush=True)
+    if kill is not None:
+        # kill acceptance: the process actually died, the restarted
+        # daemon recovered journaled work and answered resumes, every
+        # non-cancelled request completed, client-side streams carry
+        # zero lost/duplicated bytes, and surviving outputs are
+        # bit-identical to the fault-free reference.  Counters are
+        # ABSOLUTE values from the restarted process (registry reset).
+        if run["killed"] < 1:
+            print("[goodput_gate] FAIL: the daemon was never killed — "
+                  "the run proved nothing", file=sys.stderr, flush=True)
+            rc = 1
+        recov = int(run["after"].get("daemon_recoveries",
+                                     {}).get("value") or 0)
+        resumed = int(run["after"].get("daemon_resumed_streams",
+                                       {}).get("value") or 0)
+        if recov < 1:
+            print("[goodput_gate] FAIL: the restarted daemon replayed "
+                  "no journaled request (daemon_recoveries 0) — the "
+                  "kill landed outside any in-flight window or "
+                  "recovery is broken", file=sys.stderr, flush=True)
+            rc = 1
+        if resumed < 1:
+            print("[goodput_gate] FAIL: no client stream was resumed "
+                  "by rid (daemon_resumed_streams 0)",
+                  file=sys.stderr, flush=True)
+            rc = 1
+        incomplete = [r for r in results
+                      if not r["cancelled"] and not r["ok"]][:3]
+        if incomplete:
+            print(f"[goodput_gate] FAIL: non-cancelled request(s) did "
+                  f"not complete across the kill, e.g. {incomplete}",
+                  file=sys.stderr, flush=True)
+            rc = 1
+        torn = [r for r in results
+                if r["ok"] and r.get("stream_ok") is False][:3]
+        if torn:
+            print(f"[goodput_gate] FAIL: resumed streams carry lost/"
+                  f"duplicated bytes client-side, e.g. {torn}",
+                  file=sys.stderr, flush=True)
+            rc = 1
+        if kill["mismatches"]:
+            print(f"[goodput_gate] FAIL: {len(kill['mismatches'])} "
+                  f"stream(s) diverged from the fault-free reference "
+                  f"across the kill, e.g. {kill['mismatches'][:3]}",
+                  file=sys.stderr, flush=True)
+            rc = 1
+        reconnected = sum(r.get("reconnects", 0) for r in results)
+        print(f"[goodput_gate] kill: {kill['compared']} streams "
+              f"bit-compared vs reference, {run['killed']} kill(s), "
+              f"{recov} journal recover(ies), {resumed} resumed "
+              f"stream(s), {reconnected} client reconnect(s)",
               file=sys.stderr, flush=True)
     if run["roll"] is not None:
         roll = run["roll"]

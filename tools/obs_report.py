@@ -143,20 +143,42 @@ def request_with_retry(sock_path: str, lab: str, config: dict | None = None,
     drain-park during a rolling restart) honors the daemon's
     retry-after hint — all bounded by an absolute ``deadline_s``.  The
     last error is re-raised once the deadline is spent, so a genuinely
-    dead daemon still fails loudly instead of looping forever."""
+    dead daemon still fails loudly instead of looping forever.
+
+    Crash-durable daemons (round 16): a ``generate`` whose config
+    carries a durable ``rid`` retries a connection-refused/reset — the
+    daemon-restart analogue of the rebuilding park — by first asking
+    the restarted daemon to ``resume`` that rid (the journal replays
+    the request server-side), and only falls back to a fresh submission
+    when the daemon answers ``resume unknown rid`` (the crash predated
+    the accept record, so nothing can be duplicated)."""
     import random
     import time
 
     rng = rng or random.Random()
     t0 = time.monotonic()
     attempt = 0
+    rid = (config or {}).get("rid") if lab == "generate" else None
+    tried_conn = False
     while True:
         try:
+            if rid is not None and tried_conn:
+                # a connection already broke once: the request may be
+                # journaled and replaying — resuming by rid returns the
+                # SAME stream instead of submitting a duplicate
+                try:
+                    return request(sock_path, "resume",
+                                   {"rid": rid, "received": 0})
+                except RuntimeError as e:
+                    if "resume unknown rid" not in str(e):
+                        raise
             return request(sock_path, lab, config, payload)
         except (ConnectionError, OSError, RuntimeError) as e:
             shed = _SHED_RE.search(str(e))
             if shed is None and not isinstance(e, (ConnectionError, OSError)):
                 raise  # a real daemon-side error: retrying cannot help
+            if isinstance(e, (ConnectionError, OSError)):
+                tried_conn = True
             attempt += 1
             if shed is not None:
                 # either arm (shed / rebuilding park): group 2 is the

@@ -780,6 +780,118 @@ def bench_fault_overhead(
     }
 
 
+def bench_journal_overhead(
+    slots: int = 4, steps: int = 96, reps: int = 5
+) -> Dict[str, Any]:
+    """Write-ahead journal tax on the serving hot path (round 16):
+    steady-state engine ticks/s WITHOUT any journal (the default — the
+    daemon skips every journal call when ``--journal`` is unset) vs
+    WITH a live :class:`tpulab.durability.Journal` fed exactly the way
+    the daemon's drain callback feeds it — one ``note_tokens`` per slot
+    per tick carrying the full committed prefix, which appends (and
+    flushes) one ``ckpt`` record per slot every ``ckpt_every`` tokens.
+    Accept-record fsyncs happen at ADMISSION, not steady state, so they
+    sit outside the timed window here (as they sit outside the decode
+    loop in the daemon).  Same tiny-model window as
+    ``bench_fault_overhead``; the <1% budget is the ISSUE-12 acceptance
+    bar, asserted on the best-of-reps ratio to isolate intrinsic cost
+    from scheduler noise.  The reported value is the journal-ON ticks/s
+    (the crash-durable serving configuration), gated in baselines.json
+    like ``fault_overhead``."""
+    import os as _os
+    import tempfile
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpulab.durability import Journal
+    from tpulab.models.labformer import LabformerConfig, init_params
+    from tpulab.models.paged import PagedEngine
+    from tpulab.runtime.device import default_device
+
+    cfg = LabformerConfig(d_model=64, n_heads=4, n_layers=2, d_ff=128,
+                          max_seq=256, dtype=jnp.float32)
+    device = default_device()
+    params = jax.device_put(init_params(cfg, seed=0), device)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab, (8,)).astype(np.int32)
+               for _ in range(slots)]
+    warm = 6
+
+    def window(journal_on: bool):
+        jnl = None
+        path = None
+        toks = [[] for _ in range(slots)]
+        if journal_on:
+            fd, path = tempfile.mkstemp(suffix=".journal.jsonl")
+            _os.close(fd)
+            jnl = Journal(path, ckpt_every=16)
+            for i in range(slots):  # admission-time records: untimed
+                jnl.append_accept(f"bench-{i}", "bench",
+                                  bytes(prompts[i].astype(np.uint8)),
+                                  {"steps": warm + steps + 4})
+        eng = PagedEngine(params, cfg, slots=slots, n_blocks=64,
+                          block_size=16, max_seq=256, obs=False)
+        for p in prompts:  # budget outlives warm + timed window
+            eng.submit(p, max_new=warm + steps + 4)
+        for _ in range(warm):  # admission + compile outside the window
+            eng.step()
+        try:
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                eng.step()
+                if jnl is not None:
+                    # the daemon's drain-callback shape: every slot
+                    # committed one token this tick; note_tokens does
+                    # the cadence check and appends a ckpt record every
+                    # ckpt_every tokens
+                    for i in range(slots):
+                        toks[i].append(7)
+                        jnl.note_tokens(f"bench-{i}", toks[i])
+            return time.perf_counter() - t0
+        finally:
+            if jnl is not None:
+                jnl.close()
+                try:
+                    _os.unlink(path)
+                except OSError:
+                    pass
+
+    for on in (False, True):
+        window(on)  # compile prefill bucket + paged_tick
+    times = {False: [], True: []}
+    for attempt in range(5):
+        for _ in range(max(reps, 3)):
+            for on in (False, True):
+                times[on].append(window(on))
+        best_overhead = min(times[True]) / min(times[False]) - 1.0
+        if best_overhead < 0.01:
+            break  # retry-merge as in bench_fault_overhead: extra
+            # attempts only merge more samples into both mins, so a
+            # transient load spike cannot fail a budget a quiet
+            # window passes (5 attempts: one observed CI-box load
+            # shift outlasted 3)
+    t_on = float(np.median(times[True]))
+    t_off = float(np.median(times[False]))
+    assert best_overhead < 0.01, (
+        f"journal overhead {best_overhead * 100:.2f}% exceeds the 1% "
+        f"steady-state decode budget (on={min(times[True]):.4f}s "
+        f"off={min(times[False]):.4f}s)")
+    return {
+        "metric": f"journal_overhead_{slots}slots_ticks_per_s",
+        "value": round(steps / t_on, 1),
+        "unit": "ticks/s",
+        "vs_baseline": None,
+        "off_ticks_per_s": round(steps / t_off, 1),
+        "overhead_pct_median": round((t_on / t_off - 1.0) * 100, 2),
+        "overhead_pct_best": round(best_overhead * 100, 2),
+        "ckpt_every": 16,
+        "device": device.platform,
+        **variance_fields([t * 1e3 for t in times[True]]),
+    }
+
+
 def bench_decode_recompiles(
     slots: int = 4, steps: int = 64, spec_k: int = 2
 ) -> Dict[str, Any]:
@@ -1105,6 +1217,7 @@ def run_benchmarks(only: Optional[str] = None, yield_markers: bool = False,
         "obs_overhead": bench_obs_overhead,
         "obs_history_overhead": bench_obs_history_overhead,
         "fault_overhead": bench_fault_overhead,
+        "journal_overhead": bench_journal_overhead,
         "decode_recompiles": bench_decode_recompiles,
         "train_step_overhead": bench_train_step,
         "labvision_train": bench_labvision_train,

@@ -292,6 +292,21 @@ _C_POSTMORTEMS = _obs.counter(
     "daemon_postmortems",
     "crash post-mortem bundles persisted by the flight recorder "
     "(engine quarantines + replica failures)")
+#: crash-durability counters (round 16): the write-ahead request
+#: journal (tpulab/durability.py) and the restart-recovery machinery
+#: built on it
+_C_JOURNAL_RECORDS = _obs.counter(
+    "daemon_journal_records",
+    "write-ahead journal records appended (accepts fsynced before "
+    "admission + committed-prefix checkpoints + completion records)")
+_C_RECOVERIES = _obs.counter(
+    "daemon_recoveries",
+    "incomplete journaled requests replayed to completion after a "
+    "daemon process restart")
+_C_RESUMED_STREAMS = _obs.counter(
+    "daemon_resumed_streams",
+    "client streams continued by rid after a reconnect (resume "
+    "requests answered from the journal-backed stream table)")
 
 
 def _record_postmortem(reason: str, engine, err) -> None:
@@ -373,6 +388,86 @@ class _StreamBroken(ConnectionError):
     """A chunk-frame sendall failed (possibly mid-write): the wire can
     no longer carry ANY further frame for this request — the connection
     must close without a terminal frame."""
+
+
+#: write-ahead request journal (tpulab/durability.py), armed by
+#: --journal / TPULAB_DAEMON_JOURNAL.  None (the default) keeps the
+#: serving path exactly what it was before round 16 — no record
+#: appends, no resume table, no extra on_progress work.
+_JOURNAL = None
+
+#: resume-by-rid stream table: durable rid -> _ResumeEntry.  Fed by
+#: the journal-armed generate path and by restart recovery; read by
+#: the ``resume`` request.  Bounded: once past the cap, the oldest
+#: FINISHED entries are evicted (an in-flight stream is never dropped).
+_RESUME: "dict" = {}
+_RESUME_LOCK = threading.Lock()
+_RESUME_CAP = int(os.environ.get("TPULAB_DAEMON_RESUME_CAP", "512"))
+
+#: resume stall bound: a resume handler waiting on a stream that makes
+#: no progress for this long gives up with an error frame instead of
+#: pinning its connection slot forever
+_RESUME_STALL_S = float(
+    os.environ.get("TPULAB_DAEMON_RESUME_STALL_S", "600"))
+
+
+class _ResumeEntry:
+    """One request's resumable byte stream: the bytes committed so far
+    (the SAME bytes the original connection's chunk frames carried),
+    completion state, and the condition resume readers park on.  All
+    fields are guarded by ``cond``."""
+
+    __slots__ = ("cond", "buf", "done", "error")
+
+    def __init__(self):
+        self.cond = threading.Condition()
+        self.buf = bytearray()
+        self.done = False
+        self.error = None
+
+    def feed(self, chunk: bytes) -> None:
+        with self.cond:
+            self.buf += chunk
+            self.cond.notify_all()
+
+    def finish(self, data: bytes) -> None:
+        """Terminal: pin the buffer to the FULL output (byte-equal to
+        what incremental feeds accumulated — asserting that equality is
+        the durability tests' job, not a hot-path invariant check)."""
+        with self.cond:
+            self.buf[:] = data
+            self.done = True
+            self.cond.notify_all()
+
+    def fail(self, why: str) -> None:
+        with self.cond:
+            self.error = str(why)
+            self.done = True
+            self.cond.notify_all()
+
+
+def _resume_register(rid: str) -> _ResumeEntry:
+    """Fresh resume entry for ``rid`` (a re-submission under the same
+    rid resets the stream — the new run IS the stream now), evicting
+    the oldest finished entries past the table cap."""
+    entry = _ResumeEntry()
+    with _RESUME_LOCK:
+        _RESUME[rid] = entry
+        if len(_RESUME) > _RESUME_CAP:
+            for old_rid, old in list(_RESUME.items()):
+                if len(_RESUME) <= _RESUME_CAP:
+                    break
+                if old is entry:
+                    continue
+                with old.cond:
+                    if old.done:
+                        _RESUME.pop(old_rid, None)
+    return entry
+
+
+def _resume_lookup(rid: str):
+    with _RESUME_LOCK:
+        return _RESUME.get(rid)
 
 
 #: (realpath|None, attn, kv_dtype, tp, prefill_chunk) ->
@@ -1910,6 +2005,21 @@ def _fleet_for(ckpt, attn: str = "gather", kv_dtype: str = "native",
     return fleet
 
 
+def _decode_out(tok, out, stop_byte: int) -> bytes:
+    """Terminal response bytes from an engine token stream — the ONE
+    copy of the byte-LM/BPE decode + stop-byte cut, shared by the
+    serve path, journal completion replay, and restart recovery."""
+    if tok is None:
+        return bytes(int(t) & 0xFF for t in out)
+    data = tok.decode([int(t) for t in out])
+    if stop_byte >= 0:
+        cut = data.find(bytes([stop_byte]))
+        if cut >= 0:
+            data = data[: cut + 1]  # include the stop byte, like the
+            # byte-LM path (engine stops right AFTER emitting it)
+    return data
+
+
 def _handle_generate(header: dict, payload: bytes,
                      send_chunk=None) -> bytes:
     """``generate`` pseudo-lab: payload = UTF-8 prompt bytes (the byte
@@ -2142,17 +2252,49 @@ def _handle_generate(header: dict, payload: bytes,
                 "speculative decoding needs an int8 draft; MoE "
                 "checkpoints are not quantizable (models/quant.py)")
 
+    # crash durability (round 16): with the journal armed, the accept
+    # record — rid, tag, prompt payload, the FULL config (which carries
+    # the engine build recipe: ckpt_dir/attn/kv_dtype/tp/prefill_chunk)
+    # — is fsynced BEFORE admission, so a process death at any later
+    # point leaves a replayable request, never a lost one.  The durable
+    # rid is the CLIENT's (``config["rid"]`` — the resume-by-rid key it
+    # reconnects with); a client that sent none gets a server-generated
+    # fallback (journaled for replay, but not client-resumable).
+    jnl = _JOURNAL
+    entry = None
+    drid = None
+    if jnl is not None:
+        drid = config.get("rid")
+        if drid is not None:
+            drid = str(drid)
+            if not 0 < len(drid) <= 256:
+                raise ValueError(
+                    "rid must be a non-empty string of at most 256 chars")
+        else:
+            drid = f"srv-{os.getpid()}-{req_rid}"
+        jnl.append_accept(drid, tag, payload, config)
+        if _faults.ACTIVE:
+            # deterministic process death AFTER the accept record is
+            # durable and BEFORE admission — the exact window the
+            # journal exists for (kind "kill": os._exit, no cleanup)
+            _faults.fire("daemon.kill")
+        entry = _resume_register(drid)
+
+    # streaming: each tick's new tokens go out as a status-2 chunk
+    # frame (bytes; BPE-decoded per increment — token expansions
+    # are independent, so chunk boundaries are byte-exact).  Once
+    # the stop byte has been streamed (BPE path: the engine can't
+    # see it, eng_stop=-1) the request is CANCELLED via the return
+    # value — the slot frees at the next tick instead of burning
+    # the remaining ``steps`` budget on silently-discarded tokens
+    # (round-4 advisor finding).  With the journal armed the SAME
+    # closure also runs for non-streaming clients: it feeds the resume
+    # entry's byte stream and checkpoints the committed token prefix at
+    # the journal's bounded cadence.
+    streaming = send_chunk is not None and bool(config.get("stream"))
     on_progress = None
-    if send_chunk is not None and bool(config.get("stream")):
-        # streaming: each tick's new tokens go out as a status-2 chunk
-        # frame (bytes; BPE-decoded per increment — token expansions
-        # are independent, so chunk boundaries are byte-exact).  Once
-        # the stop byte has been streamed (BPE path: the engine can't
-        # see it, eng_stop=-1) the request is CANCELLED via the return
-        # value — the slot frees at the next tick instead of burning
-        # the remaining ``steps`` budget on silently-discarded tokens
-        # (round-4 advisor finding).
-        state = {"done": False}
+    if streaming or entry is not None:
+        state = {"done": False, "toks": []}
 
         def on_progress(new_tokens):
             if state["done"]:
@@ -2166,30 +2308,281 @@ def _handle_generate(header: dict, payload: bytes,
                 if cut >= 0:
                     chunk = chunk[: cut + 1]
                     state["done"] = True
-            if chunk:
+            if entry is not None:
+                state["toks"].extend(int(t) for t in new_tokens)
+                jnl.note_tokens(drid, state["toks"])
+                if chunk:
+                    entry.feed(chunk)
+            if chunk and streaming:
                 send_chunk(chunk)
             return state["done"]
 
-    out = _FLEET_SERVICE.generate(
-        fleet, prompt, steps,
+    try:
+        out = _FLEET_SERVICE.generate(
+            fleet, prompt, steps,
+            temperature=float(config.get("temperature", 0.0)),
+            seed=int(config.get("seed", 0)),
+            repetition_penalty=float(config.get("repetition_penalty", 1.0)),
+            stop_byte=eng_stop,
+            spec=spec_mode, spec_k=spec_k, spec_ngram=spec_ngram,
+            deadline_ms=deadline_ms, priority=priority,
+            req_rid=req_rid, tag=tag, hedge_ms=hedge_ms,
+            on_progress=on_progress,
+        )
+    except ShedError:
+        if jnl is not None:
+            jnl.append_done(drid, "shed")
+            entry.fail("shed before admission")
+        raise
+    except _StreamBroken:
+        # the CLIENT died mid-stream while this process stayed healthy:
+        # the request was cancelled engine-side, so the journal records
+        # a cancellation (recovery must not replay it)
+        if jnl is not None:
+            jnl.append_done(drid, "cancelled")
+            entry.fail("client hung up mid-stream")
+        raise
+    except BaseException as e:
+        if jnl is not None:
+            jnl.append_done(drid, "error")
+            entry.fail(f"{type(e).__name__}: {e}")
+        raise
+    data = _decode_out(tok, out, stop_byte)
+    if jnl is not None:
+        jnl.append_done(drid, "ok", tokens=[int(t) for t in out])
+        entry.finish(data)
+    return data
+
+
+def _handle_resume(header: dict, send_chunk=None) -> bytes:
+    """``resume`` pseudo-lab: continue a journaled stream by rid.
+
+    Config: ``rid`` (the durable id the client submitted its generate
+    with) and ``received`` (how many stream BYTES the client already
+    holds).  The daemon streams ``bytes[received:]`` as status-2 chunk
+    frames — skipping EXACTLY the acknowledged prefix, so the client
+    sees no duplicate and no gap — and answers the terminal frame with
+    the FULL output, same shape as a streamed generate.  A recovering
+    stream that has not yet regenerated past ``received`` simply waits:
+    regeneration is bit-identical (the resubmit contract), so the byte
+    offset is stable across the crash.  Unknown rids get a parseable
+    error body (``resume unknown rid=...``) — the client's signal to
+    fall back to a fresh submission."""
+    config = header.get("config") or {}
+    rid = config.get("rid")
+    if not rid:
+        raise ValueError("resume needs config['rid']")
+    rid = str(rid)
+    received = int(config.get("received", 0))
+    if received < 0:
+        raise ValueError(f"received must be >= 0, got {received}")
+    entry = _resume_lookup(rid)
+    if entry is None:
+        raise ValueError(f"resume unknown rid={rid}")
+    _C_RESUMED_STREAMS.inc()
+    _obs.event("daemon.resume", _obs.next_rid())
+    stream = send_chunk is not None and bool(config.get("stream", True))
+    sent = received
+    stall_at = time.monotonic() + _RESUME_STALL_S
+    while True:
+        with entry.cond:
+            while (not entry.done and len(entry.buf) <= sent
+                   and time.monotonic() < stall_at):
+                entry.cond.wait(0.25)
+            chunk = bytes(entry.buf[sent:])
+            done = entry.done
+            error = entry.error
+        if error is not None:
+            raise RuntimeError(f"resume rid={rid} failed: {error}")
+        if chunk:
+            stall_at = time.monotonic() + _RESUME_STALL_S
+            if stream:
+                send_chunk(chunk)
+            sent += len(chunk)
+        if done:
+            with entry.cond:
+                return bytes(entry.buf)
+        if not chunk and time.monotonic() >= stall_at:
+            raise RuntimeError(
+                f"resume rid={rid} stalled: no stream progress in "
+                f"{_RESUME_STALL_S:g}s")
+
+
+def _recovery_params(config: dict) -> dict:
+    """The replay-relevant generate knobs, decoded from a journaled
+    accept record's config with the SAME defaults ``_handle_generate``
+    applies — recovery must re-derive exactly the engine request the
+    original admission would have built."""
+    return dict(
+        steps=int(config.get("steps", 64)),
+        stop_byte=int(config.get("stop_byte", -1)),
+        attn=str(config.get("attn", "gather")),
+        kv_dtype=str(config.get("kv_dtype", "native")),
+        tp=int(config.get("tp", 1)),
+        prefill_chunk=int(config.get("prefill_chunk", PREFILL_CHUNK)),
         temperature=float(config.get("temperature", 0.0)),
         seed=int(config.get("seed", 0)),
         repetition_penalty=float(config.get("repetition_penalty", 1.0)),
-        stop_byte=eng_stop,
-        spec=spec_mode, spec_k=spec_k, spec_ngram=spec_ngram,
-        deadline_ms=deadline_ms, priority=priority,
-        req_rid=req_rid, tag=tag, hedge_ms=hedge_ms,
-        on_progress=on_progress,
+        priority=int(config.get("priority", 0)),
+        ckpt_dir=config.get("ckpt_dir"),
     )
-    if tok is None:
-        return bytes(int(t) & 0xFF for t in out)
-    data = tok.decode([int(t) for t in out])
-    if stop_byte >= 0:
-        cut = data.find(bytes([stop_byte]))
-        if cut >= 0:
-            data = data[: cut + 1]  # include the stop byte, like the
-            # byte-LM path (engine stops right AFTER emitting it)
-    return data
+
+
+def _refinish_completed(e, entry) -> None:
+    """A rid that RETIRED before the crash (done record with tokens)
+    but whose client may never have read the terminal frame: rebuild
+    the response bytes from the journaled token stream so a
+    reconnecting client's resume is answered instead of bounced into a
+    duplicate submission."""
+    try:
+        p = _recovery_params(e.accept.get("config") or {})
+        fleet = _fleet_for(p["ckpt_dir"], p["attn"], p["kv_dtype"],
+                           p["tp"], p["prefill_chunk"])
+        entry.finish(_decode_out(fleet.tok, e.done.get("tokens") or [],
+                                 p["stop_byte"]))
+    except Exception as err:  # noqa: BLE001 — a failed refinish must
+        # surface through the entry, not kill the recovery thread
+        entry.fail(f"{type(err).__name__}: {err}")
+
+
+def _recover_one(journal, rid: str, e, entry) -> None:
+    """Replay ONE incomplete journaled request to completion: rebuild
+    (or reuse) its fleet from the recorded recipe, seed an engine
+    request with the checkpointed committed prefix, and resume through
+    ``_resubmit_on`` — the same fold-tokens-into-prompt path the
+    supervisor replay and fleet migration are certified on, so greedy
+    streams are bit-identical to an uninterrupted run and sampled
+    streams continue their per-slot key chain."""
+    import numpy as np
+
+    from tpulab import durability
+    from tpulab.models.paged import _Request
+
+    try:
+        config = e.accept.get("config") or {}
+        p = _recovery_params(config)
+        payload = durability.decode_payload(e.accept.get("payload", ""))
+        tag = str(e.accept.get("tag", ""))
+        fleet = _fleet_for(p["ckpt_dir"], p["attn"], p["kv_dtype"],
+                           p["tp"], p["prefill_chunk"])
+        tok = fleet.tok
+        if tok is None:
+            prompt = np.frombuffer(payload, np.uint8).astype(np.int32)
+            eng_stop = p["stop_byte"]
+        else:
+            prompt = tok.encode(bytes(payload))
+            eng_stop = -1
+        req = _Request(
+            req_id=-1,
+            prompt=np.asarray(prompt, np.int32).reshape(-1),
+            max_new=p["steps"], temperature=p["temperature"],
+            seed=p["seed"],
+            repetition_penalty=p["repetition_penalty"],
+            stop_byte=eng_stop,
+            # spec degrades to plain ticks on recovery: speculative
+            # decode is lossless, so the stream is bit-identical either
+            # way (the same degrade _resubmit_on applies on a spec-less
+            # peer)
+            spec="off", spec_k=0,
+            priority=p["priority"], rid=_obs.next_rid(), tag=tag)
+        req.out = [int(t) for t in (e.ckpt or [])]
+        tkt = _Ticket(req, None)
+        tkt.parked = True
+        deadline = time.monotonic() + 600.0
+        while True:
+            target = _FLEET_SERVICE._place(fleet, req.prompt, frozenset())
+            if target is not None and _FLEET_SERVICE._resubmit_on(
+                    target, tkt, migrated=False):
+                break
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    "no placeable replica for journal recovery")
+            with fleet.cv:
+                fleet.cv.wait(0.25)
+        # stream the replay into the resume entry from token 0: the
+        # checkpointed prefix regenerates the SAME bytes the original
+        # connection already sent, which is exactly what lets a
+        # reconnecting client's received-count skip them
+        sent = 0
+        toks: list = []
+        stopped = False
+        while True:
+            with fleet.cv:
+                while not tkt.done and len(req.out) <= sent:
+                    fleet.cv.wait(0.5)
+                done = tkt.done
+                result = tkt.result
+                inc = list(req.out[sent:])
+                sent = len(req.out)
+            if inc and not stopped:
+                toks.extend(inc)
+                if tok is None:
+                    chunk = bytes(int(t) & 0xFF for t in inc)
+                else:
+                    chunk = tok.decode([int(t) for t in inc])
+                if tok is not None and p["stop_byte"] >= 0:
+                    cut = chunk.find(bytes([p["stop_byte"]]))
+                    if cut >= 0:
+                        chunk = chunk[: cut + 1]
+                        stopped = True
+                if chunk:
+                    entry.feed(chunk)
+                journal.note_tokens(rid, toks)
+                if stopped:
+                    _FLEET_SERVICE._engine_cancel(fleet, tkt, mark=False)
+            if done:
+                if isinstance(result, Exception):
+                    raise RuntimeError(
+                        f"recovery replay failed: {result!r}"
+                    ) from result
+                out = result
+                break
+        journal.append_done(rid, "ok", tokens=[int(t) for t in out])
+        entry.finish(_decode_out(tok, out, p["stop_byte"]))
+        _C_RECOVERIES.inc()
+        _obs.event("daemon.recover", req.rid)
+        print(f"[tpulab.daemon] recovered rid={rid} "
+              f"({len(out)} token(s))", flush=True)
+    except Exception as err:  # noqa: BLE001 — one unrecoverable rid
+        # must not kill the thread silently: the entry carries the
+        # error to any resuming client, and the journal records it so
+        # the NEXT restart does not replay a poisoned request forever
+        try:
+            journal.append_done(rid, "error")
+        except Exception:
+            pass
+        entry.fail(f"{type(err).__name__}: {err}")
+        print(f"[tpulab.daemon] recovery FAILED for rid={rid}: {err}",
+              flush=True)
+
+
+def _recover_from_journal(journal) -> int:
+    """Scan the journal (torn final record tolerated), compact it, and
+    launch the recovery threads: completed-ok rids re-register their
+    finished streams (resume-by-rid answered from the journaled
+    tokens); incomplete rids replay to completion.  Registration is
+    SYNCHRONOUS — by the time the daemon accepts its first resume
+    request every journaled rid is in the table, waiting on its
+    recovery thread.  Returns the incomplete count."""
+    state = journal.scan()
+    if state.torn:
+        print("[tpulab.daemon] journal: torn final record ignored",
+              flush=True)
+    # compact BEFORE the recovery threads start appending fresh
+    # records — compaction rewrites from the scanned state, and a
+    # concurrent append would be lost in the rewrite
+    journal.compact(state)
+    for rid, e in state.completed_ok().items():
+        entry = _resume_register(rid)
+        threading.Thread(target=_refinish_completed, args=(e, entry),
+                         daemon=True).start()
+    incomplete = state.incomplete()
+    for rid, e in incomplete.items():
+        entry = _resume_register(rid)
+        threading.Thread(target=_recover_one,
+                         args=(journal, rid, e, entry),
+                         daemon=True).start()
+    return len(incomplete)
 
 
 def _handle_generate_stats(header: dict) -> bytes:
@@ -2642,6 +3035,8 @@ def handle_request(header: dict, payload: bytes,
                    send_chunk=None) -> bytes:
     if header.get("lab") == "generate":
         return _handle_generate(header, payload, send_chunk)
+    if header.get("lab") == "resume":
+        return _handle_resume(header, send_chunk)
     if header.get("lab") == "generate_stats":
         return _handle_generate_stats(header)
     if header.get("lab") == "metrics":
@@ -2826,8 +3221,10 @@ def serve(socket_path: str, *, max_requests: Optional[int] = None) -> None:
             with served_lock:
                 served["n"] += 1
 
+    # hoisted ABOVE the try: the SIGTERM KeyboardInterrupt can land on
+    # any bytecode inside it, and the graceful drain below reads this
+    accepted = 0
     try:
-        accepted = 0
         while not stop["flag"]:
             conn, _ = srv.accept()
             # bound handler threads: accept stalls at the cap instead of
@@ -2849,6 +3246,36 @@ def serve(socket_path: str, *, max_requests: Optional[int] = None) -> None:
     except KeyboardInterrupt:
         pass
     finally:
+        if stop["flag"]:
+            # graceful SIGTERM: drain in-flight handlers (bounded well
+            # under the 30 s the goodput gate allows before SIGKILL),
+            # flush + compact the journal so a restart recovers from a
+            # minimal file, and persist a shutdown flight-recorder
+            # bundle — the "clean exit" evidence trail, symmetric with
+            # the crash bundles the supervisor records
+            for _ in range(150):
+                with served_lock:
+                    if served["n"] >= accepted:
+                        break
+                time.sleep(0.1)
+            if _JOURNAL is not None:
+                try:
+                    _JOURNAL.flush()
+                    _JOURNAL.compact()
+                except Exception:
+                    traceback.print_exc()
+            with served_lock:
+                n_served = served["n"]
+            from tpulab.obs import flightrec
+
+            if flightrec.record_postmortem(
+                    "shutdown",
+                    extra={"accepted": accepted, "served": n_served,
+                           "journal": getattr(_JOURNAL, "path", None)},
+            ) is not None:
+                _C_POSTMORTEMS.inc()
+            print(f"[tpulab.daemon] graceful shutdown: accepted="
+                  f"{accepted} served={n_served}", flush=True)
         stop_sampler()
         srv.close()
         try:
@@ -2858,7 +3285,7 @@ def serve(socket_path: str, *, max_requests: Optional[int] = None) -> None:
 
 
 def main(argv=None) -> int:
-    global PREFILL_CHUNK, REPLICAS, HEDGE_MS, METRICS_INTERVAL_S
+    global PREFILL_CHUNK, REPLICAS, HEDGE_MS, METRICS_INTERVAL_S, _JOURNAL
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--socket", default=os.environ.get("TPULAB_DAEMON_SOCKET", "/tmp/tpulab.sock"))
     ap.add_argument("--max-requests", type=int, default=None, help="exit after N requests (tests)")
@@ -2892,6 +3319,15 @@ def main(argv=None) -> int:
                          "32768; 0 disables tracing).  Dump the retained "
                          "window with a 'trace_dump' request — the JSON "
                          "loads directly in Perfetto")
+    ap.add_argument("--journal", default=os.environ.get(
+                        "TPULAB_DAEMON_JOURNAL"), metavar="PATH",
+                    help="write-ahead request journal (crash "
+                         "durability): accepts fsynced before "
+                         "admission, committed prefixes checkpointed, "
+                         "incomplete requests replayed on restart and "
+                         "client streams resumable by rid (default "
+                         "TPULAB_DAEMON_JOURNAL env; unset = off, "
+                         "streams bit-identical either way)")
     ap.add_argument("--slowlog", type=int, default=None, metavar="N",
                     help="per-request slow-log window: keep the worst N "
                          "requests by e2e latency (default 64; 0 "
@@ -2928,6 +3364,14 @@ def main(argv=None) -> int:
         # TPULAB_FAULTS (JSON schedule) — absent means inert
         print("[tpulab.daemon] fault injector ARMED from TPULAB_FAULTS",
               flush=True)
+    if args.journal:
+        from tpulab.durability import Journal
+
+        _JOURNAL = Journal(args.journal,
+                           on_record=_C_JOURNAL_RECORDS.inc)
+        n = _recover_from_journal(_JOURNAL)
+        print(f"[tpulab.daemon] journal {args.journal}: "
+              f"{n} incomplete request(s) recovering", flush=True)
     serve(args.socket, max_requests=args.max_requests)
     return 0
 

@@ -40,6 +40,9 @@ Sites wired in this round (grep for ``_FAULTS``/``faults.fire``):
 ``daemon.step``        the daemon stepper loop, before ``engine.step()``
 ``daemon.send``        before a response/chunk ``sendall`` (``slow_ms`` —
                        a wedged client connection)
+``daemon.kill``        after the journal accept record is durable, before
+                       admission (``kill`` — deterministic process death;
+                       subprocess-based tests only)
 =====================  =====================================================
 
 Fault kinds:
@@ -52,7 +55,11 @@ Fault kinds:
 * ``corrupt_table``  — site writes an out-of-range physical block into
   a slot table (the engine's release-time integrity check trips);
 * ``slow_ms``        — sleep ``arg`` milliseconds at the site (slow or
-  wedged host sync / client socket).
+  wedged host sync / client socket);
+* ``kill``           — ``os._exit(arg or 1)`` at the site: instant
+  process death with no cleanup (the SIGKILL/OOM/preemption stand-in
+  the write-ahead journal recovers from).  Fire it only in a daemon
+  SUBPROCESS — in-process it kills the test runner.
 
 Schedules are lists of rule dicts::
 
@@ -98,7 +105,7 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
-KINDS = ("raise", "nan_tokens", "corrupt_table", "slow_ms")
+KINDS = ("raise", "nan_tokens", "corrupt_table", "slow_ms", "kill")
 
 
 class InjectedFault(RuntimeError):
@@ -229,6 +236,16 @@ class FaultInjector:
         if rule.kind == "slow_ms":
             time.sleep(rule.arg / 1e3)
             return rule
+        if rule.kind == "kill":
+            # instant process death: os._exit skips every finally,
+            # atexit hook, and flush — the closest in-process stand-in
+            # for SIGKILL/OOM/preemption, which is exactly what the
+            # write-ahead journal (tpulab/durability.py) must survive.
+            # ``arg`` is the exit status (default 1).  Subprocess-based
+            # tests only: firing this in-process kills the test runner.
+            import os
+
+            os._exit(int(rule.arg) if rule.arg else 1)
         return rule
 
 

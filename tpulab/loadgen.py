@@ -387,61 +387,103 @@ def _blank_result(r: dict, tag: str) -> dict:
         # streamed chunk concatenation equals that output exactly
         # (None when nothing streamed before the terminal frame)
         "sha": None, "stream_ok": None,
+        # crash-durable serving (round 16): connections re-established
+        # after a refused/reset socket (a daemon restart), and whether
+        # the stream was continued by rid via the daemon's ``resume``
+        # request instead of being resubmitted
+        "reconnects": 0, "resumed": False,
     }
 
 
 def _run_one(socket_path: str, r: dict, tag: str, timeout_s: float) -> dict:
-    """Send one trace request; measure the client-observed span."""
+    """Send one trace request; measure the client-observed span.
+
+    Crash-durable path (round 16): the request carries its tag as the
+    durable ``rid``, and a connection refused/reset mid-request — a
+    daemon restart, the process-death analogue of the ``rebuilding``
+    park — triggers a jittered-backoff reconnect that CONTINUES the
+    stream by rid (``resume`` request, received-count = bytes already
+    held) instead of resubmitting.  The streamed concatenation therefore
+    stays gap- and duplicate-free across the crash, and ``stream_ok``
+    against the terminal frame proves it.  A daemon without a journal
+    answers ``resume unknown rid``: if nothing had streamed yet the
+    client falls back to one fresh submission (old behaviour); if bytes
+    HAD streamed it reports the error rather than resubmit-and-
+    duplicate."""
+    import random as _random
+
     out = _blank_result(r, tag)
     config = {"steps": r["steps"], "stream": True,
-              "priority": r["priority"], "tag": tag}
+              "priority": r["priority"], "tag": tag, "rid": tag}
     if r.get("deadline_ms") is not None:
         config["deadline_ms"] = r["deadline_ms"]
-    header = json.dumps({"lab": "generate", "config": config}).encode()
+    gen_header = json.dumps({"lab": "generate", "config": config}).encode()
     payload = r["prompt"].encode("utf-8")
-    s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-    s.settimeout(timeout_s)
     t_send = time.monotonic()
     deadline = t_send + timeout_s
     cancel_at = (t_send + r["cancel_after_ms"] / 1e3
                  if r.get("cancel_after_ms") is not None else None)
-    try:
-        s.connect(socket_path)
-        s.sendall(struct.pack("<I", len(header)) + header
-                  + struct.pack("<Q", len(payload)) + payload)
-        t_prev = None
-        streamed = b""
-        while True:
-            status = _read_exact(s, 1, cancel_at, deadline)[0]
-            (n,) = struct.unpack(
-                "<Q", _read_exact(s, 8, cancel_at, deadline))
-            body = _read_exact(s, n, cancel_at, deadline)
-            now = time.monotonic()
-            if status == 2:  # streamed chunk: the client-observed ticks
-                out["n_chunks"] += 1
-                streamed += body
-                if out["ttft_ms"] is None:
-                    out["ttft_ms"] = round((now - t_send) * 1e3, 3)
-                elif t_prev is not None:
-                    out["itl_max_ms"] = round(
-                        max(out["itl_max_ms"], (now - t_prev) * 1e3), 3)
-                t_prev = now
-                continue
-            if status == 0:
-                import hashlib
-
-                out["ok"] = True
-                out["e2e_ms"] = round((now - t_send) * 1e3, 3)
-                out["bytes_out"] = len(body)
-                out["sha"] = hashlib.sha256(body).hexdigest()[:16]
-                if out["n_chunks"]:
-                    # the terminal frame carries the FULL output with
-                    # chunks included: exact equality of the streamed
-                    # concatenation is the zero-lost/duplicated-token
-                    # check a migrated/hedged stream must pass
-                    out["stream_ok"] = streamed == body
+    rng = _random.Random(tag)
+    t_prev = None
+    streamed = b""
+    mode = "generate"
+    while True:
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        s.settimeout(timeout_s)
+        try:
+            s.connect(socket_path)
+            if mode == "generate":
+                header, body_out = gen_header, payload
             else:
+                header = json.dumps({
+                    "lab": "resume",
+                    "config": {"rid": tag, "received": len(streamed),
+                               "stream": True}}).encode()
+                body_out = b""
+            s.sendall(struct.pack("<I", len(header)) + header
+                      + struct.pack("<Q", len(body_out)) + body_out)
+            while True:
+                status = _read_exact(s, 1, cancel_at, deadline)[0]
+                (n,) = struct.unpack(
+                    "<Q", _read_exact(s, 8, cancel_at, deadline))
+                body = _read_exact(s, n, cancel_at, deadline)
+                now = time.monotonic()
+                if status == 2:  # streamed chunk: client-observed ticks
+                    out["n_chunks"] += 1
+                    streamed += body
+                    if out["ttft_ms"] is None:
+                        out["ttft_ms"] = round((now - t_send) * 1e3, 3)
+                    elif t_prev is not None:
+                        out["itl_max_ms"] = round(
+                            max(out["itl_max_ms"], (now - t_prev) * 1e3),
+                            3)
+                    t_prev = now
+                    continue
+                if status == 0:
+                    import hashlib
+
+                    out["ok"] = True
+                    out["e2e_ms"] = round((now - t_send) * 1e3, 3)
+                    out["bytes_out"] = len(body)
+                    out["sha"] = hashlib.sha256(body).hexdigest()[:16]
+                    if out["n_chunks"]:
+                        # the terminal frame carries the FULL output
+                        # with chunks included: exact equality of the
+                        # streamed concatenation is the zero-lost/
+                        # duplicated-token check a migrated/hedged/
+                        # resumed stream must pass
+                        out["stream_ok"] = streamed == body
+                    return out
                 text = body.decode("utf-8", "replace")
+                if (mode == "resume" and not streamed
+                        and "resume unknown rid" in text):
+                    # the crash predated the accept record (or the
+                    # daemon runs without a journal): nothing was ever
+                    # admitted, so ONE fresh submission cannot
+                    # duplicate anything
+                    mode = "generate"
+                    out["resumed"] = False
+                    break
                 shed = SHED_RE.search(text)
                 if shed:
                     # both arms are backpressure, but they are NOT the
@@ -452,17 +494,29 @@ def _run_one(socket_path: str, r: dict, tag: str, timeout_s: float) -> dict:
                     out["retry_after_ms"] = int(shed.group(2))
                 else:
                     out["error"] = text[-300:]
+                return out
+        except _Cancelled:
+            # scripted mid-stream hang-up: closing the socket (finally)
+            # breaks the daemon's chunk stream, which cancels the
+            # request
+            out["cancelled"] = True
             return out
-    except _Cancelled:
-        # scripted mid-stream hang-up: closing the socket (finally)
-        # breaks the daemon's chunk stream, which cancels the request
-        out["cancelled"] = True
-        return out
-    except (OSError, ConnectionError, TimeoutError) as e:
-        out["error"] = f"{type(e).__name__}: {e}"
-        return out
-    finally:
-        s.close()
+        except (OSError, ConnectionError, TimeoutError) as e:
+            # connection refused/reset: the daemon-restart park.  Back
+            # off with full jitter and reconnect in resume mode —
+            # UNLESS the request's own deadline is spent, which stays a
+            # hard failure exactly as before.
+            if time.monotonic() >= deadline - 0.05:
+                out["error"] = f"{type(e).__name__}: {e}"
+                return out
+            out["reconnects"] += 1
+            mode = "resume"
+            out["resumed"] = True
+            backoff = rng.uniform(
+                0.05, 0.05 * (2 ** min(out["reconnects"], 5)))
+            time.sleep(min(backoff, max(0.0, deadline - time.monotonic())))
+        finally:
+            s.close()
 
 
 def replay(trace: Trace, socket_path: str, *, time_scale: float = 1.0,

@@ -87,7 +87,11 @@ def _load_obs_report():
 _COUNTERS = ("daemon_shed_requests", "daemon_replays",
              "daemon_engine_restarts", "engine_preemptions",
              "daemon_migrations", "daemon_hedges", "daemon_hedge_wins",
-             "daemon_drains")
+             "daemon_drains",
+             # round 17: the elastic-fleet surface
+             "daemon_scale_outs", "daemon_scale_ins",
+             "daemon_spot_preemptions", "daemon_brownout_steps",
+             "daemon_brownout_reversals")
 
 #: the chaos fault schedule (--chaos, replayed via TPULAB_FAULTS in
 #: the spawned daemon's environment): CRASH replica1 mid-trace (its
@@ -105,6 +109,18 @@ CHAOS_SCHEDULE = [
     # the wedged replica
     {"site": "paged.drain@replica2", "kind": "slow_ms", "at": 30,
      "count": 60, "arg": 300.0},
+]
+
+#: the elastic-fleet drill (--autoscale): one spot-preemption NOTICE
+#: delivered to replica1 — the slot the first scale-out brings up — a
+#: few dozen stepper ticks into its life (mid-burst), with a 2 s drain
+#: deadline.  The replica migrates what the deadline allows, parks the
+#: stragglers, and releases; the reconcile loop revives the slot
+#: because provisioned fell below target.  Scoped, so it is
+#: deterministic per replica regardless of stepper interleaving.
+RAMP_PREEMPT_SCHEDULE = [
+    {"site": "replica.preempt@replica1", "kind": "preempt", "at": 40,
+     "arg": 2000.0},
 ]
 
 #: histograms percentile-diffed over the replay window
@@ -303,12 +319,43 @@ def compare_streams(ref_results: list, chaos_results: list):
     return compared, mismatches
 
 
+def settle_fleet(rep, sock: str, floor: int, log,
+                 timeout_s: float = 180.0) -> dict:
+    """Post-burst convergence poll (--autoscale): wait for the fleet
+    to return to its ``floor`` serving replicas with the brownout
+    ladder fully released.  This is the decay half of the elastic
+    story — the scrape that follows captures the scale-in and reversal
+    counters the acceptance block gates on."""
+    t0 = time.monotonic()
+    last = None
+    while time.monotonic() - t0 < timeout_s:
+        try:
+            last = json.loads(rep.request(sock, "fleet"))
+        except Exception:
+            time.sleep(0.5)
+            continue
+        active = last.get("active")
+        level = (last.get("brownout") or {}).get("level", 0)
+        target = (last.get("autoscale") or {}).get("target")
+        if active == floor and level == 0 and target == floor:
+            waited = time.monotonic() - t0
+            log(f"[goodput_gate] fleet settled at floor={floor}, "
+                f"brownout level 0 after {waited:.1f}s")
+            return {"settled": True, "waited_s": round(waited, 3),
+                    "final": last}
+        time.sleep(0.5)
+    return {"settled": False,
+            "waited_s": round(time.monotonic() - t0, 3), "final": last}
+
+
 def run_replay(args, rep, trace, *, extra_env=None, extra_args=None,
-               rolling=False, label=""):
+               rolling=False, settle=None, label=""):
     """One full replay window against a (possibly spawned) daemon:
     warmup outside the window, before/after scrapes, trace replay,
-    slowlog + fleet captures, optional rolling-restart phase.  Returns
-    every capture the report needs."""
+    slowlog + fleet captures, optional rolling-restart phase.
+    ``settle`` (the autoscale scenario) runs between the replay and
+    the after-scrape, so convergence-phase counter movement lands in
+    the deltas.  Returns every capture the report needs."""
     daemon_proc = None
     if args.spawn_daemon:
         daemon_proc = _spawn_daemon(
@@ -330,6 +377,9 @@ def run_replay(args, rep, trace, *, extra_env=None, extra_args=None,
             trace, args.socket, time_scale=args.time_scale,
             timeout_s=args.timeout_s,
             log=lambda m: log(f"{label}{m}"))
+        settled = None
+        if settle is not None:
+            settled = settle(log)
         after = rep.parse_prometheus(
             rep.request(args.socket, "metrics").decode("utf-8"))
         slow = json.loads(rep.request(args.socket, "slowlog",
@@ -344,7 +394,8 @@ def run_replay(args, rep, trace, *, extra_env=None, extra_args=None,
     finally:
         _reap(daemon_proc)
     return {"results": results, "wall_s": wall_s, "before": before,
-            "after": after, "slow": slow, "fleet": fleet, "roll": roll}
+            "after": after, "slow": slow, "fleet": fleet, "roll": roll,
+            "settled": settled}
 
 
 def run_kill_replay(args, rep, trace, ref_wall_s: float,
@@ -484,6 +535,22 @@ def main(argv=None) -> int:
                          "non-cancelled request completing "
                          "bit-identical to the reference with zero "
                          "lost/duplicated tokens client-side")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="elastic-fleet certification (round 17): "
+                         "replay the trace FAULT-FREE against a fixed "
+                         "one-replica daemon (reference outputs), then "
+                         "again against an autoscaler-armed daemon "
+                         "(floor 1, ceiling 3) with one spot "
+                         "preemption injected on the scaled-out "
+                         "replica — gate on scale-out engaging, "
+                         "brownout steps firing AND fully reversing, "
+                         "attainment 1.0, zero lost/duplicated client "
+                         "bytes vs the reference, and the fleet "
+                         "settling back to its floor (use with "
+                         "--spec ramp)")
+    ap.add_argument("--autoscale-max", type=int, default=3, metavar="N",
+                    help="ceiling passed to the autoscaler-armed "
+                         "daemon in the --autoscale scenario")
     ap.add_argument("--kill-at", type=float, default=0.4, metavar="F",
                     help="when to SIGKILL, as a fraction of the "
                          "reference replay's wall time (default 0.4)")
@@ -528,6 +595,10 @@ def main(argv=None) -> int:
         ap.error("--replicas must be >= 1")
     chaos = None
     kill = None
+    autoscale = None
+    if args.autoscale and (args.chaos or args.kill_daemon):
+        ap.error("--autoscale is its own scenario: run --chaos/"
+                 "--kill-daemon as separate invocations")
     if args.kill_daemon:
         if not args.spawn_daemon:
             ap.error("--kill-daemon needs --spawn-daemon (the gate "
@@ -580,6 +651,38 @@ def main(argv=None) -> int:
         chaos = {"schedule": CHAOS_SCHEDULE, "compared": compared,
                  "mismatches": mismatches,
                  "reference_wall_s": round(ref["wall_s"], 3)}
+    elif args.autoscale:
+        if not args.spawn_daemon:
+            ap.error("--autoscale needs --spawn-daemon (the reference "
+                     "and elastic replays each own a private daemon)")
+        if args.replicas != 1:
+            ap.error("--autoscale starts at the fleet floor: use "
+                     "--replicas 1")
+        if args.autoscale_max < 2:
+            ap.error("--autoscale-max must be >= 2 (the scenario must "
+                     "have headroom to scale out)")
+        # fault-free, fixed-size, autoscaler-DISARMED reference first:
+        # its shas are the disabled-by-default contract — every stream
+        # the elastic run serves (across scale-out, brownout, and the
+        # preemption) must equal them bit-for-bit
+        ref = run_replay(args, rep, trace, label="[ref] ")
+        fault_env = {"TPULAB_FAULTS": json.dumps(RAMP_PREEMPT_SCHEDULE)}
+        auto_args = ["--autoscale-min", "1",
+                     "--autoscale-max", str(args.autoscale_max),
+                     # a tighter control-loop cadence than the 1 s
+                     # default: the trace's burst phase is short
+                     "--metrics-interval", "0.5"]
+        run = run_replay(
+            args, rep, trace, extra_env=fault_env,
+            extra_args=auto_args, label="[autoscale] ",
+            settle=lambda log: settle_fleet(rep, args.socket, 1, log))
+        compared, mismatches = compare_streams(ref["results"],
+                                               run["results"])
+        autoscale = {"schedule": RAMP_PREEMPT_SCHEDULE,
+                     "ceiling": args.autoscale_max,
+                     "compared": compared, "mismatches": mismatches,
+                     "settled": run["settled"],
+                     "reference_wall_s": round(ref["wall_s"], 3)}
     else:
         run = run_replay(args, rep, trace,
                          rolling=args.rolling_restart)
@@ -604,6 +707,8 @@ def main(argv=None) -> int:
         report["chaos"] = chaos
     if kill is not None:
         report["kill"] = kill
+    if autoscale is not None:
+        report["autoscale"] = autoscale
     if run["roll"] is not None:
         report["rolling_restart"] = run["roll"]
     if args.out:
@@ -722,6 +827,80 @@ def main(argv=None) -> int:
               f"bit-compared vs reference, {run['killed']} kill(s), "
               f"{recov} journal recover(ies), {resumed} resumed "
               f"stream(s), {reconnected} client reconnect(s)",
+              file=sys.stderr, flush=True)
+    if autoscale is not None:
+        # elastic acceptance: the controller actually scaled out AND
+        # back in, the brownout ladder engaged and FULLY reversed, the
+        # injected preemption fired, the fleet settled at its floor,
+        # attainment held at 1.0 through the ramp, streams reassembled
+        # exactly, and every surviving output is bit-identical to the
+        # disarmed reference (zero lost/duplicated client bytes).
+        counters = report["counters"]
+        if counters.get("daemon_scale_outs", 0) < 1:
+            print("[goodput_gate] FAIL: the ramp never drove a "
+                  "scale-out (daemon_scale_outs delta 0) — the run "
+                  "proved nothing", file=sys.stderr, flush=True)
+            rc = 1
+        if counters.get("daemon_scale_ins", 0) < 1:
+            print("[goodput_gate] FAIL: the decay never drove a "
+                  "scale-in (daemon_scale_ins delta 0)",
+                  file=sys.stderr, flush=True)
+            rc = 1
+        if counters.get("daemon_spot_preemptions", 0) < 1:
+            print("[goodput_gate] FAIL: the injected spot preemption "
+                  "never fired (daemon_spot_preemptions delta 0)",
+                  file=sys.stderr, flush=True)
+            rc = 1
+        steps = counters.get("daemon_brownout_steps", 0)
+        reversals = counters.get("daemon_brownout_reversals", 0)
+        if steps < 1:
+            print("[goodput_gate] FAIL: no brownout rung ever engaged "
+                  "(daemon_brownout_steps delta 0)",
+                  file=sys.stderr, flush=True)
+            rc = 1
+        if steps != reversals:
+            print(f"[goodput_gate] FAIL: brownout did not fully "
+                  f"reverse: {steps} engage(s) vs {reversals} "
+                  f"release(s)", file=sys.stderr, flush=True)
+            rc = 1
+        if not (run["settled"] or {}).get("settled"):
+            print(f"[goodput_gate] FAIL: fleet never settled back to "
+                  f"its floor: {run['settled']}",
+                  file=sys.stderr, flush=True)
+            rc = 1
+        if overall["attainment"] != 1.0:
+            print(f"[goodput_gate] FAIL: attainment "
+                  f"{overall['attainment']} != 1.0 through the ramp",
+                  file=sys.stderr, flush=True)
+            rc = 1
+        incomplete = [r for r in results
+                      if not r["cancelled"] and not r["ok"]][:3]
+        if incomplete:
+            print(f"[goodput_gate] FAIL: non-cancelled request(s) did "
+                  f"not complete through the ramp, e.g. {incomplete}",
+                  file=sys.stderr, flush=True)
+            rc = 1
+        torn = [r for r in results
+                if r["ok"] and r.get("stream_ok") is False][:3]
+        if torn:
+            print(f"[goodput_gate] FAIL: streamed chunks do not "
+                  f"reassemble to the terminal output (lost/duplicated "
+                  f"bytes), e.g. {torn}", file=sys.stderr, flush=True)
+            rc = 1
+        if autoscale["mismatches"]:
+            print(f"[goodput_gate] FAIL: {len(autoscale['mismatches'])} "
+                  f"stream(s) diverged from the disarmed reference, "
+                  f"e.g. {autoscale['mismatches'][:3]}",
+                  file=sys.stderr, flush=True)
+            rc = 1
+        print(f"[goodput_gate] autoscale: {autoscale['compared']} "
+              f"streams bit-compared vs reference, "
+              f"{counters.get('daemon_scale_outs', 0)} scale-out(s), "
+              f"{counters.get('daemon_scale_ins', 0)} scale-in(s), "
+              f"{counters.get('daemon_spot_preemptions', 0)} "
+              f"preemption(s), {steps} brownout step(s) / "
+              f"{reversals} reversal(s), "
+              f"{counters.get('daemon_migrations', 0)} migration(s)",
               file=sys.stderr, flush=True)
     if run["roll"] is not None:
         roll = run["roll"]

@@ -255,6 +255,28 @@ METRICS_INTERVAL_S = float(
 #: history ring capacity in samples (15 min at the 1 s default cadence)
 HISTORY_CAPACITY = int(os.environ.get("TPULAB_DAEMON_HISTORY", "900"))
 
+#: elastic fleet (round 17): autoscale bounds.  ``--autoscale-max N``
+#: (N >= 1) arms the telemetry-driven controller (tpulab/autoscale.py)
+#: riding the history sampler tick: the fleet grows toward
+#: AUTOSCALE_MAX under sustained pressure and shrinks back to the
+#: AUTOSCALE_MIN floor as it decays, one replica per decision, with
+#: per-direction cooldowns and flap hysteresis.  0 (the default)
+#: disables the controller entirely — the fleet stays the fixed
+#: ``--replicas N`` it has been since round 13, bit-identical.
+AUTOSCALE_MIN = int(os.environ.get("TPULAB_DAEMON_AUTOSCALE_MIN", "1"))
+AUTOSCALE_MAX = int(os.environ.get("TPULAB_DAEMON_AUTOSCALE_MAX", "0"))
+
+#: brownout rung 3 (``token_cap``): new admissions' max output tokens
+#: are capped here while the ladder holds level >= 3
+BROWNOUT_TOKEN_CAP = int(
+    os.environ.get("TPULAB_DAEMON_BROWNOUT_TOKEN_CAP", "64"))
+
+#: signal window the autoscale controller reads (queue-wait p99, shed
+#: rate) — shorter than the shed check's QUEUE_WAIT_WINDOW_S so the
+#: controller reacts to the ramp edge, not the hour
+AUTOSCALE_WINDOW_S = float(
+    os.environ.get("TPULAB_DAEMON_AUTOSCALE_WINDOW_S", "15"))
+
 #: fault-tolerance counters (process-global registry, in every
 #: ``metrics`` scrape): engine step loops quarantined+rebuilt, requests
 #: replayed into a rebuilt engine, and requests shed with retry-after
@@ -307,6 +329,39 @@ _C_RESUMED_STREAMS = _obs.counter(
     "daemon_resumed_streams",
     "client streams continued by rid after a reconnect (resume "
     "requests answered from the journal-backed stream table)")
+#: elastic-fleet counters/gauges (round 17): the autoscale controller's
+#: reconciliations, the brownout ladder's rung transitions, and the
+#: spot-preemption drill — every fleet-shape change is counted, and the
+#: two gauges make the CURRENT control state scrapeable (target vs
+#: actual replicas, ladder level)
+_C_SCALE_OUTS = _obs.counter(
+    "daemon_scale_outs",
+    "replicas added by the autoscaler (fresh spawns + retired-slot "
+    "revivals, each warmed and placed into service)")
+_C_SCALE_INS = _obs.counter(
+    "daemon_scale_ins",
+    "replicas retired by the autoscaler (drained, in-flight requests "
+    "migrated to peers, engine released)")
+_C_SPOT_PREEMPTIONS = _obs.counter(
+    "daemon_spot_preemptions",
+    "spot-preemption notices delivered to replicas (replica.preempt "
+    "drills: deadline-bounded drain-and-migrate, then release)")
+_C_BROWNOUT_STEPS = _obs.counter(
+    "daemon_brownout_steps",
+    "brownout ladder rungs engaged under sustained pressure "
+    "(hedging_off -> spec_off -> token_cap -> deadline_tight)")
+_C_BROWNOUT_REVERSALS = _obs.counter(
+    "daemon_brownout_reversals",
+    "brownout ladder rungs released (reverse order) as pressure "
+    "decayed")
+_G_TARGET_REPLICAS = _obs.gauge(
+    "fleet_target_replicas",
+    "the autoscale controller's current target replica count, summed "
+    "across armed fleets (0 = autoscaling disabled)")
+_G_BROWNOUT_LEVEL = _obs.gauge(
+    "daemon_brownout_level",
+    "current brownout ladder level (0 = healthy, 4 = every rung "
+    "engaged), worst across armed fleets")
 
 
 def _record_postmortem(reason: str, engine, err) -> None:
@@ -1044,6 +1099,10 @@ class _Replica:
         self.generation = 0           # completed rebuilds
         self.restarts = 0             # failure-driven rebuilds
         self.parked: list = []        # tickets awaiting this rebuild
+        #: round 17: the slot holds NO engine (scale-in / spot
+        #: preemption released it) until a scale-out revives it —
+        #: fleet.cv-guarded like the rest of the placement state
+        self.retired = False
         # per-replica windowed health evidence (round 15): the stepper
         # counts every tick and every slow/stalled tick into these
         # registry counters, and the alert engine's ReplicaStallRule
@@ -1091,6 +1150,21 @@ class _Fleet:
         self.cv = threading.Condition()
         self.replicas: list = []
         self.tok = None
+        # round 17 (elastic fleet): the telemetry-driven controller +
+        # brownout ladder, armed only when --autoscale-max >= 1 — a
+        # disarmed fleet is bit-identical to the fixed-size rounds
+        # before it (the sampler hook and the admission hooks all
+        # guard on None)
+        self.autoscaler = None
+        self.brownout = None
+        self.scaling = False          # one reconcile op in flight (cv)
+        if AUTOSCALE_MAX >= 1:
+            from tpulab import autoscale as _autoscale
+
+            self.autoscaler = _autoscale.AutoscalePolicy(
+                AUTOSCALE_MIN, AUTOSCALE_MAX)
+            self.brownout = _autoscale.BrownoutLadder(
+                token_cap=BROWNOUT_TOKEN_CAP)
 
     def add(self, engine, tok) -> "_Replica":
         r = _Replica(self, len(self.replicas), engine, tok)
@@ -1098,6 +1172,26 @@ class _Fleet:
         if self.tok is None:
             self.tok = tok
         return r
+
+    # round 17: the elastic surface.  Thin delegations so the policy
+    # loop (and tests) drive fleet shape through the fleet object; the
+    # mechanics (locking, migration, release) live on _FleetService.
+    def add_replica(self) -> int:
+        """Scale-out: spawn + warm a fresh replica (or revive a
+        retired slot through the rebuild lifecycle, replaying any
+        stragglers a preemption parked there) and place it into
+        service.  Blocking — run it from a reconcile thread."""
+        return _FLEET_SERVICE.scale_out(self)
+
+    def retire_replica(self, index: Optional[int] = None,
+                       deadline_s: Optional[float] = None):
+        """Scale-in: drain the least-loaded replica (or ``index``),
+        migrate its in-flight requests to peers (PR-8 path, greedy
+        streams bit-identical), release its engine.  Returns the
+        retired index, or None when nothing is retirable (floor of
+        one serving replica)."""
+        return _FLEET_SERVICE.scale_in(self, index,
+                                       deadline_s=deadline_s)
 
 
 def _make_fleet(builder, n: int, key=None, stamp=None) -> _Fleet:
@@ -1282,6 +1376,17 @@ class _FleetService:
         try:
             last_stall = eng.counters["stall_ticks"]
             while True:
+                if _faults.ACTIVE:
+                    # spot-preemption drill (round 17): a "preempt"
+                    # rule on this site is the cloud's preemption
+                    # NOTICE — ``arg`` milliseconds to drain.  Handled
+                    # outside the condition (the drain takes it), and
+                    # the stepper exits: the replica is being released.
+                    rule = _faults.fire("replica.preempt", replica.scope)
+                    if rule is not None and rule.kind == "preempt":
+                        self._preempt_replica(
+                            replica, rule.arg or 2000.0)
+                        return
                 published = []
                 with replica.cond:
                     if _faults.ACTIVE:
@@ -1570,6 +1675,200 @@ class _FleetService:
               f"{replica.generation}, {len(parked)} parked request(s) "
               f"replayed)", flush=True)
 
+    # ------------------------------------------------------- elastic fleet
+    def scale_out(self, fleet: _Fleet) -> Optional[int]:
+        """Add serving capacity: revive a retired slot through the
+        rebuild lifecycle (replaying any stragglers a preemption
+        parked there) when one exists, else spawn + append a fresh
+        replica.  Blocking (a cold build); the autoscale loop runs it
+        from a reconcile thread, never the sampler tick itself."""
+        slot = None
+        with fleet.cv:
+            for r in fleet.replicas:
+                if r.retired:
+                    slot = r
+                    r.retired = False
+                    r.draining = False
+                    r.drain_pending = False
+                    r.health.note_rebuild_start()
+                    break
+        if slot is not None:
+            self._rebuild(slot)  # build outside locks, swap, replay
+        else:
+            if fleet.builder is None:
+                return None
+            eng, tok = fleet.builder()
+            with fleet.cv:
+                slot = fleet.add(eng, tok)
+                fleet.cv.notify_all()
+        _C_SCALE_OUTS.inc()
+        _obs.event("daemon.scale_out", slot.index)
+        print(f"[serve] scale-out: replica{slot.index} in service",
+              flush=True)
+        return slot.index
+
+    def scale_in(self, fleet: _Fleet, index: Optional[int] = None, *,
+                 deadline_s: Optional[float] = None) -> Optional[int]:
+        """Retire one replica: ``index`` when given, else the least-
+        loaded placeable one (ties to the HIGHEST index — replica 0
+        stays the fleet's stable anchor).  Refuses to drop below one
+        serving replica.  Returns the retired index, or None when
+        nothing is retirable."""
+        with fleet.cv:
+            serving = [r for r in fleet.replicas if not r.retired]
+            if len(serving) <= 1:
+                return None
+            if index is not None:
+                cand = [r for r in serving if r.index == index]
+            else:
+                cand = [r for r in serving
+                        if r.health.placeable and not r.draining]
+        if index is None:
+            # loads read under each replica's own condition AFTER the
+            # fleet snapshot (the fleet.cv -> replica.cond order is
+            # forbidden), exactly like placement's _views
+            scored = []
+            for r in cand:
+                with r.cond:
+                    if r.dead:
+                        continue
+                    eng = r.engine
+                    load = len(eng.pending) + sum(
+                        1 for a in eng.active if a is not None)
+                scored.append((load, -r.index, r))
+            if not scored:
+                return None
+            scored.sort(key=lambda t: (t[0], t[1]))
+            victim = scored[0][2]
+        elif cand:
+            victim = cand[0]
+        else:
+            return None
+        self._retire(fleet, victim, deadline_s=deadline_s)
+        _C_SCALE_INS.inc()
+        _obs.event("daemon.scale_in", victim.index)
+        return victim.index
+
+    def _preempt_replica(self, replica: _Replica,
+                         deadline_ms: float) -> None:
+        """A spot-preemption NOTICE landed on this replica (the
+        ``replica.preempt`` fault site, fired from its own stepper
+        thread): migrate what the drain deadline allows, park the
+        stragglers, release the engine.  Unlike scale-in there is no
+        serving floor — the cloud does not ask; with the autoscaler
+        armed the next reconcile revives the slot (replaying the
+        parked set), and with the journal armed the client resume
+        path covers a straggler either way."""
+        _C_SPOT_PREEMPTIONS.inc()
+        _obs.event("daemon.preempt", replica.index)
+        print(f"[serve] replica{replica.index} spot-preemption "
+              f"notice: {deadline_ms:g}ms to drain", flush=True)
+        self._retire(replica.fleet, replica,
+                     deadline_s=deadline_ms / 1e3, from_stepper=True)
+
+    def _retire(self, fleet: _Fleet, replica: _Replica,
+                deadline_s: Optional[float] = None,
+                from_stepper: bool = False) -> dict:
+        """Drain-migrate-release one replica — the GRACEFUL sibling of
+        ``_fail_replica``: the same harvest and the same migration
+        path (greedy streams stay bit-identical on the peer), but no
+        quarantine, no post-mortem, and no replay-budget charge — a
+        retirement is not a failure.  ``deadline_s`` bounds the
+        migration loop (a preemption notice's drain budget); requests
+        still unmigrated at the deadline PARK on the slot, where a
+        scale-out revival replays them.  ``from_stepper`` marks the
+        call as coming from the replica's OWN stepper thread (the
+        preempt drill), which exits right after; otherwise the
+        harvest leaves a live stepper to observe the emptied engine
+        and exit on its own before the engine is released."""
+        import numpy as np
+
+        deadline = (None if deadline_s is None
+                    else time.monotonic() + deadline_s)
+        with fleet.cv:
+            replica.draining = True   # placement stops immediately
+            replica.drain_pending = False
+        with replica.cond:
+            eng = replica.engine
+            banked = list(eng._done.items())
+            eng._done.clear()
+            survivors = list(eng.pending) + [
+                r for r in eng.active if r is not None]
+            eng.pending.clear()
+            eng.active = [None] * eng.slots
+            eng._inflight.clear()  # in-flight device work: recomputed
+            # on the peer from the committed prefix (bit-identical)
+            tickets = dict(replica.tickets)
+            replica.tickets = {}
+            replica.dead = True
+            if from_stepper:
+                replica.stepper_alive = False
+        if not from_stepper:
+            # bounded wait for a live stepper to observe the emptied
+            # engine and exit — the engine must not be released under
+            # a mid-tick stepper
+            end = time.monotonic() + 30.0
+            while time.monotonic() < end:
+                with replica.cond:
+                    if not replica.stepper_alive:
+                        break
+                time.sleep(0.005)
+        migrate = []
+        with fleet.cv:
+            for rid_e, out in banked:
+                tkt = tickets.pop(rid_e, None)
+                if tkt is not None:
+                    self._finish_locked(tkt, out)
+            for req in survivors:
+                tkt = tickets.pop(req.req_id, None)
+                if tkt is None or tkt.cancelled:
+                    continue
+                if req.cancelled:
+                    # early-stopped: complete with the tokens it has
+                    self._finish_locked(
+                        tkt, np.asarray(req.out, np.int32))
+                    continue
+                migrate.append(tkt)
+            fleet.cv.notify_all()
+        n_migrated = 0
+        stragglers = []
+        for pos, tkt in enumerate(migrate):
+            if deadline is not None and time.monotonic() >= deadline:
+                # drain budget blown: everything left parks (the
+                # journal/recovery path's stragglers)
+                stragglers.extend(migrate[pos:])
+                break
+            try:
+                ok = self._migrate(fleet, tkt, {replica.index})
+            except Exception as mig_err:  # noqa: BLE001 — one bad
+                # ticket must not strand the rest of the drain
+                with fleet.cv:
+                    self._finish_error_locked(tkt, mig_err)
+                    fleet.cv.notify_all()
+                continue
+            if ok:
+                n_migrated += 1
+            else:
+                stragglers.append(tkt)  # no peer capacity: park
+        with fleet.cv:
+            for tkt in stragglers:
+                tkt.parked = True
+                tkt.replica = None
+                replica.parked.append(tkt)
+        # release: the engine reference drops here — block pools,
+        # prefix cache, and device buffers free with it
+        with replica.cond:
+            replica.engine = None
+        with fleet.cv:
+            replica.retired = True
+            replica.draining = False  # retired supersedes drain
+            replica.health.note_retired()
+            fleet.cv.notify_all()
+        print(f"[serve] replica{replica.index} retired: migrated "
+              f"{n_migrated}, parked {len(stragglers)} request(s)",
+              flush=True)
+        return {"migrated": n_migrated, "parked": len(stragglers)}
+
     # --------------------------------------------------------------- drain
     def drain(self, fleet: _Fleet, index: int) -> dict:
         """Stop placement on one replica; once it quiesces (pending,
@@ -1632,6 +1931,7 @@ class _FleetService:
                    "suspects": replica.health.suspects,
                    "crashes": replica.health.crashes,
                    "draining": replica.draining,
+                   "retired": replica.retired,
                    "generation": replica.generation,
                    "restarts": replica.restarts,
                    "parked": len(replica.parked)}
@@ -1647,9 +1947,20 @@ class _FleetService:
         return row
 
     def fleet_status(self, fleet: _Fleet) -> dict:
-        return {"replicas": len(fleet.replicas),
-                "replica": [self.replica_status(r)
-                            for r in fleet.replicas]}
+        with fleet.cv:
+            active = sum(1 for r in fleet.replicas if not r.retired)
+        out = {"replicas": len(fleet.replicas),
+               "active": active,
+               "replica": [self.replica_status(r)
+                           for r in fleet.replicas]}
+        # the elastic surface, when armed (snapshot() reads are
+        # sampler-thread-written ints/lists — same tolerance as the
+        # admission-path ladder reads)
+        if fleet.autoscaler is not None:
+            out["autoscale"] = fleet.autoscaler.snapshot()
+        if fleet.brownout is not None:
+            out["brownout"] = fleet.brownout.snapshot()
+        return out
 
     # -------------------------------------------------------------- hedging
     def _decide_winner_locked(self, tkt: _Ticket):
@@ -2198,11 +2509,33 @@ def _handle_generate(header: dict, payload: bytes,
             "beams/speculative/prompt_lookup or tp")
     fleet = _fleet_for(config.get("ckpt_dir"), attn, kv_dtype, tp,
                        prefill_chunk)
+    # brownout ladder (round 17): degrade NEW admissions by the
+    # currently-engaged rungs.  All four apply after parse/validation
+    # (a browned-out request still had to be well-formed) and before
+    # any engine work.  Reads are lock-free on purpose: the ladder's
+    # level is a single int mutated only by the sampler tick, and an
+    # admission racing a rung transition is equivalent to arriving one
+    # tick earlier/later.
+    ladder = fleet.brownout
+    if ladder is not None and ladder.level > 0:
+        if ladder.hedging_disabled:
+            hedge_ms = 0.0
+        if ladder.spec_disabled:
+            spec_mode = "off"
+            spec_k = 0
+            spec_ngram = 0
+        steps = ladder.cap_steps(steps)
+        deadline_ms = ladder.tighten_deadline_ms(deadline_ms)
     tok = fleet.tok
     # config-validation reads only (beam search additionally runs on
-    # these params): every replica shares the one build recipe, so
-    # replica 0's config speaks for the fleet
-    engine = fleet.replicas[0].engine
+    # these params): every replica shares the one build recipe, so any
+    # live replica's config speaks for the fleet (replica 0 can be a
+    # RETIRED slot once the fleet is elastic)
+    engine = next((r.engine for r in fleet.replicas
+                   if r.engine is not None), None)
+    if engine is None:  # every slot retired: a submit would park; the
+        # config reads below need SOME engine, so refuse loudly
+        raise RuntimeError("fleet has no live replica (all retired)")
     if tok is None:
         prompt = np.frombuffer(payload, np.uint8).astype(np.int32)
         eng_stop = stop_byte
@@ -2871,13 +3204,132 @@ def _apply_fleet_alerts() -> None:
                 r.health.note_alert(firing)
 
 
+#: the FIRING states the autoscaler counts as pressure evidence — the
+#: burn-rate rules install_default_rules() always installs (PR 10)
+_PRESSURE_ALERTS = ("queue_wait_burn_fast", "ttft_burn_fast",
+                    "itl_burn_fast", "e2e_burn_fast",
+                    "goodput_shed_burn")
+
+
+def _fleet_signals(fleet: _Fleet) -> "object":
+    """Snapshot one :class:`tpulab.autoscale.Signals` for a fleet:
+    serving-replica count + summed load under the proper lock order
+    (fleet snapshot under fleet.cv, THEN loads under each replica's own
+    condition), plus the history-window pressure evidence shared by
+    every fleet (the ring is process-global)."""
+    from tpulab import autoscale as _autoscale
+    from tpulab.obs import alerts as _alerts
+
+    with fleet.cv:
+        live = [r for r in fleet.replicas if not r.retired]
+        n = len(live)
+    load = 0
+    for r in live:
+        with r.cond:
+            if r.dead:
+                continue
+            eng = r.engine
+            load += len(eng.pending) + sum(
+                1 for a in eng.active if a is not None)
+    qp99 = None
+    shed_rate = 0.0
+    if _sampler_active():
+        w = _obs.HISTORY.window(AUTOSCALE_WINDOW_S)
+        if w is not None:
+            if w.count("queue_wait_seconds") > 0:
+                qp99 = w.percentile("queue_wait_seconds", 0.99)
+            shed_rate = w.rate("daemon_shed_requests")
+    firing = 0
+    for name in _PRESSURE_ALERTS:
+        st = _alerts.ALERTS.get_state(name)
+        if st is not None and st.state == _alerts.FIRING:
+            firing += 1
+    return _autoscale.Signals(
+        active_replicas=max(1, n),
+        load_per_replica=load / max(1, n),
+        queue_wait_p99_s=qp99,
+        shed_rate=shed_rate,
+        alerts_firing=firing)
+
+
+def _reconcile_fleet(fleet: _Fleet, target: int) -> None:
+    """One reconcile step toward ``target`` (a daemon thread, one op
+    in flight per fleet): scale OUT when provisioned < target — a
+    preempted slot revives this way too, since preemption drops the
+    provisioned count below target with no cooldown in the way — and
+    scale IN when above."""
+    try:
+        with fleet.cv:
+            provisioned = sum(
+                1 for r in fleet.replicas if not r.retired)
+        if provisioned < target:
+            fleet.add_replica()
+        elif provisioned > target:
+            fleet.retire_replica()
+    except Exception:
+        traceback.print_exc()
+    finally:
+        with fleet.cv:
+            fleet.scaling = False
+            fleet.cv.notify_all()
+
+
+def _autoscale_tick() -> None:
+    """The round-17 control loop, riding the sampler tick: per warm
+    fleet, fold one Signals snapshot into the fleet's AutoscalePolicy
+    and BrownoutLadder, then kick ONE reconcile op (a background
+    thread — the cold build must never run on the sampler thread)
+    whenever provisioned != target and no op is already in flight."""
+    with _FLEET_SERVICE.lock:
+        fleets = [v[1] for v in _FLEETS.values()]
+    now = time.monotonic()
+    total_target = 0
+    max_level = 0
+    armed = False
+    for fleet in fleets:
+        pol = fleet.autoscaler
+        if pol is None:
+            continue
+        armed = True
+        sig = _fleet_signals(fleet)
+        target = pol.observe(now, sig)
+        total_target += target
+        ladder = fleet.brownout
+        if ladder is not None:
+            transition = ladder.observe(now, pol.overloaded(sig))
+            if transition is not None:
+                direction, rung = transition.split(":", 1)
+                if direction == "engage":
+                    _C_BROWNOUT_STEPS.inc()
+                else:
+                    _C_BROWNOUT_REVERSALS.inc()
+                _obs.event(f"daemon.brownout.{direction}", rung)
+                print(f"[serve] brownout {direction}: {rung} "
+                      f"(level {ladder.level})", flush=True)
+            max_level = max(max_level, ladder.level)
+        with fleet.cv:
+            provisioned = sum(
+                1 for r in fleet.replicas if not r.retired)
+            busy = fleet.scaling
+            if not busy and provisioned != target:
+                fleet.scaling = True
+                threading.Thread(
+                    target=_reconcile_fleet, args=(fleet, target),
+                    daemon=True).start()
+    if armed:
+        _G_TARGET_REPLICAS.set(float(total_target))
+        _G_BROWNOUT_LEVEL.set(float(max_level))
+
+
 def _sampler_tick() -> None:
     """One sampler iteration's POST-sample hook (the gauge refresh runs
     as the before-hook so the sample itself is fresh): evaluate alerts
-    over the ring, then wire the verdicts into fleet health."""
+    over the ring, wire the verdicts into fleet health, then run the
+    elastic-fleet control loop off the same verdicts."""
     _ensure_replica_rules()
     _obs.ALERTS.evaluate(_obs.HISTORY)
     _apply_fleet_alerts()
+    _autoscale_tick()
 
 
 def start_sampler(interval_s: Optional[float] = None,
@@ -3285,7 +3737,8 @@ def serve(socket_path: str, *, max_requests: Optional[int] = None) -> None:
 
 
 def main(argv=None) -> int:
-    global PREFILL_CHUNK, REPLICAS, HEDGE_MS, METRICS_INTERVAL_S, _JOURNAL
+    global PREFILL_CHUNK, REPLICAS, HEDGE_MS, METRICS_INTERVAL_S, \
+        _JOURNAL, AUTOSCALE_MIN, AUTOSCALE_MAX
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--socket", default=os.environ.get("TPULAB_DAEMON_SOCKET", "/tmp/tpulab.sock"))
     ap.add_argument("--max-requests", type=int, default=None, help="exit after N requests (tests)")
@@ -3328,6 +3781,19 @@ def main(argv=None) -> int:
                          "client streams resumable by rid (default "
                          "TPULAB_DAEMON_JOURNAL env; unset = off, "
                          "streams bit-identical either way)")
+    ap.add_argument("--autoscale-min", type=int, default=AUTOSCALE_MIN,
+                    metavar="N",
+                    help="elastic-fleet floor: the autoscaler never "
+                         "retires below N serving replicas (default "
+                         "TPULAB_DAEMON_AUTOSCALE_MIN or 1; only "
+                         "meaningful with --autoscale-max >= 1)")
+    ap.add_argument("--autoscale-max", type=int, default=AUTOSCALE_MAX,
+                    metavar="N",
+                    help="elastic-fleet ceiling: arm the telemetry-"
+                         "driven autoscaler + brownout ladder, scaling "
+                         "each warm fleet between --autoscale-min and N "
+                         "replicas (default TPULAB_DAEMON_AUTOSCALE_MAX "
+                         "or 0 = disarmed, fixed --replicas fleet)")
     ap.add_argument("--slowlog", type=int, default=None, metavar="N",
                     help="per-request slow-log window: keep the worst N "
                          "requests by e2e latency (default 64; 0 "
@@ -3347,10 +3813,33 @@ def main(argv=None) -> int:
         ap.error("--slowlog must be >= 0")
     if args.metrics_interval < 0:
         ap.error("--metrics-interval must be >= 0 (0 disables)")
+    # elastic-fleet bounds: reject misconfiguration HERE with a
+    # parseable argparse error (exit 2, message on stderr) instead of
+    # a late crash inside the first fleet build
+    if args.autoscale_max < 0:
+        ap.error("--autoscale-max must be >= 0 (0 disarms)")
+    if args.autoscale_max >= 1:
+        if args.autoscale_min < 1:
+            ap.error("--autoscale-min must be >= 1")
+        if args.autoscale_min > args.autoscale_max:
+            ap.error(
+                f"--autoscale-min ({args.autoscale_min}) must be <= "
+                f"--autoscale-max ({args.autoscale_max})")
+        if not (args.autoscale_min <= args.replicas
+                <= args.autoscale_max):
+            ap.error(
+                f"--replicas ({args.replicas}) must start inside "
+                f"[--autoscale-min, --autoscale-max] = "
+                f"[{args.autoscale_min}, {args.autoscale_max}]")
+        if args.metrics_interval == 0:
+            ap.error("--autoscale-max requires the sampler: "
+                     "--metrics-interval must be > 0")
     PREFILL_CHUNK = args.prefill_chunk
     REPLICAS = args.replicas
     HEDGE_MS = args.hedge_ms
     METRICS_INTERVAL_S = args.metrics_interval
+    AUTOSCALE_MIN = args.autoscale_min
+    AUTOSCALE_MAX = args.autoscale_max
     if args.trace_buffer is not None:
         from tpulab import obs
 

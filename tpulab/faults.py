@@ -43,6 +43,9 @@ Sites wired in this round (grep for ``_FAULTS``/``faults.fire``):
 ``daemon.kill``        after the journal accept record is durable, before
                        admission (``kill`` — deterministic process death;
                        subprocess-based tests only)
+``replica.preempt``    the daemon stepper loop, alongside ``daemon.step``
+                       (``preempt`` — a spot-preemption notice for that
+                       replica; ``arg`` is the drain deadline in ms)
 =====================  =====================================================
 
 Fault kinds:
@@ -60,6 +63,12 @@ Fault kinds:
   process death with no cleanup (the SIGKILL/OOM/preemption stand-in
   the write-ahead journal recovers from).  Fire it only in a daemon
   SUBPROCESS — in-process it kills the test runner.
+* ``preempt``        — a SPOT-PREEMPTION NOTICE, returned for the site
+  to apply (only the daemon's fleet layer knows how to drain a
+  replica): the replica gets ``arg`` milliseconds (default 2000) to
+  migrate what it can to peers before it is released; stragglers park
+  for the journal/recovery path.  Unlike ``kill``, the notice-then-
+  deadline shape is the cloud spot contract, and it is safe in-process.
 
 Schedules are lists of rule dicts::
 
@@ -105,7 +114,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
-KINDS = ("raise", "nan_tokens", "corrupt_table", "slow_ms", "kill")
+KINDS = ("raise", "nan_tokens", "corrupt_table", "slow_ms", "kill",
+         "preempt")
 
 
 class InjectedFault(RuntimeError):

@@ -8,8 +8,10 @@ completed within their SLO under realistic bursty, heavy-tailed
 traffic.  This module builds that traffic:
 
 * **arrival processes** — ``poisson`` (memoryless, the steady-state
-  story) and ``onoff`` (bursty: exponential ON periods at a multiplied
-  rate separated by exponential silences — the queue-building story);
+  story), ``onoff`` (bursty: exponential ON periods at a multiplied
+  rate separated by exponential silences — the queue-building story),
+  and ``ramp`` (a piecewise-constant rate schedule that steps ~10x
+  mid-trace and back — the autoscaling story);
 * **heavy-tailed sizes** — lognormal prompt lengths and output budgets
   (clamped to the daemon's serving window);
 * **multi-turn sessions** — a follow-up turn extends its parent's
@@ -108,7 +110,7 @@ class TraceSpec:
     name: str = "trace"
     seed: int = 0
     n_requests: int = 64
-    #: "poisson" | "onoff"
+    #: "poisson" | "onoff" | "ramp"
     arrival: str = "poisson"
     rate_rps: float = 8.0
     #: onoff burst shape: exponential ON/OFF period means, and the rate
@@ -116,6 +118,12 @@ class TraceSpec:
     on_ms: float = 800.0
     off_ms: float = 600.0
     burst_factor: float = 2.5
+    #: ramp arrival: a piecewise-constant rate schedule of
+    #: ``(start_ms, rate_rps)`` segments — the autoscale story (the
+    #: arrival rate steps ~10x mid-trace and back down).  Empty for
+    #: the other arrival kinds (defaulted so their committed trace
+    #: JSON stays byte-stable).
+    ramp_schedule: Tuple[Tuple[float, float], ...] = ()
     #: heavy-tail prompt bytes (lognormal around the median), clamped
     prompt_median: int = 48
     prompt_sigma: float = 0.6
@@ -170,6 +178,27 @@ SPECS: Dict[str, TraceSpec] = {
             SLOClass("bulk", weight=0.4, priority=0, deadline_ms=None,
                      ttft_ms=40000.0, itl_ms=10000.0, e2e_ms=90000.0),
         )),
+    # the elastic-fleet tier (tools/goodput_gate.py --spec ramp
+    # --autoscale): a quiet floor phase, a ~10x arrival-rate step that
+    # the autoscaler + brownout ladder must absorb, and a short tail
+    # for the decay story.  Classes carry no deadline (the acceptance
+    # gate requires every non-cancelled request to COMPLETE — scaling
+    # and brownout, not shedding, absorb the burst) and ``steps_max``
+    # stays at/below the default brownout token cap so an engaged
+    # cap rung cannot change any stream's bytes mid-gate.
+    "ramp": TraceSpec(
+        name="ramp", seed=33, n_requests=56, arrival="ramp",
+        rate_rps=2.0,
+        ramp_schedule=((0.0, 2.0), (6000.0, 20.0), (8000.0, 2.0)),
+        steps_median=24, steps_sigma=0.5, steps_min=8, steps_max=48,
+        p_cancel=0.05, cancel_ms=(30.0, 200.0),
+        classes=(
+            SLOClass("interactive", weight=0.6, priority=2,
+                     deadline_ms=None, ttft_ms=30000.0, itl_ms=10000.0,
+                     e2e_ms=60000.0),
+            SLOClass("bulk", weight=0.4, priority=0, deadline_ms=None,
+                     ttft_ms=60000.0, itl_ms=15000.0, e2e_ms=120000.0),
+        )),
 }
 
 
@@ -199,9 +228,30 @@ def _arrivals(spec: TraceSpec, rng: random.Random):
                     break
                 yield t
             t = on_end + rng.expovariate(1.0) * spec.off_ms
+    elif spec.arrival == "ramp":
+        # piecewise-constant rate: each inter-arrival gap is drawn at
+        # the rate of the segment the CURRENT time falls in (a gap may
+        # overshoot a boundary — the standard piecewise approximation,
+        # still fully determined by the seed).  Before the first
+        # segment's start the first segment's rate applies.
+        sched = sorted((float(at), float(r))
+                       for at, r in spec.ramp_schedule)
+        if not sched:
+            raise ValueError(
+                "arrival='ramp' needs a non-empty ramp_schedule")
+        if any(r <= 0 for _, r in sched):
+            raise ValueError("ramp_schedule rates must be > 0")
+        while True:
+            rate = sched[0][1]
+            for at, r in sched:
+                if at <= t:
+                    rate = r
+            t += rng.expovariate(1.0) * (1e3 / rate)
+            yield t
     else:
         raise ValueError(
-            f"arrival={spec.arrival!r}; expected 'poisson' or 'onoff'")
+            f"arrival={spec.arrival!r}; expected 'poisson', 'onoff', "
+            f"or 'ramp'")
 
 
 def _lognormal_int(rng: random.Random, median: int, sigma: float,

@@ -145,7 +145,26 @@ def format_fleet(fleet: Optional[dict],
         return ("engine (no fleet): "
                 + " ".join(f"{k}={'-' if v is None else v}"
                            for k, v in row.items()))
-    lines = [f"fleet: {fleet['replicas']} replica(s)"]
+    head = f"fleet: {fleet['replicas']} replica(s)"
+    # the elastic surface (round 17): target vs actual + the brownout
+    # ladder, present only when the daemon runs with --autoscale-max
+    auto = fleet.get("autoscale")
+    if auto:
+        head = (f"fleet: {fleet.get('active', fleet['replicas'])}"
+                f"/{fleet['replicas']} serving, target "
+                f"{auto.get('target')} "
+                f"[{auto.get('min')}..{auto.get('max')}] "
+                f"(scale-outs={auto.get('raises', 0)} "
+                f"scale-ins={auto.get('lowers', 0)})")
+    lines = [head]
+    brown = fleet.get("brownout")
+    if brown:
+        rungs = brown.get("rungs") or []
+        lines.append(
+            f"  brownout: level {brown.get('level', 0)}"
+            f"{' [' + ' > '.join(rungs) + ']' if rungs else ''} "
+            f"(engages={brown.get('engages', 0)} "
+            f"releases={brown.get('releases', 0)})")
     for r in fleet.get("replica", []):
         def v(key, default="-"):
             x = r.get(key)
@@ -154,7 +173,9 @@ def format_fleet(fleet: Optional[dict],
         flags = []
         if r.get("draining"):
             flags.append("draining")
-        if r.get("dead"):
+        if r.get("retired"):
+            flags.append("retired")
+        elif r.get("dead"):
             flags.append("dead")
         lines.append(
             f"  replica{v('replica')} {str(v('health', '?')):<11} "

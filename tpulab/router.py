@@ -42,6 +42,10 @@ HEALTHY = "healthy"
 SUSPECT = "suspect"
 QUARANTINED = "quarantined"
 REBUILDING = "rebuilding"
+#: round 17 (elastic fleet): the replica's engine was released — by a
+#: scale-in or a spot preemption — and its slot idles empty until a
+#: scale-out revives it through the rebuild lifecycle
+RETIRED = "retired"
 
 #: a stepper tick at or above this duration counts as SLOW — sized for
 #: the chaos tier's wedge signature (``slow_ms`` >= 100ms on a
@@ -156,6 +160,17 @@ class ReplicaHealth:
         """The rebuild itself raised: back to QUARANTINED (the daemon
         may retry on the next failure-driven rebuild request)."""
         self.state = QUARANTINED
+
+    def note_retired(self) -> None:
+        """The replica's engine was RELEASED (autoscale scale-in, or a
+        spot-preemption notice whose drain deadline expired): not a
+        health observation — the slot simply holds no engine.  Tick
+        and alert evidence are ignored while retired (both guard on
+        HEALTHY/SUSPECT); only a scale-out revival
+        (:meth:`note_rebuild_start` -> :meth:`note_rebuilt`) leaves."""
+        self.state = RETIRED
+        self._slow = self._fast = 0
+        self.alert_firing = False
 
     def note_rebuilt(self) -> None:
         """A fresh engine was swapped in: fully healthy, counters

@@ -1,0 +1,454 @@
+"""Hierarchical prefix/KV cache (round 18): radix-tree partial hits +
+host-RAM spill tier (tpulab.kvcache, tpulab/models/paged.py wiring).
+
+Covers the round-18 ISSUE checklist:
+
+  * the radix prefix index property-tested against a brute-force
+    oracle that mirrors its touch clock exactly — lookup results,
+    adopted-block lists, LRU leaf-eviction victims, and node/entry
+    counts all match over thousands of random operations;
+  * dict-vs-radix engine bit-equality BOTH WAYS on exact-hit traces
+    (identical repeated prompts): same tokens out, and both engines
+    record the exact hits — the radix rewire changes WHAT can hit
+    (partial prefixes), never what a hit returns;
+  * the host spill tier: lossless ``native`` round-trips for dense
+    AND (q, s) int8-pool payloads, LRU capacity drops, the lossy
+    int8/int4 host formats' error bounds, and the int4 nibble
+    pack/unpack round-trip (tpulab.models.quant);
+  * the full spill cycle on a live engine: evict under pressure ->
+    host tier -> prefetch back at admission -> outputs bit-identical
+    to a spill-disabled engine and to plain ``generate``;
+  * SATELLITE: ``_evict_prefixes`` can never free a block a live slot
+    still references — asserted directly against the slot tables in
+    dict, radix, and radix+spill modes;
+  * standing contracts RE-CERTIFIED with the tier armed: the steady
+    decode window stays flat-h2d under ``jax.transfer_guard`` + the
+    ``jnp.asarray`` tripwire, and records ZERO recompiles under
+    ``strict()`` even after real spill/prefetch traffic warmed the
+    transfer programs;
+  * constructor validation: spill requires the radix index, rejects
+    meshes, bounds, and dtype names.
+"""
+
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import tpulab.models.paged as paged_mod
+from tpulab.kvcache import (DEFAULT_WATERMARK, SPILL_DTYPES,
+                            HostSpillTier, RadixPrefixIndex, SpillPolicy)
+from tpulab.kvcache.spill import _decode, _encode
+from tpulab.models.generate import generate
+from tpulab.models.labformer import LabformerConfig
+from tpulab.models.paged import TRASH, PagedEngine
+from tpulab.models.quant import pack_int4, unpack_int4
+from tpulab.obs import compilestats as cstats
+
+CFG = LabformerConfig(d_model=32, n_heads=4, n_layers=2, d_ff=64, max_seq=128)
+
+
+@pytest.fixture(scope="module")
+def trained(trained_small, trained_small_cfg):
+    assert CFG == trained_small_cfg  # shared-model drift fails loudly
+    return trained_small
+
+
+def _cycle_prompt(p):
+    return (np.arange(p) % 7).astype(np.int32)
+
+
+# ---------------------------------------------- radix vs brute force
+class _Oracle:
+    """Transparent O(n^2) model of RadixPrefixIndex: a flat dict of
+    chunk-path -> (block, last_use) plus the same strictly-increasing
+    touch clock (lookup and insert both freshen every node on the
+    walked path, shallowest first), so even LRU ties are impossible
+    and eviction victims must match exactly."""
+
+    def __init__(self, bs):
+        self.bs = bs
+        self.nodes = {}      # path tuple-of-chunks -> [block, last_use]
+        self.entries = set()
+        self.clock = 0
+
+    def _chunks(self, tokens):
+        n = len(tokens) // self.bs
+        return tuple(tuple(int(t) for t in tokens[i * self.bs:(i + 1) * self.bs])
+                     for i in range(n))
+
+    def _touch(self, path):
+        self.clock += 1
+        self.nodes[path][1] = self.clock
+
+    def lookup(self, tokens):
+        blocks = []
+        chunks = self._chunks(tokens)
+        for j in range(1, len(chunks) + 1):
+            path = chunks[:j]
+            if path not in self.nodes:
+                break
+            blocks.append(self.nodes[path][0])
+            self._touch(path)
+        return blocks, len(blocks)
+
+    def insert(self, tokens, blocks):
+        chunks = self._chunks(tokens)
+        adopted = []
+        for j in range(1, len(chunks) + 1):
+            path = chunks[:j]
+            if path not in self.nodes:
+                self.nodes[path] = [int(blocks[j - 1]), 0]
+                adopted.append(int(blocks[j - 1]))
+            self._touch(path)
+        if chunks:
+            self.entries.add(chunks)
+        return adopted
+
+    def evict_leaf(self):
+        leaves = [p for p in self.nodes
+                  if not any(q[:len(p)] == p and len(q) > len(p)
+                             for q in self.nodes)]
+        if not leaves:
+            return None
+        victim = min(leaves, key=lambda p: self.nodes[p][1])
+        block = self.nodes.pop(victim)[0]
+        self.entries.discard(victim)
+        return block, tuple(t for chunk in victim for t in chunk)
+
+
+def test_radix_matches_oracle_over_random_ops():
+    """Thousands of mixed insert/lookup/evict ops from a seeded stream:
+    every return value and both counters match the brute-force model."""
+    bs = 4
+    rng = random.Random(1234)
+    tree, oracle = RadixPrefixIndex(bs), _Oracle(bs)
+    next_block = 1
+    for step in range(3000):
+        op = rng.random()
+        # small alphabet + short paths force dense prefix sharing
+        tokens = [rng.randrange(3) for _ in range(bs * rng.randrange(1, 5))]
+        if op < 0.45:
+            need = len(tokens) // bs
+            blocks = list(range(next_block, next_block + need))
+            next_block += need
+            a = tree.insert(tokens, blocks)
+            b = oracle.insert(tokens, blocks)
+            assert a == b, step
+        elif op < 0.8:
+            assert tree.lookup(tokens) == oracle.lookup(tokens), step
+        else:
+            assert tree.evict_leaf() == oracle.evict_leaf(), step
+        assert tree.n_blocks == len(oracle.nodes), step
+        assert tree.n_entries == len(oracle.entries) == len(tree), step
+    assert sorted(tree.blocks()) == sorted(b for b, _ in oracle.nodes.values())
+    # drain: eviction order over the whole surviving tree still agrees
+    while True:
+        a, b = tree.evict_leaf(), oracle.evict_leaf()
+        assert a == b
+        if a is None:
+            break
+    assert tree.n_blocks == 0 and tree.n_entries == 0
+
+
+def test_radix_first_writer_wins_and_partial_hits():
+    t = RadixPrefixIndex(2)
+    assert t.insert([1, 2, 3, 4], [10, 11]) == [10, 11]
+    # shared first chunk: only the divergent tail is adopted
+    assert t.insert([1, 2, 9, 9], [77, 12]) == [12]
+    assert t.n_blocks == 3 and t.n_entries == 2
+    # longest PARTIAL hit: unseen suffix still reuses the shared chunk
+    assert t.lookup([1, 2, 8, 8, 5, 5]) == ([10], 1)
+    assert t.lookup([1, 2, 3, 4, 5, 5]) == ([10, 11], 2)
+    assert t.lookup([9, 9]) == ([], 0)
+    # sub-chunk tokens never match (block-aligned only)
+    assert t.lookup([1]) == ([], 0)
+
+
+def test_radix_leaf_only_lru_eviction():
+    t = RadixPrefixIndex(1)
+    t.insert([1, 2, 3], [10, 11, 12])     # chain: 1 -> 2 -> 3
+    t.insert([1, 9], [0, 13])             # sibling leaf under 1
+    t.lookup([1, 9])                       # freshen the sibling branch
+    # LRU leaf is the chain tip (12): interior 10/11 are untouchable
+    assert t.evict_leaf() == (12, (1, 2, 3))
+    assert t.evict_leaf() == (11, (1, 2))  # becomes a leaf only now
+    assert t.evict_leaf() == (13, (1, 9))
+    assert t.evict_leaf() == (10, (1,))
+    assert t.evict_leaf() is None
+
+
+def test_radix_validation():
+    with pytest.raises(ValueError, match="block_size"):
+        RadixPrefixIndex(0)
+    t = RadixPrefixIndex(2)
+    with pytest.raises(ValueError, match="one block per chunk"):
+        t.insert([1, 2, 3, 4], [10])
+    t.insert([1, 2], [10])
+    t.clear()
+    assert t.n_blocks == 0 and t.lookup([1, 2]) == ([], 0)
+
+
+# ------------------------------------------------- int4 pack/unpack
+def test_int4_roundtrip_property():
+    rng = np.random.default_rng(7)
+    for n in (0, 1, 2, 7, 8, 33, 256, 1001):
+        q = rng.integers(-8, 8, size=(n,)).astype(np.int8)
+        packed, odd = pack_int4(q)
+        assert packed.dtype == np.uint8
+        assert packed.size == (n + 1) // 2 and odd == bool(n % 2)
+        out = unpack_int4(packed, odd)
+        assert out.dtype == np.int8
+        assert np.array_equal(out, q), n
+    with pytest.raises(ValueError, match="int4"):
+        pack_int4(np.array([8], dtype=np.int8))
+
+
+# ------------------------------------------------------ spill tier
+def test_spill_tier_native_roundtrip_dense_and_quantized():
+    rng = np.random.default_rng(0)
+    k = rng.standard_normal((2, 1, 4, 8)).astype(np.float32)
+    v = rng.standard_normal((2, 1, 4, 8)).astype(np.float32)
+    tier = HostSpillTier(4, "native")
+    tier.put(b"a", k, v)
+    kk, vv = tier.get(b"a", pool_is_quantized=False, pool_dtype=np.float32)
+    assert np.array_equal(kk, k) and np.array_equal(vv, v)
+    # int8 pools spill their (q, s) representation verbatim — lossless
+    q = rng.integers(-127, 128, size=k.shape).astype(np.int8)
+    s = rng.random((2, 1, 4), dtype=np.float32) + 0.1
+    tier.put(b"b", (q, s), (q, s))
+    (q2, s2), _ = tier.get(b"b", pool_is_quantized=True, pool_dtype=np.int8)
+    assert np.array_equal(q2, q) and np.array_equal(s2, s)
+    assert b"a" in tier and len(tier) == 2 and tier.nbytes > 0
+
+
+def test_spill_tier_lru_capacity_and_lossy_dtypes():
+    rng = np.random.default_rng(1)
+    mk = lambda: rng.standard_normal((2, 1, 2, 4)).astype(np.float32)
+    tier = HostSpillTier(2, "native")
+    tier.put(b"a", mk(), mk())
+    tier.put(b"b", mk(), mk())
+    tier.get(b"a", pool_is_quantized=False, pool_dtype=np.float32)  # freshen
+    tier.put(b"c", mk(), mk())          # capacity 2: LRU b drops
+    assert b"b" not in tier and b"a" in tier and b"c" in tier
+    assert tier.dropped == 1
+    for dtype, tol in (("int8", 0.02), ("int4", 0.15)):
+        k = mk()
+        entry = _encode(k, dtype)
+        out = _decode(entry, False, np.float32)
+        rel = np.abs(out - k).max() / np.abs(k).max()
+        assert rel < tol, (dtype, rel)
+    with pytest.raises(ValueError, match="spill dtype"):
+        HostSpillTier(2, "fp7")
+
+
+def test_spill_policy_overage():
+    pol = SpillPolicy(watermark=0.90, batch=8)
+    assert pol.overage(100, 128) == 0       # below the watermark
+    assert pol.overage(116, 128) == 1       # 1 over int(0.9 * 128)
+    assert pol.overage(128, 128) == 8       # 13 over, batch-bounded
+    assert SpillPolicy(watermark=0.5, batch=2).overage(10, 10) == 2
+    assert DEFAULT_WATERMARK == 0.90 and "native" in SPILL_DTYPES
+
+
+# ------------------------------------- engine wiring: dict vs radix
+def test_dict_radix_bit_equality_exact_hit_traces(trained):
+    """Acceptance: the SAME exact-hit workload (repeated prompts across
+    waves) through a dict engine and a radix engine yields bit-equal
+    tokens per request — and matches plain generate — while both
+    engines record the exact hits."""
+    outs, engines = {}, {}
+    for mode in ("dict", "radix"):
+        eng = PagedEngine(trained, CFG, slots=2, n_blocks=24,
+                          block_size=8, max_seq=64, prefix_index=mode)
+        got = {}
+        for wave in range(3):                 # waves 2/3 hit exactly
+            rids = {eng.submit(_cycle_prompt(p), max_new=5): p
+                    for p in (9, 17)}
+            res = eng.run()
+            for rid, p in rids.items():
+                got[(wave, p)] = res[rid]
+        outs[mode], engines[mode] = got, eng
+        assert eng.counters["prefix_hits"] >= 4, mode  # 2 waves x 2
+    for key, toks in outs["dict"].items():
+        assert np.array_equal(toks, outs["radix"][key]), key
+        p = key[1]
+        want = generate(trained, _cycle_prompt(p)[None, :], CFG, steps=5,
+                        temperature=0.0)[0]
+        assert np.array_equal(toks, want), key
+    # the radix engine additionally serves PARTIAL hits: with ONLY a
+    # 2-block prefix registered, a prompt diverging inside block 2
+    # still reuses block 1 — the dict index has no depth-1 entry to
+    # probe and must miss
+    div = np.concatenate([_cycle_prompt(8),
+                          np.full(9, 5, np.int32)]).astype(np.int32)
+    for mode in ("dict", "radix"):
+        eng = PagedEngine(trained, CFG, slots=1, n_blocks=24,
+                          block_size=8, max_seq=64, prefix_index=mode)
+        _spin_waves(eng, [_cycle_prompt(17)])
+        h0 = eng.counters["prefix_hits"]
+        _spin_waves(eng, [div])
+        hit = eng.counters["prefix_hits"] - h0
+        assert hit == (1 if mode == "radix" else 0), mode
+
+
+def _spin_waves(eng, prompts, max_new=5):
+    rids = {eng.submit(p, max_new=max_new): i
+            for i, p in enumerate(prompts)}
+    res = eng.run()
+    return {i: res[r] for r, i in rids.items()}
+
+
+def test_spill_roundtrip_bit_equality(trained):
+    """The full tier cycle: a tiny pool evicts A's prefix to host under
+    filler pressure, resubmitting A prefetches it back, and every token
+    stream is bit-identical to a spill-disabled engine's."""
+    def mk(spill):
+        kw = ({"prefix_index": "radix", "spill_blocks": 16}
+              if spill else {})
+        return PagedEngine(trained, CFG, slots=1, n_blocks=8,
+                           block_size=8, max_seq=64, **kw)
+
+    a = _cycle_prompt(17)                     # 2 full blocks of prefix
+    fillers = [(np.arange(i, i + 17) % 11).astype(np.int32)
+               for i in (1, 2, 3)]            # distinct working sets
+    outs = {}
+    for spill in (False, True):
+        eng = mk(spill)
+        outs[spill] = [_spin_waves(eng, [a])]
+        for f in fillers:                     # 7-usable-block pool churns
+            outs[spill].append(_spin_waves(eng, [f]))
+        outs[spill].append(_spin_waves(eng, [a]))   # back for A
+        if spill:
+            assert eng.counters["spill_spilled"] >= 1
+            assert eng.counters["spill_prefetched"] >= 1
+            assert eng.counters["spill_hits"] >= 1
+            assert eng.stats()["spill_capacity_blocks"] == 16
+    for w, (ref, run) in enumerate(zip(outs[False], outs[True])):
+        for i in ref:
+            assert np.array_equal(ref[i], run[i]), (w, i)
+    want = generate(trained, a[None, :], CFG, steps=5, temperature=0.0)[0]
+    assert np.array_equal(outs[True][-1][0], want)
+
+
+@pytest.mark.parametrize("mode", ["dict", "radix", "radix+spill"])
+def test_evict_prefixes_never_frees_live_slot_blocks(trained, mode):
+    """SATELLITE: prefix eviction under pressure must never free a
+    block a PREFILLING/DECODING slot still references.  A second wave
+    re-admits over the cached prefix (cache ref + slot ref on the same
+    blocks); a forced over-demand eviction then drains the whole index
+    — the shared blocks must survive in the slot tables, off the free
+    list, and the stream must stay bit-exact."""
+    kw = {"prefix_index": "radix"} if "radix" in mode else {}
+    if mode == "radix+spill":
+        kw["spill_blocks"] = 8
+    eng = PagedEngine(trained, CFG, slots=1, n_blocks=16, block_size=8,
+                      max_seq=64, **kw)
+    p = _cycle_prompt(17)
+    _spin_waves(eng, [p])                     # registers the prefix
+    eng.submit(p, max_new=8)
+    for _ in range(2):                        # admit + a tick or two
+        eng.step()
+    live = {int(b) for b in np.asarray(eng.tables).ravel() if b != TRASH}
+    assert live, "no live slot blocks — the scenario is vacuous"
+    eng._evict_prefixes(eng.n_usable_blocks + 1)   # over-demand: drain
+    if "radix" in mode:
+        assert eng._radix.n_blocks == 0
+    else:
+        assert not eng.prefix_cache
+    for b in live:
+        assert b not in eng.free, (mode, b)
+        assert eng.block_refs[b] >= 1, (mode, b)
+    out = eng.run()
+    want = generate(trained, p[None, :], CFG, steps=8, temperature=0.0)[0]
+    assert np.array_equal(out[max(out)], want)
+
+
+def test_engine_validation(trained):
+    with pytest.raises(ValueError, match="prefix_index"):
+        PagedEngine(trained, CFG, slots=1, n_blocks=8, block_size=8,
+                    max_seq=32, prefix_index="btree")
+    with pytest.raises(ValueError, match="spill_blocks"):
+        PagedEngine(trained, CFG, slots=1, n_blocks=8, block_size=8,
+                    max_seq=32, spill_blocks=-1)
+    with pytest.raises(ValueError, match="radix"):
+        PagedEngine(trained, CFG, slots=1, n_blocks=8, block_size=8,
+                    max_seq=32, spill_blocks=4)       # dict + spill
+    with pytest.raises(ValueError, match="spill_dtype"):
+        PagedEngine(trained, CFG, slots=1, n_blocks=8, block_size=8,
+                    max_seq=32, prefix_index="radix", spill_blocks=4,
+                    spill_dtype="fp8")
+    # disarmed engines still expose the spill stats surface (zeros)
+    eng = PagedEngine(trained, CFG, slots=1, n_blocks=8, block_size=8,
+                      max_seq=32)
+    st = eng.stats()
+    assert st["spill_capacity_blocks"] == 0
+    assert st["spill_host_blocks"] == 0 and st["spill_dropped"] == 0
+
+
+# ----------------------- standing contracts re-certified, tier armed
+class _NoUpload:
+    """jnp stand-in whose ``asarray`` (the engine's one host-upload
+    idiom) raises — same tripwire as tests/test_paged_overlap.py."""
+
+    def __getattr__(self, name):
+        return getattr(jnp, name)
+
+    def asarray(self, *a, **kw):  # noqa: D102 - tripwire
+        raise AssertionError("host->device upload in steady-state decode")
+
+
+def test_spill_armed_steady_window_flat_h2d(trained, monkeypatch):
+    """Transfer-guard re-certification: with radix + spill ARMED, a
+    steady window moves nothing host<->device — spill/prefetch traffic
+    is admission-boundary work and its programs are warm-compiled at
+    init, so the armed-but-idle tier must be invisible to the guard,
+    the asarray tripwire, and the h2d_ticks counter alike."""
+    eng = PagedEngine(trained, CFG, slots=2, n_blocks=32, block_size=8,
+                      max_seq=64, prefix_index="radix", spill_blocks=16)
+    eng.submit(_cycle_prompt(4), max_new=30)
+    eng.submit(_cycle_prompt(6), max_new=30, temperature=1.5, seed=3)
+    for _ in range(4):    # admission + compile happen OUTSIDE the guard
+        eng.step()
+    before = eng.stats()
+    monkeypatch.setattr(paged_mod, "jnp", _NoUpload())
+    with jax.transfer_guard("disallow"):
+        for _ in range(8):
+            eng.step()
+    monkeypatch.undo()
+    st = eng.stats()
+    assert st["ticks"] == before["ticks"] + 8
+    assert st["h2d_ticks"] == before["h2d_ticks"], "steady tick uploaded"
+    assert st["host_syncs"] == before["host_syncs"], "steady tick synced"
+    want = generate(trained, _cycle_prompt(4)[None, :], CFG, steps=30,
+                    temperature=0.0)[0]
+    assert np.array_equal(eng.run()[0], want)
+
+
+def test_spill_armed_steady_window_zero_recompiles(trained):
+    """Recompile-tripwire re-certification: after REAL spill and
+    prefetch traffic (so the transfer programs have run, not merely
+    warm-compiled), a steady decode window under strict() still
+    records zero recompiles — ``decode_steady_recompiles == 0`` holds
+    with the tier armed."""
+    eng = PagedEngine(trained, CFG, slots=1, n_blocks=8, block_size=8,
+                      max_seq=64, prefix_index="radix", spill_blocks=16)
+    a = _cycle_prompt(17)
+    _spin_waves(eng, [a])
+    for f in [(np.arange(i, i + 17) % 11).astype(np.int32)
+              for i in (1, 2, 3)]:
+        _spin_waves(eng, [f])                 # churn: spill A out
+    assert eng.counters["spill_spilled"] >= 1
+    eng.submit(a, max_new=24)                 # prefetch A back in
+    for _ in range(4):
+        eng.step()
+    assert eng.counters["spill_prefetched"] >= 1
+    assert eng._steady, "engine never reached the steady state"
+    r0 = eng.counters["recompiles"]
+    with cstats.strict():
+        for _ in range(12):
+            eng.step()
+    assert eng.counters["recompiles"] == r0 == 0
+    eng.run()

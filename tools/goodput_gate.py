@@ -551,6 +551,23 @@ def main(argv=None) -> int:
     ap.add_argument("--autoscale-max", type=int, default=3, metavar="N",
                     help="ceiling passed to the autoscaler-armed "
                          "daemon in the --autoscale scenario")
+    ap.add_argument("--prefix-cache", action="store_true",
+                    help="hierarchical-cache certification (round 18): "
+                         "replay the trace against an HBM-only daemon "
+                         "(dict prefix index — the reference outputs "
+                         "AND the hit-rate floor), then again against "
+                         "a radix + host-RAM spill-tier daemon "
+                         "(--prefix-index radix --spill-blocks N) and "
+                         "gate: the trace's block-aligned working set "
+                         "is >= 4x the 128-block HBM pool, the "
+                         "spill-enabled hit rate is STRICTLY above "
+                         "HBM-only, blocks actually spilled AND "
+                         "prefetched, attainment >= the reference, and "
+                         "every stream is bit-identical to the spill-"
+                         "disabled reference (use with --spec prefix)")
+    ap.add_argument("--spill-blocks", type=int, default=512, metavar="N",
+                    help="host spill-tier capacity (blocks) for the "
+                         "armed daemon in the --prefix-cache scenario")
     ap.add_argument("--kill-at", type=float, default=0.4, metavar="F",
                     help="when to SIGKILL, as a fraction of the "
                          "reference replay's wall time (default 0.4)")
@@ -596,9 +613,14 @@ def main(argv=None) -> int:
     chaos = None
     kill = None
     autoscale = None
+    prefix_cache = None
     if args.autoscale and (args.chaos or args.kill_daemon):
         ap.error("--autoscale is its own scenario: run --chaos/"
                  "--kill-daemon as separate invocations")
+    if args.prefix_cache and (args.chaos or args.kill_daemon
+                              or args.autoscale):
+        ap.error("--prefix-cache is its own scenario: run --chaos/"
+                 "--kill-daemon/--autoscale as separate invocations")
     if args.kill_daemon:
         if not args.spawn_daemon:
             ap.error("--kill-daemon needs --spawn-daemon (the gate "
@@ -683,6 +705,73 @@ def main(argv=None) -> int:
                      "compared": compared, "mismatches": mismatches,
                      "settled": run["settled"],
                      "reference_wall_s": round(ref["wall_s"], 3)}
+    elif args.prefix_cache:
+        if not args.spawn_daemon:
+            ap.error("--prefix-cache needs --spawn-daemon (the "
+                     "HBM-only and spill-enabled replays each own a "
+                     "private daemon)")
+        if args.spill_blocks < 1:
+            ap.error("--spill-blocks must be >= 1")
+        # The scenario only proves anything when the trace's shared-
+        # prefix working set cannot fit on-chip: require >= 4x the
+        # serving pool (128 blocks of 16 tokens each — the config
+        # tpulab/daemon.py _build_engine hard-wires).  Prompts are
+        # byte-level tokens, so the block-aligned working set is
+        # countable from the trace alone; depth mirrors the engine's
+        # prefill region (prompt minus the last token).
+        srv_bs, srv_pool = 16, 128
+        ws = set()
+        for r in trace.requests:
+            pb = r["prompt"].encode()
+            for j in range(1, (len(pb) - 1) // srv_bs + 1):
+                ws.add(pb[: srv_bs * j])
+        if len(ws) < 4 * srv_pool:
+            ap.error(f"trace working set {len(ws)} blocks < 4x the "
+                     f"{srv_pool}-block HBM pool: use --spec prefix "
+                     f"or a heavier shared-prefix trace")
+        # HBM-only reference first: the default dict prefix index with
+        # NO spill tier.  Its per-request output shas are the
+        # bit-equality contract the hierarchical cache must honour,
+        # and its hit rate is the floor it must strictly beat.
+        ref = run_replay(args, rep, trace, label="[hbm] ")
+        run = run_replay(
+            args, rep, trace, label="[spill] ",
+            extra_args=["--prefix-index", "radix",
+                        "--spill-blocks", str(args.spill_blocks),
+                        "--spill-dtype", "native"])
+        compared, mismatches = compare_streams(ref["results"],
+                                               run["results"])
+
+        # engine_* stats are published as gauges holding cumulative
+        # engine counters, NOT in counter_deltas' daemon counter set —
+        # delta the scrapes directly
+        def _gdelta(cap, gname):
+            a = cap["after"].get(gname, {}).get("value") or 0
+            b = cap["before"].get(gname, {}).get("value") or 0
+            return int(a - b)
+
+        def _rate(cap):
+            h = _gdelta(cap, "engine_prefix_hits")
+            m = _gdelta(cap, "engine_prefix_misses")
+            return h, m, (h / (h + m) if h + m else 0.0)
+
+        hbm_h, hbm_m, hbm_rate = _rate(ref)
+        sp_h, sp_m, sp_rate = _rate(run)
+        ref_overall = loadgen.summarize(
+            ref["results"], trace, ref["wall_s"])["overall"]
+        prefix_cache = {
+            "working_set_blocks": len(ws), "pool_blocks": srv_pool,
+            "spill_blocks": args.spill_blocks,
+            "compared": compared, "mismatches": mismatches,
+            "hbm_hits": hbm_h, "hbm_misses": hbm_m,
+            "hbm_hit_rate": round(hbm_rate, 4),
+            "spill_hits": sp_h, "spill_misses": sp_m,
+            "spill_hit_rate": round(sp_rate, 4),
+            "spilled_blocks": _gdelta(run, "engine_spill_spilled"),
+            "prefetched_blocks": _gdelta(run, "engine_spill_prefetched"),
+            "spill_admission_hits": _gdelta(run, "engine_spill_hits"),
+            "reference_attainment": ref_overall["attainment"],
+            "reference_wall_s": round(ref["wall_s"], 3)}
     else:
         run = run_replay(args, rep, trace,
                          rolling=args.rolling_restart)
@@ -709,6 +798,8 @@ def main(argv=None) -> int:
         report["kill"] = kill
     if autoscale is not None:
         report["autoscale"] = autoscale
+    if prefix_cache is not None:
+        report["prefix_cache"] = prefix_cache
     if run["roll"] is not None:
         report["rolling_restart"] = run["roll"]
     if args.out:
@@ -732,6 +823,15 @@ def main(argv=None) -> int:
          "vs_baseline": None, "in_slo": overall["in_slo"],
          "eligible": overall["n"] - overall["cancelled"]},
     ]
+    if prefix_cache is not None:
+        rows.append(
+            {"metric": "prefix_cache_hit_rate",
+             "value": prefix_cache["spill_hit_rate"],
+             "unit": "fraction", "vs_baseline": None,
+             "hbm_hit_rate": prefix_cache["hbm_hit_rate"],
+             "working_set_blocks": prefix_cache["working_set_blocks"],
+             "spilled_blocks": prefix_cache["spilled_blocks"],
+             "prefetched_blocks": prefix_cache["prefetched_blocks"]})
     for row in rows:
         print(json.dumps(row), flush=True)
 
@@ -901,6 +1001,54 @@ def main(argv=None) -> int:
               f"preemption(s), {steps} brownout step(s) / "
               f"{reversals} reversal(s), "
               f"{counters.get('daemon_migrations', 0)} migration(s)",
+              file=sys.stderr, flush=True)
+    if prefix_cache is not None:
+        # hierarchical-cache acceptance: blocks actually crossed the
+        # tier boundary in BOTH directions (spill out, prefetch back),
+        # the spill-enabled hit rate is STRICTLY above HBM-only on the
+        # same trace, attainment did not regress vs the spill-disabled
+        # reference, and every stream is bit-identical to it — the
+        # host tier may only ever change WHERE bytes live, never what
+        # any client reads.
+        pc = prefix_cache
+        if pc["spilled_blocks"] < 1:
+            print("[goodput_gate] FAIL: no block was ever spilled to "
+                  "host (engine_spill_spilled delta 0) — the tier was "
+                  "never exercised", file=sys.stderr, flush=True)
+            rc = 1
+        if pc["prefetched_blocks"] < 1:
+            print("[goodput_gate] FAIL: no block was ever prefetched "
+                  "back from host (engine_spill_prefetched delta 0) — "
+                  "spilled prefixes were never re-used",
+                  file=sys.stderr, flush=True)
+            rc = 1
+        if not pc["spill_hit_rate"] > pc["hbm_hit_rate"]:
+            print(f"[goodput_gate] FAIL: spill-enabled hit rate "
+                  f"{pc['spill_hit_rate']} is not strictly above the "
+                  f"HBM-only floor {pc['hbm_hit_rate']}",
+                  file=sys.stderr, flush=True)
+            rc = 1
+        ref_att = pc["reference_attainment"]
+        if (overall["attainment"] is not None and ref_att is not None
+                and overall["attainment"] < ref_att):
+            print(f"[goodput_gate] FAIL: attainment "
+                  f"{overall['attainment']} regressed below the "
+                  f"spill-disabled reference {ref_att}",
+                  file=sys.stderr, flush=True)
+            rc = 1
+        if pc["mismatches"]:
+            print(f"[goodput_gate] FAIL: {len(pc['mismatches'])} "
+                  f"stream(s) diverged from the spill-disabled "
+                  f"reference, e.g. {pc['mismatches'][:3]}",
+                  file=sys.stderr, flush=True)
+            rc = 1
+        print(f"[goodput_gate] prefix-cache: {pc['compared']} streams "
+              f"bit-compared vs reference, working set "
+              f"{pc['working_set_blocks']} blocks over a "
+              f"{pc['pool_blocks']}-block pool, hit rate "
+              f"{pc['hbm_hit_rate']} -> {pc['spill_hit_rate']}, "
+              f"{pc['spilled_blocks']} spill(s) / "
+              f"{pc['prefetched_blocks']} prefetch(es)",
               file=sys.stderr, flush=True)
     if run["roll"] is not None:
         roll = run["roll"]

@@ -989,6 +989,157 @@ def bench_autoscale_overhead(
     }
 
 
+def bench_spill_overhead(
+    slots: int = 4, steps: int = 96, reps: int = 5
+) -> Dict[str, Any]:
+    """Hierarchical-cache tax on the serving hot path (round 18):
+    steady-state engine ticks/s WITHOUT the cache tier (the default —
+    dict prefix index, no spill) vs WITH the radix index and an ARMED
+    BUT COLD host spill tier (``prefix_index="radix"``,
+    ``spill_blocks=64``).  Armed-but-cold is the configuration the <1%
+    budget covers: the watermark policy is consulted every admission
+    and the tier's bookkeeping exists, but short prompts on a roomy
+    pool never cross the 0.90 watermark, so no block ever crosses the
+    host boundary — exactly the steady decode a daemon started with
+    ``--spill-blocks`` spends its life in between prefix storms.
+    Spill/prefetch traffic itself is admission-boundary work, priced
+    by the goodput gate's --prefix-cache scenario, not here.  Same
+    tiny-model window and best-of-reps retry-merge as
+    ``bench_journal_overhead``.  The reported value is the
+    spill-armed ticks/s, gated in baselines.json like
+    ``journal_overhead``."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpulab.models.labformer import LabformerConfig, init_params
+    from tpulab.models.paged import PagedEngine
+    from tpulab.runtime.device import default_device
+
+    cfg = LabformerConfig(d_model=64, n_heads=4, n_layers=2, d_ff=128,
+                          max_seq=256, dtype=jnp.float32)
+    device = default_device()
+    params = jax.device_put(init_params(cfg, seed=0), device)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab, (8,)).astype(np.int32)
+               for _ in range(slots)]
+    warm = 6
+
+    def window(spill_on: bool):
+        kw = ({"prefix_index": "radix", "spill_blocks": 64}
+              if spill_on else {})
+        eng = PagedEngine(params, cfg, slots=slots, n_blocks=64,
+                          block_size=16, max_seq=256, obs=False, **kw)
+        for p in prompts:  # budget outlives warm + timed window
+            eng.submit(p, max_new=warm + steps + 4)
+        for _ in range(warm):  # admission + compile outside the window
+            eng.step()
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            eng.step()
+        dt = time.perf_counter() - t0
+        if spill_on:
+            # armed-but-cold contract: the budget only means anything
+            # if no host traffic happened inside the timed window
+            assert eng.counters["spill_spilled"] == 0, \
+                "spill fired inside the cold window"
+        return dt
+
+    for on in (False, True):
+        window(on)  # compile prefill bucket + paged_tick (+ spill
+        # programs: warm-compiled at engine init, outside any window)
+    times = {False: [], True: []}
+    for attempt in range(5):
+        for _ in range(max(reps, 3)):
+            for on in (False, True):
+                times[on].append(window(on))
+        best_overhead = min(times[True]) / min(times[False]) - 1.0
+        if best_overhead < 0.01:
+            break  # retry-merge as in bench_journal_overhead
+    t_on = float(np.median(times[True]))
+    t_off = float(np.median(times[False]))
+    assert best_overhead < 0.01, (
+        f"armed-but-cold spill overhead {best_overhead * 100:.2f}% "
+        f"exceeds the 1% steady-state decode budget "
+        f"(on={min(times[True]):.4f}s off={min(times[False]):.4f}s)")
+    return {
+        "metric": f"spill_overhead_{slots}slots_ticks_per_s",
+        "value": round(steps / t_on, 1),
+        "unit": "ticks/s",
+        "vs_baseline": None,
+        "off_ticks_per_s": round(steps / t_off, 1),
+        "overhead_pct_median": round((t_on / t_off - 1.0) * 100, 2),
+        "overhead_pct_best": round(best_overhead * 100, 2),
+        "spill_blocks": 64,
+        "device": device.platform,
+        **variance_fields([t * 1e3 for t in times[True]]),
+    }
+
+
+def bench_prefix_lookup(
+    short: int = 4096, factor: int = 4, reps: int = 7
+) -> Dict[str, Any]:
+    """Admission-path prefix lookup must scale O(L) in prompt length
+    (round 18 satellite): the dict index's old scan rebuilt the key
+    bytes at every depth — O(L^2) over long prompts — and now chains
+    sha256 digests in ONE pass over the prefill region.  Time
+    ``_lookup_prefix`` on a miss (the worst case: every depth is
+    hashed and probed) at ``short`` tokens and ``short * factor``
+    tokens and assert the per-token cost stays flat: best-of-reps
+    ``t_long / t_short`` must sit well under ``factor**2 / 2`` — the
+    quadratic scan scales like ``factor**2`` (16x at the default 4x),
+    the linear chain like ``factor``.  Pure host-side work; no engine
+    step runs."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpulab.models.labformer import LabformerConfig, init_params
+    from tpulab.models.paged import PagedEngine
+    from tpulab.runtime.device import default_device
+
+    cfg = LabformerConfig(d_model=64, n_heads=4, n_layers=2, d_ff=128,
+                          max_seq=256, dtype=jnp.float32)
+    device = default_device()
+    params = jax.device_put(init_params(cfg, seed=0), device)
+    eng = PagedEngine(params, cfg, slots=2, n_blocks=16, block_size=16,
+                      max_seq=256, obs=False)
+    rng = np.random.default_rng(0)
+    long = short * factor
+    p_short = rng.integers(0, cfg.vocab, (short,)).astype(np.int32)
+    p_long = rng.integers(0, cfg.vocab, (long,)).astype(np.int32)
+
+    def timed(prompt):
+        t0 = time.perf_counter()
+        blocks, pos = eng._lookup_prefix(prompt)
+        dt = time.perf_counter() - t0
+        assert blocks == [] and pos == 0  # miss path end to end
+        return dt
+
+    timed(p_short), timed(p_long)  # warm allocators
+    t_s = min(timed(p_short) for _ in range(max(reps, 3)))
+    t_l = min(timed(p_long) for _ in range(max(reps, 3)))
+    ratio = t_l / t_s
+    bound = factor ** 2 / 2.0
+    assert ratio < bound, (
+        f"prefix lookup scaled {ratio:.1f}x over a {factor}x longer "
+        f"prompt (>= {bound:.0f}x bound): the admission path has "
+        f"gone quadratic again")
+    return {
+        "metric": "prefix_lookup_tokens_per_s",
+        "value": round(long / t_l, 1),
+        "unit": "tokens/s",
+        "vs_baseline": None,
+        "short_tokens": short,
+        "long_tokens": long,
+        "scaling_ratio": round(ratio, 2),
+        "linear_bound": round(bound, 1),
+        "device": device.platform,
+    }
+
+
 def bench_decode_recompiles(
     slots: int = 4, steps: int = 64, spec_k: int = 2
 ) -> Dict[str, Any]:
@@ -1316,6 +1467,8 @@ def run_benchmarks(only: Optional[str] = None, yield_markers: bool = False,
         "fault_overhead": bench_fault_overhead,
         "journal_overhead": bench_journal_overhead,
         "autoscale_overhead": bench_autoscale_overhead,
+        "spill_overhead": bench_spill_overhead,
+        "prefix_lookup": bench_prefix_lookup,
         "decode_recompiles": bench_decode_recompiles,
         "train_step_overhead": bench_train_step,
         "labvision_train": bench_labvision_train,

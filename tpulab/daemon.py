@@ -208,6 +208,23 @@ _SPEC_K = 4
 #: overrides the daemon-wide default at startup.
 PREFILL_CHUNK = 32
 
+#: prefix-index structure for the daemon's engines: "dict" keeps the
+#: exact-match OrderedDict; "radix" swaps in the tpulab.kvcache radix
+#: tree whose lookups return the longest PARTIAL hit (any block-aligned
+#: prefix of a cached prefix).  ``--prefix-index`` overrides at startup;
+#: daemon-wide (not per-request) — all engines share one policy.
+PREFIX_INDEX = os.environ.get("TPULAB_DAEMON_PREFIX_INDEX", "dict")
+
+#: host-RAM spill tier capacity in KV blocks (0 = disarmed): cold
+#: radix leaves evict to host numpy instead of being dropped and are
+#: prefetched back at admission.  Requires --prefix-index radix.
+SPILL_BLOCKS = int(os.environ.get("TPULAB_DAEMON_SPILL_BLOCKS", "0"))
+
+#: host-tier payload format: "native" is lossless (bit-identical
+#: streams vs a spill-disabled reference); "int8"/"int4" shrink the
+#: host footprint at the cost of requantization error on restore.
+SPILL_DTYPE = os.environ.get("TPULAB_DAEMON_SPILL_DTYPE", "native")
+
 #: bounded admission: each serving engine's pending queue caps here and
 #: submit-past-the-bound sheds with retry-after instead of growing an
 #: unbounded backlog no request in it could meet a deadline through
@@ -2276,6 +2293,13 @@ def _build_engine(path, attn: str, kv_dtype: str, tp: int,
         # bounded admission queue: backpressure (shed-with-retry-after)
         # instead of unbounded pending growth
         max_pending=MAX_PENDING,
+        # hierarchical cache policy (daemon-wide, --prefix-index /
+        # --spill-blocks / --spill-dtype): radix partial-hit index and
+        # the host-RAM spill tier; mesh engines stay on the dict (the
+        # engine rejects spill on sharded pools)
+        prefix_index=PREFIX_INDEX if mesh is None else "dict",
+        spill_blocks=SPILL_BLOCKS if mesh is None else 0,
+        spill_dtype=SPILL_DTYPE,
     )
     engine._build_key = (path, attn, kv_dtype, tp, prefill_chunk)
     engine._build_stamp = _ckpt_stamp(path) if path else None
@@ -3738,7 +3762,8 @@ def serve(socket_path: str, *, max_requests: Optional[int] = None) -> None:
 
 def main(argv=None) -> int:
     global PREFILL_CHUNK, REPLICAS, HEDGE_MS, METRICS_INTERVAL_S, \
-        _JOURNAL, AUTOSCALE_MIN, AUTOSCALE_MAX
+        _JOURNAL, AUTOSCALE_MIN, AUTOSCALE_MAX, PREFIX_INDEX, \
+        SPILL_BLOCKS, SPILL_DTYPE
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--socket", default=os.environ.get("TPULAB_DAEMON_SOCKET", "/tmp/tpulab.sock"))
     ap.add_argument("--max-requests", type=int, default=None, help="exit after N requests (tests)")
@@ -3794,6 +3819,28 @@ def main(argv=None) -> int:
                          "each warm fleet between --autoscale-min and N "
                          "replicas (default TPULAB_DAEMON_AUTOSCALE_MAX "
                          "or 0 = disarmed, fixed --replicas fleet)")
+    ap.add_argument("--prefix-index", choices=("dict", "radix"),
+                    default=PREFIX_INDEX,
+                    help="prefix-cache structure for the serving "
+                         "engines: 'radix' returns longest PARTIAL "
+                         "hits (any block-aligned prefix of a cached "
+                         "prefix); 'dict' is the exact-match legacy "
+                         "index (default TPULAB_DAEMON_PREFIX_INDEX "
+                         "or dict)")
+    ap.add_argument("--spill-blocks", type=int, default=SPILL_BLOCKS,
+                    metavar="N",
+                    help="host-RAM KV spill tier capacity in blocks "
+                         "(0 = off): cold radix leaves spill to host "
+                         "numpy on eviction and prefetch back at "
+                         "admission; requires --prefix-index radix "
+                         "(default TPULAB_DAEMON_SPILL_BLOCKS or 0)")
+    ap.add_argument("--spill-dtype", choices=("native", "int8", "int4"),
+                    default=SPILL_DTYPE,
+                    help="host spill-tier payload format: 'native' is "
+                         "lossless (streams bit-identical to a "
+                         "spill-disabled reference); int8/int4 shrink "
+                         "host bytes, lossy on restore (default "
+                         "TPULAB_DAEMON_SPILL_DTYPE or native)")
     ap.add_argument("--slowlog", type=int, default=None, metavar="N",
                     help="per-request slow-log window: keep the worst N "
                          "requests by e2e latency (default 64; 0 "
@@ -3813,6 +3860,11 @@ def main(argv=None) -> int:
         ap.error("--slowlog must be >= 0")
     if args.metrics_interval < 0:
         ap.error("--metrics-interval must be >= 0 (0 disables)")
+    if args.spill_blocks < 0:
+        ap.error("--spill-blocks must be >= 0 (0 disables)")
+    if args.spill_blocks and args.prefix_index != "radix":
+        ap.error("--spill-blocks > 0 requires --prefix-index radix "
+                 "(the spill tier keys host payloads by radix paths)")
     # elastic-fleet bounds: reject misconfiguration HERE with a
     # parseable argparse error (exit 2, message on stderr) instead of
     # a late crash inside the first fleet build
@@ -3837,6 +3889,9 @@ def main(argv=None) -> int:
     PREFILL_CHUNK = args.prefill_chunk
     REPLICAS = args.replicas
     HEDGE_MS = args.hedge_ms
+    PREFIX_INDEX = args.prefix_index
+    SPILL_BLOCKS = args.spill_blocks
+    SPILL_DTYPE = args.spill_dtype
     METRICS_INTERVAL_S = args.metrics_interval
     AUTOSCALE_MIN = args.autoscale_min
     AUTOSCALE_MAX = args.autoscale_max

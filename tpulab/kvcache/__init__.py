@@ -1,0 +1,9 @@
+"""Hierarchical prefix/KV cache: radix-tree partial-hit index over the
+HBM block pool plus a host-RAM spill tier with prefetch-on-admission.
+See docs/ARCHITECTURE.md "Hierarchical KV cache"."""
+from tpulab.kvcache.radix import RadixPrefixIndex
+from tpulab.kvcache.spill import (DEFAULT_WATERMARK, SPILL_DTYPES,
+                                  HostSpillTier, SpillPolicy)
+
+__all__ = ["RadixPrefixIndex", "HostSpillTier", "SpillPolicy",
+           "SPILL_DTYPES", "DEFAULT_WATERMARK"]

@@ -1,0 +1,194 @@
+"""Host-RAM spill tier for cold KV blocks.
+
+When the radix prefix index evicts a cold leaf whose block nothing
+live references, the engine hands the block's KV here instead of
+dropping it; at the next admission that walks back onto that prefix,
+the engine prefetches the payload to the device ahead of prefill — a
+spill hit costs one H2D transfer, never a recompute.
+
+Payload fidelity is the load-bearing contract:
+
+* ``dtype="native"`` stores exactly what the pool held — raw
+  ``cfg.dtype`` arrays for native pools, the ``(int8 data, f32
+  scale)`` pair for quantized pools — so a spill round-trip is
+  LOSSLESS for both pool kinds and spill-enabled streams stay
+  bit-identical to a spill-disabled reference (the goodput gate's
+  compare_streams contract).
+* ``dtype="int8"`` / ``dtype="int4"`` re-encode native payloads to a
+  smaller host footprint (symmetric amax over the head dim, mirroring
+  ``paged._kv_quant``; int4 packs two nibbles per byte via
+  ``tpulab.models.quant``).  Opt-in and LOSSY for native pools — the
+  bit-equality gate runs ``native`` only.
+
+Keys are opaque bytes (the engine uses a sha256 digest chain over the
+block-aligned token prefix, the same chain its dict index probes), so
+this module needs no tokenizer, no engine, and no JAX.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Optional, Tuple
+
+import numpy as np
+
+from tpulab.models.quant import pack_int4, unpack_int4
+
+SPILL_DTYPES = ("native", "int8", "int4")
+
+#: Proactive-spill watermark: strictly below the ``kv_occupancy_high``
+#: alert threshold (tpulab/obs/alerts.py: blocks_used/blocks_total >=
+#: 0.95 for 5 s => warn), so the cache tier starts shedding cold blocks
+#: to host BEFORE the fleet alert fires, and a firing alert means the
+#: spill tier is already saturated or the working set is truly hot.
+DEFAULT_WATERMARK = 0.90
+
+
+class SpillPolicy:
+    """When/how much to spill at admission boundaries.
+
+    Reads the same occupancy ratio the PR-9 ``engine_blocks_used`` /
+    ``engine_blocks_total`` gauges publish and the PR-10
+    ``kv_occupancy_high`` alert thresholds on; ``batch`` bounds work
+    per admission so a pressure spike never turns one admission into an
+    unbounded d2h stall."""
+
+    def __init__(self, watermark: float = DEFAULT_WATERMARK,
+                 batch: int = 8) -> None:
+        if not 0.0 < watermark <= 1.0:
+            raise ValueError(f"watermark must be in (0, 1], got {watermark}")
+        if batch <= 0:
+            raise ValueError(f"batch must be positive, got {batch}")
+        self.watermark = float(watermark)
+        self.batch = int(batch)
+
+    def overage(self, blocks_used: int, blocks_total: int) -> int:
+        """How many blocks to shed now (0 when below the watermark)."""
+        if blocks_total <= 0:
+            return 0
+        limit = int(self.watermark * blocks_total)
+        return max(0, min(self.batch, blocks_used - limit))
+
+
+def _np_quant(x: np.ndarray, qmax: int) -> Tuple[np.ndarray, np.ndarray]:
+    """(..., d) -> (int8 data, f32 scale (...,)): symmetric amax, the
+    numpy mirror of ``paged._kv_quant`` generalized to ``qmax``."""
+    xf = np.asarray(x, np.float32)
+    scale = np.maximum(np.max(np.abs(xf), axis=-1), 1e-8) / float(qmax)
+    q = np.clip(np.round(xf / scale[..., None]), -qmax, qmax).astype(np.int8)
+    return q, scale.astype(np.float32)
+
+
+def _np_dequant(q: np.ndarray, scale: np.ndarray, dtype) -> np.ndarray:
+    return (q.astype(np.float32) * scale[..., None].astype(np.float32)
+            ).astype(dtype)
+
+
+def _encode(raw, dtype: str):
+    """Pool-representation payload -> host payload for one K or V slab.
+
+    ``raw`` is either a dense array (native pool block, (L, BS, kv, d))
+    or an ``(int8, f32 scale)`` pair (quantized pool block)."""
+    if dtype == "native":
+        return ("raw", raw)
+    if isinstance(raw, tuple):
+        q, s = raw
+        if dtype == "int8":  # already the pool's int8 representation
+            return ("q8", (q, s))
+        x = _np_dequant(q, s, np.float32)
+    else:
+        x = np.asarray(raw, np.float32)
+    if dtype == "int8":
+        return ("q8", _np_quant(x, 127))
+    q4, s4 = _np_quant(x, 7)
+    packed, odd = pack_int4(q4)
+    return ("q4", (packed, s4, q4.shape, odd))
+
+
+def _decode(entry, pool_is_quantized: bool, pool_dtype):
+    """Host payload -> the POOL's representation (dense array for
+    native pools, (int8, scale) pair for quantized pools)."""
+    kind, payload = entry
+    if kind == "raw":
+        return payload
+    if kind == "q8":
+        q, s = payload
+        if pool_is_quantized:
+            return q, s
+        return _np_dequant(q, s, pool_dtype)
+    packed, s4, shape, odd = payload
+    q4 = unpack_int4(packed, odd).reshape(shape)
+    x = _np_dequant(q4, s4, np.float32)
+    if pool_is_quantized:
+        return _np_quant(x, 127)
+    return x.astype(pool_dtype)
+
+
+def _entry_nbytes(entry) -> int:
+    kind, payload = entry
+    if kind == "raw":
+        if isinstance(payload, tuple):
+            return int(payload[0].nbytes) + int(payload[1].nbytes)
+        return int(payload.nbytes)
+    if kind == "q8":
+        return int(payload[0].nbytes) + int(payload[1].nbytes)
+    return int(payload[0].nbytes) + int(payload[1].nbytes)
+
+
+class HostSpillTier:
+    """Bounded LRU host cache of spilled KV blocks.
+
+    One entry per block: ``put(key, kraw, vraw)`` at eviction time,
+    ``get(key)`` at prefetch time (freshens, does NOT remove — the
+    block may be re-evicted and re-spilled cheaply).  At capacity the
+    tier drops ITS least-recently-used entry (``dropped`` counts them);
+    a dropped block falls back to prefill recompute, never an error."""
+
+    def __init__(self, capacity_blocks: int, dtype: str = "native") -> None:
+        if capacity_blocks <= 0:
+            raise ValueError(
+                f"capacity_blocks must be positive, got {capacity_blocks}")
+        if dtype not in SPILL_DTYPES:
+            raise ValueError(
+                f"spill dtype={dtype!r}; expected one of {SPILL_DTYPES}")
+        self.capacity = int(capacity_blocks)
+        self.dtype = dtype
+        self._entries: "OrderedDict[bytes, tuple]" = OrderedDict()
+        self._nbytes = 0
+        self.dropped = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, key: bytes) -> bool:
+        return key in self._entries
+
+    @property
+    def nbytes(self) -> int:
+        return self._nbytes
+
+    def put(self, key: bytes, kraw, vraw) -> None:
+        old = self._entries.pop(key, None)
+        if old is not None:
+            self._nbytes -= _entry_nbytes(old[0]) + _entry_nbytes(old[1])
+        while len(self._entries) >= self.capacity:
+            _, (ek, ev) = self._entries.popitem(last=False)
+            self._nbytes -= _entry_nbytes(ek) + _entry_nbytes(ev)
+            self.dropped += 1
+        entry = (_encode(kraw, self.dtype), _encode(vraw, self.dtype))
+        self._entries[key] = entry
+        self._nbytes += _entry_nbytes(entry[0]) + _entry_nbytes(entry[1])
+
+    def get(self, key: bytes, *, pool_is_quantized: bool,
+            pool_dtype) -> Optional[tuple]:
+        """Decoded ``(kblk, vblk)`` in the POOL's representation, or
+        ``None`` on miss."""
+        entry = self._entries.get(key)
+        if entry is None:
+            return None
+        self._entries.move_to_end(key)
+        return (_decode(entry[0], pool_is_quantized, pool_dtype),
+                _decode(entry[1], pool_is_quantized, pool_dtype))
+
+    def clear(self) -> None:
+        self._entries.clear()
+        self._nbytes = 0

@@ -146,6 +146,14 @@ class TraceSpec:
     cancel_ms: Tuple[float, float] = (150.0, 900.0)
     #: prompt + steps cap (the daemon's serving window is 512)
     max_total: int = 500
+    #: shared system-prompt headers (the hierarchical-cache tier's
+    #: traffic shape): > 0 prepends one of ``n_system_prompts``
+    #: deterministic headers of this many bytes to every ROOT prompt,
+    #: drawn from a child rng so the request schedule of specs that
+    #: leave this at 0 is unchanged.  Deep block-aligned sharing: every
+    #: session opening with the same header re-walks the same prefix.
+    system_prompt_len: int = 0
+    n_system_prompts: int = 1
     classes: Tuple[SLOClass, ...] = DEFAULT_CLASSES
 
 
@@ -197,6 +205,29 @@ SPECS: Dict[str, TraceSpec] = {
                      deadline_ms=None, ttft_ms=30000.0, itl_ms=10000.0,
                      e2e_ms=60000.0),
             SLOClass("bulk", weight=0.4, priority=0, deadline_ms=None,
+                     ttft_ms=60000.0, itl_ms=15000.0, e2e_ms=120000.0),
+        )),
+    # the hierarchical-cache tier (tools/goodput_gate.py --prefix-cache):
+    # heavy shared-prefix traffic — every root prompt opens with one of
+    # four 96-byte system headers, long prompts, multi-turn sessions
+    # that extend their parents verbatim — sized so the distinct
+    # block-aligned working set is >= 4x the daemon's 128-block HBM
+    # pool (the gate recomputes and asserts this from the trace
+    # itself).  No cancels and no deadlines: the acceptance gate
+    # requires EVERY stream bit-identical to a spill-disabled
+    # reference, so shedding and hang-ups must not be in play.
+    "prefix": TraceSpec(
+        name="prefix", seed=41, n_requests=96, arrival="poisson",
+        rate_rps=14.0, prompt_median=208, prompt_sigma=0.45,
+        prompt_min=160, prompt_max=384, steps_median=8, steps_sigma=0.5,
+        steps_min=4, steps_max=12, p_followup=0.55, max_turns=4,
+        think_ms=(120.0, 500.0), est_ms_per_token=20.0, p_cancel=0.0,
+        system_prompt_len=96, n_system_prompts=4,
+        classes=(
+            SLOClass("interactive", weight=0.7, priority=2,
+                     deadline_ms=None, ttft_ms=30000.0, itl_ms=10000.0,
+                     e2e_ms=60000.0),
+            SLOClass("bulk", weight=0.3, priority=0, deadline_ms=None,
                      ttft_ms=60000.0, itl_ms=15000.0, e2e_ms=120000.0),
         )),
 }
@@ -329,6 +360,15 @@ def build_trace(spec: TraceSpec) -> Trace:
     a FIXED order, so the same spec always yields the same trace —
     byte-identical JSON (the replayability acceptance criterion)."""
     rng = random.Random(spec.seed)
+    # shared system-prompt headers from a CHILD rng: specs that leave
+    # system_prompt_len at 0 consume exactly the draws they always did,
+    # so their committed traces stay byte-stable
+    sys_prompts: List[str] = []
+    if spec.system_prompt_len > 0:
+        hrng = random.Random((spec.seed << 8) ^ 0x517)
+        sys_prompts = [
+            _text(hrng, spec.system_prompt_len, prefix=f"<sys{i}> ")
+            for i in range(max(1, spec.n_system_prompts))]
     arrivals = _arrivals(spec, rng)
     followups: list = []  # (t_ms, seq, session, turn, parent_prompt)
     requests: List[dict] = []
@@ -351,7 +391,12 @@ def build_trace(spec: TraceSpec) -> Trace:
                                   spec.prompt_min,
                                   min(spec.prompt_max,
                                       spec.max_total - steps))
-            prompt = _text(rng, plen, prefix=f"[{cls.name}] ")
+            prefix = f"[{cls.name}] "
+            if sys_prompts:
+                prefix = (sys_prompts[rng.randrange(len(sys_prompts))]
+                          + prefix)
+                plen = max(plen, len(prefix) + 8)
+            prompt = _text(rng, plen, prefix=prefix)
         else:
             # the follow-up EXTENDS its parent's prompt verbatim — the
             # engine's exact-match prefix cache sees the parent's

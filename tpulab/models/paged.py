@@ -103,16 +103,19 @@ vLLM's PagedAttention, built on XLA gathers instead of custom CUDA.
 from __future__ import annotations
 
 import functools
+import hashlib
 import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
 from tpulab import faults as _faults
+from tpulab.kvcache import spill as _spill_mod
+from tpulab.kvcache.radix import RadixPrefixIndex as _RadixPrefixIndex
 from tpulab.obs import compilestats as _cstats
 from tpulab.obs import tracer as _obs_tracer
 from tpulab.obs.registry import gauge as _obs_gauge
@@ -636,6 +639,51 @@ def _spec_commit(state, adv, last_tok, new_keys, marks):
     )
 
 
+@functools.partial(jax.jit, donate_argnums=(0, 1))
+def _spill_restore(kpool, vpool, kblk, vblk, b):
+    """Write one host-prefetched KV block back into the pools at
+    dynamic index ``b`` (the spill tier's H2D leg).  ``kblk``/``vblk``
+    are the pool's own representation — dense (L, BS, kv, d) for native
+    pools, an (int8 data, f32 scale) pair for quantized pools — so the
+    restore is a pure placement, never a requantize."""
+    def put(pool, blk):
+        if isinstance(pool, tuple):
+            return (
+                jax.lax.dynamic_update_index_in_dim(pool[0], blk[0], b, 1),
+                jax.lax.dynamic_update_index_in_dim(pool[1], blk[1], b, 1))
+        return jax.lax.dynamic_update_index_in_dim(pool, blk, b, 1)
+    return put(kpool, kblk), put(vpool, vblk)
+
+
+@jax.jit
+def _spill_read(kpool, vpool, b):
+    """Read one block out of the pools at dynamic index ``b`` (the
+    spill tier's D2H leg).  Dynamic so every block index reuses ONE
+    compiled program — a static python index would compile per block
+    and trip the steady-state recompile tripwire."""
+    def rd(pool):
+        if isinstance(pool, tuple):
+            return (jax.lax.dynamic_index_in_dim(pool[0], b, 1, False),
+                    jax.lax.dynamic_index_in_dim(pool[1], b, 1, False))
+        return jax.lax.dynamic_index_in_dim(pool, b, 1, False)
+    return rd(kpool), rd(vpool)
+
+
+def _chain_digests(key: bytes, step: int) -> List[bytes]:
+    """sha256 digest CHAIN over ``step``-byte chunks of ``key``:
+    ``out[j]`` identifies the block-aligned prefix of j+1 chunks.  One
+    O(L) pass serves every depth — the dict index probes these instead
+    of rebuilding key bytes per depth, and the spill tier uses them as
+    host-entry keys (both sides hash the same token bytes, so a radix
+    eviction's path digest matches a later admission's probe)."""
+    h = hashlib.sha256()
+    out = []
+    for i in range(0, len(key), step):
+        h.update(key[i:i + step])
+        out.append(h.digest())
+    return out
+
+
 # ------------------------------------------------- compile observability
 # Every jitted program the engine dispatches reports into the process
 # compile ledger (tpulab.obs.compilestats) under a stable program name:
@@ -654,6 +702,8 @@ paged_tick = _cstats.instrument("paged_tick", paged_tick)
 _scatter_prefill = _cstats.instrument("scatter_prefill", _scatter_prefill)
 _draft_extend = _cstats.instrument("draft_extend", _draft_extend)
 _slot_write = _cstats.instrument("slot_write", _slot_write)
+_spill_restore = _cstats.instrument("spill_restore", _spill_restore)
+_spill_read = _cstats.instrument("spill_read", _spill_read)
 _table_trash = _cstats.instrument("table_trash", _table_trash)
 _spec_commit = _cstats.instrument("spec_commit", _spec_commit)
 _sample_tokens = _cstats.instrument("sample_tokens", _sample_tokens)
@@ -849,7 +899,8 @@ class PagedEngine:
                  spec_k: int = 0, spec_ngram: int = 3,
                  draft_params=None, draft_cfg=None, overlap: int = 1,
                  interleave: bool = True, obs: bool = True,
-                 max_pending: int = 0):
+                 max_pending: int = 0, prefix_index: str = "dict",
+                 spill_blocks: int = 0, spill_dtype: str = "native"):
         if max_seq % block_size:
             raise ValueError("max_seq must be a multiple of block_size")
         if prefill_chunk < 0:
@@ -894,6 +945,25 @@ class PagedEngine:
         if kv_dtype == "int8" and mesh is not None:
             raise ValueError("kv_dtype='int8' does not support mesh "
                              "serving (scale pools are unsharded)")
+        if prefix_index not in ("dict", "radix"):
+            raise ValueError(f"prefix_index={prefix_index!r}; expected "
+                             "'dict' or 'radix'")
+        if spill_blocks < 0:
+            raise ValueError(
+                f"spill_blocks must be >= 0, got {spill_blocks}")
+        if spill_blocks and prefix_index != "radix":
+            # the spill tier keys host payloads by radix token paths;
+            # the dict index cannot name a single evicted block
+            raise ValueError(
+                "spill_blocks > 0 requires prefix_index='radix'")
+        if spill_blocks and mesh is not None:
+            raise ValueError("spill_blocks > 0 does not support mesh "
+                             "serving (block d2h/restore is uncertified "
+                             "on sharded pools)")
+        if spill_dtype not in _spill_mod.SPILL_DTYPES:
+            raise ValueError(
+                f"spill_dtype={spill_dtype!r}; expected one of "
+                f"{_spill_mod.SPILL_DTYPES}")
         self.params = params
         self.cfg = cfg
         self.slots = slots
@@ -963,6 +1033,24 @@ class PagedEngine:
         # shared block, so shared blocks are read-only by construction.
         self.block_refs = np.zeros(n_blocks, np.int64)
         self.prefix_cache: "OrderedDict[bytes, List[int]]" = OrderedDict()
+        # hierarchical cache (tpulab.kvcache): prefix_index="radix"
+        # swaps the exact-match dict for a radix tree whose lookup
+        # returns the LONGEST PARTIAL hit; spill_blocks > 0 arms the
+        # host-RAM tier cold evictions land in and admissions prefetch
+        # from.  The dict stays the default AND the bit-equality oracle.
+        self.prefix_index = prefix_index
+        self._radix = (_RadixPrefixIndex(block_size)
+                       if prefix_index == "radix" else None)
+        self._spill = (_spill_mod.HostSpillTier(spill_blocks, spill_dtype)
+                       if spill_blocks else None)
+        self._spill_policy = (_spill_mod.SpillPolicy()
+                              if spill_blocks else None)
+        # dict-path digest side-index: sha256 CHAIN over block-sized
+        # token chunks, so _lookup_prefix hashes a prompt once (O(L))
+        # and probes every block depth in O(1) instead of rebuilding
+        # the key bytes per depth (the old O(L^2) admission cost)
+        self._pc_digest: Dict[bytes, bytes] = {}
+        self._pc_by_digest: Dict[bytes, bytes] = {}
         # chunked prefill: admit long prompts in fixed windows through
         # paged_extend instead of one whole-tail program — peak prefill
         # activation memory and compile-bucket count stay bounded
@@ -1025,6 +1113,13 @@ class PagedEngine:
             # multi-second stall hit live traffic.  The tripwire raises
             # instead under tpulab.obs.compilestats.strict() (tests).
             "recompiles": 0,
+            # hierarchical-cache observability (round 18): spill_spilled
+            # = cold blocks handed to the host tier at eviction;
+            # spill_prefetched = blocks restored to HBM ahead of
+            # admission; spill_hits = admissions the host tier extended
+            # past the HBM radix hit.  Always present (0 while the tier
+            # is disarmed) so the stats surface is config-independent.
+            "spill_spilled": 0, "spill_prefetched": 0, "spill_hits": 0,
         }
         # bounded admission queue (0 = unbounded): submit raises
         # QueueFullError past the bound — backpressure the daemon maps
@@ -1091,6 +1186,15 @@ class PagedEngine:
 
         _cstats.COMPILESTATS.set_model_flops(
             "paged_tick", float(slots * _ptf(cfg)))
+        if self._spill is not None:
+            # compile the spill D2H/H2D programs NOW, against the TRASH
+            # block: the first real spill/prefetch lands mid-wave inside
+            # a steady step, where a fresh compile is a recompile-
+            # tripwire violation (and a multi-second stall on chip)
+            kblk, vblk = jax.device_get(
+                _spill_read(self.kpool, self.vpool, np.int32(TRASH)))
+            self.kpool, self.vpool = _spill_restore(
+                self.kpool, self.vpool, kblk, vblk, np.int32(TRASH))
 
     def _init_dev_state(self):
         # DEVICE-allocated (jnp.zeros/ones, never jnp.asarray of a
@@ -1264,22 +1368,74 @@ class PagedEngine:
 
     def _lookup_prefix(self, prompt: np.ndarray):
         """Longest cached block-aligned prefix of the prefill region
-        (prompt[:-1]); returns (shared_blocks, shared_positions)."""
+        (prompt[:-1]); returns (shared_blocks, shared_positions).
+
+        radix: one cursor walk from the root returns the longest
+        PARTIAL hit (any block-aligned prefix of any cached prefix).
+        dict: one O(L) digest chain plus an O(1) probe per depth
+        replaces the old rebuild-the-key-bytes-per-depth scan (O(L^2)
+        over long prompts); exact-hit semantics are unchanged — the
+        candidate depth is confirmed against the real key bytes, and a
+        digest collision just falls back to shallower direct probes."""
         nb_full = (len(prompt) - 1) // self.block_size
-        for j in range(nb_full, 0, -1):
-            key = prompt[: j * self.block_size].tobytes()
-            hit = self.prefix_cache.get(key)
+        if nb_full <= 0:
+            return [], 0
+        if self._radix is not None:
+            blocks, nb = self._radix.lookup(
+                prompt[: nb_full * self.block_size])
+            return blocks, nb * self.block_size
+        key = prompt[: nb_full * self.block_size].tobytes()
+        step = self.block_size * prompt.itemsize
+        best = 0
+        for j, d in enumerate(_chain_digests(key, step), start=1):
+            if d in self._pc_by_digest:
+                best = j
+        while best:
+            k = key[: best * step]
+            hit = self.prefix_cache.get(k)
             if hit is not None:
-                self.prefix_cache.move_to_end(key)  # LRU freshen
-                return list(hit), j * self.block_size
+                self.prefix_cache.move_to_end(k)  # LRU freshen
+                return list(hit), best * self.block_size
+            best -= 1
         return [], 0
+
+    def _spill_out(self, block: int, path: Tuple[int, ...]):
+        """Hand one cold evicted block to the host tier (D2H at an
+        eviction boundary — never inside steady decode)."""
+        key = _chain_digests(
+            np.asarray(path, np.int32).tobytes(), self.block_size * 4)[-1]
+        kblk, vblk = jax.device_get(
+            _spill_read(self.kpool, self.vpool, np.int32(block)))
+        self._spill.put(key, kblk, vblk)
+        self.counters["spill_spilled"] += 1
+        self._trace.event("kv.spill", int(block))
 
     def _evict_prefixes(self, want_free: int):
         """Drop least-recently-used cached prefixes until ``want_free``
         blocks are available (entries a live request still references
-        only lose the cache's own ref; blocks free when refs hit 0)."""
+        only lose the cache's own ref; blocks free when refs hit 0).
+
+        radix: leaf-at-a-time LRU — deep cold suffixes go first while
+        the hot shared trunk stays cached; with the spill tier armed, a
+        COLD leaf (cache-only, refcount 1) spills to host RAM on the
+        way out instead of being dropped."""
+        if self._radix is not None:
+            while len(self.free) < want_free and self._radix.n_blocks:
+                got = self._radix.evict_leaf()
+                if got is None:
+                    break
+                block, path = got
+                self.counters["evictions"] += 1
+                self._trace.event("engine.evict", 1)
+                if self._spill is not None and self.block_refs[block] == 1:
+                    self._spill_out(block, path)
+                self._deref(block)
+            return
         while len(self.free) < want_free and self.prefix_cache:
-            _, blocks = self.prefix_cache.popitem(last=False)
+            key, blocks = self.prefix_cache.popitem(last=False)
+            d = self._pc_digest.pop(key, None)
+            if d is not None and self._pc_by_digest.get(d) == key:
+                del self._pc_by_digest[d]
             self.counters["evictions"] += 1
             self._trace.event("engine.evict", len(blocks))
             for b in blocks:
@@ -1289,6 +1445,11 @@ class PagedEngine:
         """Blocks the cache alone holds — the number eviction could
         actually return to the free list (blocks a live request or an
         admission pin also references stay allocated regardless)."""
+        if self._radix is not None:
+            # 1:1 node<->block, one cache ref per node: a block is
+            # cache-only exactly when its refcount is that single ref
+            return sum(1 for b in self._radix.blocks()
+                       if self.block_refs[b] == 1)
         cache_refs: Dict[int, int] = {}
         for blocks in self.prefix_cache.values():
             for b in blocks:
@@ -1301,12 +1462,82 @@ class PagedEngine:
         if self.block_refs[block] == 0:
             self.free.append(int(block))
 
+    def _prefetch_spill(self, req: "_Request", shared: List[int],
+                        shared_pos: int):
+        """Extend the HBM radix hit with host-tier blocks: probe the
+        spill tier for successively deeper block-aligned prefixes and
+        restore hits into freshly-claimed free blocks BEFORE prefill
+        decides what it must recompute — a spill hit costs one H2D
+        prefetch, never a recompute; a miss (or an empty free list)
+        falls through to normal prefill for the remaining tail.  Runs
+        at the admission boundary only, so steady decode's h2d_ticks
+        stays flat with the tier armed (transfer-guard contract).
+        Restored blocks become ordinary radix entries (one cache ref),
+        so the admission arithmetic is unchanged: each prefetched block
+        consumes one free block and shortens the prefill tail by one —
+        ``_head_admittable``'s feasibility simulation stays exact."""
+        prompt = req.prompt
+        bs = self.block_size
+        nb_full = (len(prompt) - 1) // bs
+        j = shared_pos // bs
+        if j >= nb_full or len(self._spill) == 0:
+            return shared, shared_pos
+        digs = _chain_digests(
+            np.ascontiguousarray(prompt[: nb_full * bs],
+                                 dtype=np.int32).tobytes(), bs * 4)
+        quantized = isinstance(self.kpool, tuple)
+        pool_dtype = (np.dtype(self.kpool[0].dtype) if quantized
+                      else np.dtype(self.kpool.dtype))
+        shared = list(shared)
+        got = 0
+        while j + got < nb_full and self.free:
+            payload = self._spill.get(digs[j + got],
+                                      pool_is_quantized=quantized,
+                                      pool_dtype=pool_dtype)
+            if payload is None:
+                break
+            b = self.free.pop()
+            self._h2d = True
+            self.kpool, self.vpool = _spill_restore(
+                self.kpool, self.vpool, payload[0], payload[1],
+                np.int32(b))
+            adopted = self._radix.insert(prompt[: (j + got + 1) * bs],
+                                         shared + [b])
+            for a in adopted:
+                self.block_refs[a] += 1
+            if adopted != [b]:
+                # path already materialized under us (defensive: the
+                # lookup said it ended at depth j+got) — b is unused
+                self.free.append(b)
+                break
+            shared.append(b)
+            got += 1
+            self.counters["spill_prefetched"] += 1
+            self._trace.event("kv.prefetch", int(b))
+        if got:
+            self.counters["spill_hits"] += 1
+            shared_pos = (j + got) * bs
+        return shared, shared_pos
+
     def _admit(self):
+        if self._spill_policy is not None and self.pending:
+            # proactive spill at the admission boundary: past the
+            # watermark (0.90, strictly below the kv_occupancy_high
+            # alert's 0.95 — tpulab/obs/alerts.py), shed a bounded
+            # batch of cold leaves to the host tier so the alert only
+            # fires once the spill tier itself can't keep up
+            used = self.n_usable_blocks - len(self.free)
+            over = self._spill_policy.overage(used, self.n_usable_blocks)
+            if over > 0:
+                self._evict_prefixes(len(self.free) + over)
         for s in range(self.slots):
             if self.active[s] is not None or not self.pending:
                 continue
             req = self.pending[0]
             shared, shared_pos = self._lookup_prefix(req.prompt)
+            if self._spill is not None:
+                shared, shared_pos = self._prefetch_spill(
+                    req, shared, shared_pos)
             # pin shared blocks NOW: eviction below may drop the very
             # cache entry we matched, and without our ref its blocks
             # would land on the free list while also sitting in `shared`
@@ -1401,6 +1632,18 @@ class PagedEngine:
         nb_full = (len(prompt) - 1) // self.block_size
         if nb_full == 0:
             return
+        if self._radix is not None:
+            # first writer wins per chunk: nodes that already exist
+            # keep their block (every live path chains through it), so
+            # the cache increfs exactly the newly-adopted blocks — a
+            # duplicate block this request prefilled privately stays
+            # slot-owned and frees on release
+            adopted = self._radix.insert(
+                prompt[: nb_full * self.block_size],
+                [int(b) for b in row[:nb_full]])
+            for b in adopted:
+                self.block_refs[b] += 1
+            return
         key = prompt[: nb_full * self.block_size].tobytes()
         if key in self.prefix_cache:
             return
@@ -1408,6 +1651,9 @@ class PagedEngine:
         for b in blocks:
             self.block_refs[b] += 1
         self.prefix_cache[key] = blocks
+        d = _chain_digests(key, self.block_size * prompt.itemsize)[-1]
+        self._pc_digest[key] = d
+        self._pc_by_digest[d] = key
 
     def _prefill_slot(self, s: int, req: _Request, row: np.ndarray,
                       shared_pos: int = 0):
@@ -2321,12 +2567,27 @@ class PagedEngine:
             "blocks_free": len(self.free),
             "blocks_used": self.n_usable_blocks - len(self.free),
             "blocks_total": self.n_usable_blocks,
-            "cache_entries": len(self.prefix_cache),
+            "cache_entries": (self._radix.n_entries
+                              if self._radix is not None
+                              else len(self.prefix_cache)),
             # bytes the cache's entries span (block-granular; shared
             # blocks counted once per entry referencing them — the
-            # eviction-pressure view, like the refcounts themselves)
-            "cache_bytes": self._block_bytes * sum(
-                len(b) for b in self.prefix_cache.values()),
+            # eviction-pressure view, like the refcounts themselves;
+            # the radix tree holds one ref per NODE, so its view is
+            # simply nodes * block_bytes)
+            "cache_bytes": self._block_bytes * (
+                self._radix.n_blocks if self._radix is not None
+                else sum(len(b) for b in self.prefix_cache.values())),
+            # host spill tier (0s while disarmed — the stats/lint
+            # surface is config-independent)
+            "spill_host_blocks": (len(self._spill)
+                                  if self._spill is not None else 0),
+            "spill_host_bytes": (self._spill.nbytes
+                                 if self._spill is not None else 0),
+            "spill_capacity_blocks": (self._spill.capacity
+                                      if self._spill is not None else 0),
+            "spill_dropped": (self._spill.dropped
+                              if self._spill is not None else 0),
             # static device footprint of the K+V pools (int8 pools
             # include their scale planes)
             "kv_pool_bytes": self._kv_pool_bytes,

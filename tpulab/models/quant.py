@@ -15,10 +15,11 @@ stay in the model dtype (their traffic is already small at decode).
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import NamedTuple, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 class QTensor(NamedTuple):
@@ -60,6 +61,36 @@ def unembed(x, embed):
     if isinstance(embed, QTensor):
         return (x @ embed.q.T.astype(x.dtype)) * embed.s.astype(x.dtype)
     return x @ embed.T
+
+
+def pack_int4(q: np.ndarray) -> Tuple[np.ndarray, bool]:
+    """Pack int8 values in [-8, 7] two-per-byte (low nibble first).
+
+    Host-side (numpy) — the KV spill tier's cold format.  Returns the
+    packed uint8 array over the flattened input plus whether a padding
+    nibble was appended (odd element count); ``unpack_int4`` inverts it
+    exactly for any in-range input.
+    """
+    flat = np.asarray(q, np.int8).reshape(-1)
+    if flat.size and (flat.min() < -8 or flat.max() > 7):
+        raise ValueError("pack_int4 input out of int4 range [-8, 7]")
+    odd = bool(flat.size % 2)
+    if odd:
+        flat = np.concatenate([flat, np.zeros(1, np.int8)])
+    u = (flat.astype(np.int16) & 0xF).astype(np.uint8)
+    return (u[0::2] | (u[1::2] << 4)).astype(np.uint8), odd
+
+
+def unpack_int4(packed: np.ndarray, odd: bool = False) -> np.ndarray:
+    """Inverse of ``pack_int4``: packed uint8 -> flat int8 in [-8, 7]."""
+    p = np.asarray(packed, np.uint8)
+    lo = (p & 0xF).astype(np.int8)
+    hi = ((p >> 4) & 0xF).astype(np.int8)
+    out = np.empty(p.size * 2, np.int8)
+    out[0::2] = lo
+    out[1::2] = hi
+    out = np.where(out > 7, out - 16, out).astype(np.int8)
+    return out[:-1] if odd else out
 
 
 def quantize_decode_params(params, cfg):

@@ -401,7 +401,7 @@ def test_brownout_token_cap_bounds_admission(trained):
     fleet = _mk_fleet(trained, 1)
     fleet.brownout = BrownoutLadder(engage_after=1, release_after=1,
                                     step_cooldown_s=0.0, token_cap=6)
-    key = (None, "gather", "native", 1, 0)
+    key = (None, "gather", "native", 1, 0, "")
     daemon_mod._FLEETS[key] = (None, fleet)
     try:
         for i in range(3):                   # climb to token_cap

@@ -625,7 +625,7 @@ def test_chaos_schedule_end_to_end(trained):
         final.pending.clear()
     # counters visible in the Prometheus scrape (the daemon's metrics
     # request over the warm engine)
-    key = (None, "gather", "native", 1, -11)
+    key = (None, "gather", "native", 1, -11, "")
     daemon_mod._ENGINES[key] = (None, final, None)
     try:
         text = handle_request({"lab": "metrics"}, b"").decode("utf-8")

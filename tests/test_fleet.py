@@ -537,7 +537,7 @@ def test_metrics_per_replica_breakdown(trained):
     svc.generate(fleet, _cycle_prompt(9), 3)
     t.join(timeout=60)
     _fleet_quiesce(fleet)
-    key = (None, "gather", "native", 1, -13)
+    key = (None, "gather", "native", 1, -13, "")
     daemon_mod._FLEETS[key] = (None, fleet)
     try:
         text = daemon_mod.handle_request(
@@ -590,7 +590,7 @@ def test_fleet_status_and_generate_stats_shape(trained):
         assert row["health"] == "healthy"
         assert not row["draining"] and not row["dead"]
     # generate_stats over a warm FLEET key: replica-summed stats + count
-    key = (None, "gather", "native", 1, -17)
+    key = (None, "gather", "native", 1, -17, "")
     daemon_mod._FLEETS[key] = (None, fleet)
     try:
         got = json.loads(daemon_mod.handle_request(

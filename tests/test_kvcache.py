@@ -26,8 +26,10 @@ Covers the round-18 ISSUE checklist:
     ``jnp.asarray`` tripwire, and records ZERO recompiles under
     ``strict()`` even after real spill/prefetch traffic warmed the
     transfer programs;
-  * constructor validation: spill requires the radix index, rejects
-    meshes, bounds, and dtype names.
+  * constructor validation: spill requires the radix index, bounds,
+    and dtype names.  (Round 19 certified the tier on mesh-sharded
+    pools — the spill-on-mesh arms live in tests/test_mesh_serving.py;
+    only the int4 host format still rejects there.)
 """
 
 import random

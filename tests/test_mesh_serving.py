@@ -1,0 +1,451 @@
+"""Mesh-sharded PagedEngine (round 19): tensor-parallel decode over the
+2D ``("batch", "model")`` serving mesh, on 8 forced virtual CPU devices.
+
+The tentpole's acceptance harness IS the standing contracts,
+re-certified on-mesh:
+
+  * greedy token streams bit-identical between ``serving_mesh(1, 1)``
+    and ``serving_mesh(2, 4)`` — and to the mesh=None engine and the
+    dense ``generate`` oracle — for plain, sampled, penalized,
+    speculative (prompt-lookup), and prefix-hit slots;
+  * the degenerate 1x1 mesh == current (meshless) behavior exactly;
+  * transfer-guard flat-h2d steady window and ``decode_steady_
+    recompiles == 0`` (strict mode) on the full 2x4 mesh;
+  * obs on/off stats bit-equality unchanged by sharding;
+  * the PR-13 spill tier CERTIFIED on sharded pools: d2h -> evict ->
+    prefetch -> restore round-trips bit-identical for native and int8
+    host payloads with the spill counters advancing, plus the armed-
+    tier flat-h2d/zero-recompile recert on-mesh;
+  * ``EngineConfigError`` arms for every still-uncertified combination
+    (pallas kernel, int4 host format, dense-draft proposer) and the
+    indivisible head/slot sharding rejections;
+  * the round-19 byte-accounting fix: ``kv_pool_device_bytes`` /
+    ``device_bytes_estimate()`` sum PHYSICAL per-shard bytes
+    (replicated leaves cost n_devices x logical; sharded leaves ~1x),
+    and the per-shard gauge mirror ``engine_*_shard<i>`` publishes.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import tpulab.models.paged as paged_mod
+from tpulab.models.generate import generate
+from tpulab.models.labformer import LabformerConfig
+from tpulab.models.paged import EngineConfigError, PagedEngine
+from tpulab.obs import compilestats as cstats
+from tpulab.parallel.mesh import serving_mesh
+
+CFG = LabformerConfig(d_model=32, n_heads=4, n_layers=2, d_ff=64, max_seq=128)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _isolated_compile_cache():
+    """Two kinds of process-global isolation for the mesh tier.
+
+    DISK: run this module with the PERSISTENT compile cache OFF.  This
+    module deliberately compiles the same engine programs both
+    single-device (``mesh=None`` comparison arms) and GSPMD-partitioned
+    (1x1 / 2x4), and the CPU AOT loader on this jaxlib cross-loads
+    those entries between PROCESSES: a warm cache dir from an earlier
+    run serves a single-device executable where a sharded compile
+    should happen (and vice versa), which surfaces as garbage token
+    streams on the degenerate 1x1 mesh, then heap corruption
+    (``free(): invalid pointer`` / segfaults in later cache
+    operations).  Namespacing the cache dir is NOT enough — the mix is
+    between this module's own entries across runs — so the module pays
+    fresh compiles every process and stays hermetic.  In-process
+    executable caches key correctly; only the disk round-trip is
+    poisoned.
+
+    MEMORY: drop this module's executables at teardown.  Every
+    8-virtual-device GSPMD executable holds JIT code mappings for the
+    process lifetime (the engine's programs are module-level jits, so
+    their executable caches are never collected), and the full tier-1
+    run already peaks near the kernel's vm.max_map_count=65530 — the
+    mesh tier's extra mappings pushed it OVER, segfaulting inside an
+    unrelated LLVM compile at ~96% of the suite.  ``jax.clear_caches``
+    releases the mappings.  This also guarantees the mesh tier leaves
+    no pre-warmed same-shape executables behind that would flip a
+    later engine STEADY before its full program set compiled (the
+    round-14 recompile-tripwire tests bracket exactly that).
+    """
+    from jax._src import compilation_cache as _cc
+
+    old = jax.config.jax_enable_compilation_cache
+    jax.config.update("jax_enable_compilation_cache", False)
+    _cc.reset_cache()
+    try:
+        yield
+    finally:
+        jax.clear_caches()
+        jax.config.update("jax_enable_compilation_cache", old)
+        _cc.reset_cache()
+
+
+@pytest.fixture(scope="module")
+def trained(trained_small, trained_small_cfg):
+    assert CFG == trained_small_cfg  # shared-model drift fails loudly
+    return trained_small
+
+
+@pytest.fixture(scope="module")
+def mesh24():
+    return serving_mesh(2, 4)
+
+
+def _cycle_prompt(p):
+    return (np.arange(p) % 7).astype(np.int32)
+
+
+def _spin_waves(eng, prompts, max_new=5, **per_req):
+    rids = {eng.submit(p, max_new=max_new,
+                       **{k: v[i] for k, v in per_req.items()}): i
+            for i, p in enumerate(prompts)}
+    res = eng.run()
+    return {i: res[r] for r, i in rids.items()}
+
+
+# ------------------------------------------------- stream bit-equality
+def _mixed_workload(eng):
+    """Two waves over one engine: plain greedy, sampled, penalized, and
+    prompt-lookup speculative slots in one batch, then a repeat of the
+    first wave's prompts so wave 2 rides prefix-cache hits.  Returns
+    ({wave: {slot: tokens}}, prefix_hits)."""
+    prompts = [_cycle_prompt(9), _cycle_prompt(17),
+               (np.arange(24) % 5).astype(np.int32), _cycle_prompt(12)]
+    waves = {}
+    for w in range(2):
+        rids = [
+            eng.submit(prompts[0], max_new=8),                    # plain
+            eng.submit(prompts[1], max_new=8, temperature=0.9,    # sampled
+                       seed=3),
+            eng.submit(prompts[2], max_new=10, spec="lookup",     # spec
+                       spec_k=4, spec_ngram=3),
+            eng.submit(prompts[3], max_new=8,                     # penalized
+                       repetition_penalty=1.3),
+        ]
+        res = eng.run()
+        waves[w] = [res[r].tolist() for r in rids]
+    return waves, eng.counters["prefix_hits"]
+
+
+def test_mesh24_streams_bit_identical(trained, mesh24):
+    """THE acceptance criterion: plain/sampled/penalized/spec/prefix-hit
+    streams bit-identical across mesh=None, the degenerate 1x1 mesh,
+    and the full 2x4 mesh — and the plain greedy stream matches the
+    dense ``generate`` oracle."""
+    results = {}
+    for name, mesh in (("none", None), ("1x1", serving_mesh(1, 1)),
+                       ("2x4", mesh24)):
+        eng = PagedEngine(trained, CFG, slots=4, n_blocks=32,
+                          block_size=8, max_seq=72, spec_k=4, mesh=mesh)
+        results[name] = _mixed_workload(eng)
+    assert results["none"] == results["1x1"], "1x1 drifted from meshless"
+    assert results["none"] == results["2x4"], "2x4 drifted from meshless"
+    waves, hits = results["2x4"]
+    assert hits >= 1, "wave 2 never hit the prefix cache"
+    want = generate(trained, _cycle_prompt(9)[None, :], CFG, steps=8,
+                    temperature=0.0)[0]
+    assert np.array_equal(np.asarray(waves[0][0]), want)
+
+
+def test_mesh24_spec_lookup_accepts(trained, mesh24):
+    """paged_verify is one of the sharded fixed-shape programs: the
+    lookup proposer must actually ACCEPT drafts on-mesh (a silent
+    fall-back to one-token ticks would pass bit-equality)."""
+    eng = PagedEngine(trained, CFG, slots=2, n_blocks=16, block_size=8,
+                      max_seq=72, spec_k=4, mesh=mesh24)
+    eng.submit((np.arange(24) % 5).astype(np.int32), max_new=10,
+               spec="lookup", spec_k=4, spec_ngram=3)
+    eng.run()
+    assert eng.counters["spec_accepted"] >= 1
+
+
+# ----------------------------------- standing contracts, re-certified
+class _NoUpload:
+    """jnp stand-in whose ``asarray`` (the engine's one host-upload
+    idiom) raises — same tripwire as tests/test_paged_overlap.py."""
+
+    def __getattr__(self, name):
+        return getattr(jnp, name)
+
+    def asarray(self, *a, **kw):  # noqa: D102 - tripwire
+        raise AssertionError("host->device upload in steady-state decode")
+
+
+def test_mesh_steady_window_flat_h2d(trained, mesh24, monkeypatch):
+    """Transfer-guard re-certification ON-MESH: a steady decode window
+    over sharded pools/params/state moves nothing host<->device — the
+    mesh placement all happens at init and admission, and GSPMD's
+    cross-shard collectives are device-side, invisible to the guard."""
+    eng = PagedEngine(trained, CFG, slots=2, n_blocks=32, block_size=8,
+                      max_seq=72, mesh=mesh24)
+    eng.submit(_cycle_prompt(4), max_new=30)
+    eng.submit(_cycle_prompt(6), max_new=30, temperature=1.5, seed=3)
+    for _ in range(4):    # admission + compile happen OUTSIDE the guard
+        eng.step()
+    before = eng.stats()
+    monkeypatch.setattr(paged_mod, "jnp", _NoUpload())
+    with jax.transfer_guard("disallow"):
+        for _ in range(8):
+            eng.step()
+    monkeypatch.undo()
+    st = eng.stats()
+    assert st["ticks"] == before["ticks"] + 8
+    assert st["h2d_ticks"] == before["h2d_ticks"], "steady tick uploaded"
+    assert st["host_syncs"] == before["host_syncs"], "steady tick synced"
+    want = generate(trained, _cycle_prompt(4)[None, :], CFG, steps=30,
+                    temperature=0.0)[0]
+    assert np.array_equal(eng.run()[0], want)
+
+
+def test_mesh_steady_window_zero_recompiles(trained, mesh24):
+    """``decode_steady_recompiles == 0`` ON-MESH under strict(): the
+    donated sharded state must round-trip through paged_tick with a
+    stable sharding — any output-sharding drift would re-specialize
+    the jit and trip here."""
+    eng = PagedEngine(trained, CFG, slots=2, n_blocks=32, block_size=8,
+                      max_seq=72, mesh=mesh24)
+    eng.submit(_cycle_prompt(4), max_new=24)
+    eng.submit(_cycle_prompt(6), max_new=24)
+    for _ in range(4):
+        eng.step()
+    assert eng._steady, "engine never reached the steady state"
+    r0 = eng.counters["recompiles"]
+    with cstats.strict():
+        for _ in range(12):
+            eng.step()
+    assert eng.counters["recompiles"] == r0 == 0
+    eng.run()
+
+
+def test_mesh_obs_on_off_bit_equality(trained, mesh24):
+    """The obs on/off contract is orthogonal to sharding: identical
+    streams and identical DETERMINISTIC stats either way on-mesh."""
+    outs = {}
+    for obs_on in (False, True):
+        eng = PagedEngine(trained, CFG, slots=2, n_blocks=16,
+                          block_size=8, max_seq=72, mesh=mesh24,
+                          obs=obs_on)
+        outs[obs_on] = (_spin_waves(eng, [_cycle_prompt(9),
+                                          _cycle_prompt(12)]),
+                        eng.stats())
+    got_off, got_on = outs[False], outs[True]
+    for i in got_off[0]:
+        assert np.array_equal(got_off[0][i], got_on[0][i]), i
+    assert got_off[1] == got_on[1]
+
+
+# ------------------------------------------- spill tier, mesh-certified
+@pytest.mark.parametrize("kv_dtype", ["native", "int8"])
+@pytest.mark.parametrize("spill_dtype", ["native", "int8"])
+def test_spill_on_mesh_roundtrip_bit_equality(trained, mesh24, kv_dtype,
+                                              spill_dtype):
+    """The full tier cycle ON SHARDED POOLS: filler pressure evicts A's
+    prefix d2h (``_spill_read`` gathers the sharded block to host),
+    resubmitting A prefetches + restores it (``_spill_restore``
+    re-places into the pool sharding), and every stream is
+    bit-identical to the spill-disabled MESH reference.  int8 host
+    payloads stay lossless here because the pool representation is
+    spilled verbatim (native) or requantized from already-int8 pools."""
+    if kv_dtype == "native" and spill_dtype == "int8":
+        pytest.skip("lossy: int8 host format over f32 pools certifies "
+                    "end-to-end serving, not bit-equality (covered by "
+                    "the counters arm below)")
+
+    def mk(spill):
+        kw = {"kv_dtype": kv_dtype} if kv_dtype != "native" else {}
+        if spill:
+            kw.update(prefix_index="radix", spill_blocks=16,
+                      spill_dtype=spill_dtype)
+        return PagedEngine(trained, CFG, slots=2, n_blocks=8,
+                           block_size=8, max_seq=72, mesh=mesh24, **kw)
+
+    a = _cycle_prompt(17)                     # 2 full blocks of prefix
+    fillers = [(np.arange(i, i + 17) % 11).astype(np.int32)
+               for i in (1, 2, 3)]            # distinct working sets
+    outs = {}
+    for spill in (False, True):
+        eng = mk(spill)
+        outs[spill] = [_spin_waves(eng, [a])]
+        for f in fillers:                     # tiny pool churns
+            outs[spill].append(_spin_waves(eng, [f]))
+        outs[spill].append(_spin_waves(eng, [a]))   # back for A
+        if spill:
+            assert eng.counters["spill_spilled"] >= 1
+            assert eng.counters["spill_prefetched"] >= 1
+            assert eng.counters["spill_hits"] >= 1
+    for w, (ref, run) in enumerate(zip(outs[False], outs[True])):
+        for i in ref:
+            assert np.array_equal(ref[i], run[i]), (w, i)
+
+
+def test_spill_on_mesh_int8_host_format_serves(trained, mesh24):
+    """The lossy arm: int8 HOST payloads over f32 sharded pools must
+    serve end-to-end with the counters advancing (bit-equality is not
+    the contract there — requantization error is documented)."""
+    eng = PagedEngine(trained, CFG, slots=2, n_blocks=8, block_size=8,
+                      max_seq=72, mesh=mesh24, prefix_index="radix",
+                      spill_blocks=16, spill_dtype="int8")
+    a = _cycle_prompt(17)
+    _spin_waves(eng, [a])
+    for f in [(np.arange(i, i + 17) % 11).astype(np.int32)
+              for i in (1, 2, 3)]:
+        _spin_waves(eng, [f])
+    got = _spin_waves(eng, [a])
+    assert eng.counters["spill_spilled"] >= 1
+    assert eng.counters["spill_prefetched"] >= 1
+    assert len(got[0]) == 5
+
+
+def test_spill_armed_on_mesh_steady_contracts(trained, mesh24,
+                                              monkeypatch):
+    """Flat-h2d AND zero-recompile recert with the tier ARMED on-mesh,
+    after REAL spill + prefetch traffic (the transfer programs have
+    run against sharded pools, not merely warm-compiled at init)."""
+    eng = PagedEngine(trained, CFG, slots=2, n_blocks=8, block_size=8,
+                      max_seq=72, mesh=mesh24, prefix_index="radix",
+                      spill_blocks=16)
+    a = _cycle_prompt(17)
+    _spin_waves(eng, [a])
+    for f in [(np.arange(i, i + 17) % 11).astype(np.int32)
+              for i in (1, 2, 3)]:
+        _spin_waves(eng, [f])                 # churn: spill A out
+    assert eng.counters["spill_spilled"] >= 1
+    eng.submit(a, max_new=24)                 # prefetch A back in
+    for _ in range(4):
+        eng.step()
+    assert eng.counters["spill_prefetched"] >= 1
+    assert eng._steady, "engine never reached the steady state"
+    before = eng.stats()
+    monkeypatch.setattr(paged_mod, "jnp", _NoUpload())
+    with jax.transfer_guard("disallow"), cstats.strict():
+        for _ in range(8):
+            eng.step()
+    monkeypatch.undo()
+    st = eng.stats()
+    assert st["h2d_ticks"] == before["h2d_ticks"], "steady tick uploaded"
+    assert st["recompiles"] == before["recompiles"] == 0
+    eng.run()
+
+
+# ------------------------------------------------ config-error arms
+def test_engine_config_error_arms(trained, mesh24):
+    """Every still-uncertified combination refuses LOUDLY with
+    ``EngineConfigError`` (a ValueError subclass — pre-round-19
+    ``except ValueError`` callers keep working), never a silent
+    fallback."""
+    assert issubclass(EngineConfigError, ValueError)
+    with pytest.raises(EngineConfigError, match="pallas"):
+        PagedEngine(trained, CFG, slots=2, n_blocks=8, block_size=8,
+                    max_seq=72, mesh=mesh24, attn="pallas")
+    with pytest.raises(EngineConfigError, match="int4"):
+        PagedEngine(trained, CFG, slots=2, n_blocks=8, block_size=8,
+                    max_seq=72, mesh=mesh24, prefix_index="radix",
+                    spill_blocks=8, spill_dtype="int4")
+    # slots must split evenly over the batch axis (batch=2 here)
+    with pytest.raises(EngineConfigError, match="slots"):
+        PagedEngine(trained, CFG, slots=3, n_blocks=8, block_size=8,
+                    max_seq=72, mesh=mesh24)
+    # the model axis must divide the kv heads
+    cfg1 = LabformerConfig(d_model=32, n_heads=4, n_kv_heads=1,
+                           n_layers=2, d_ff=64, max_seq=128)
+    with pytest.raises(EngineConfigError, match="must divide kv_heads=1"):
+        PagedEngine(trained, cfg1, mesh=mesh24)
+    # the dense-draft proposer has no certified sharding yet
+    eng = PagedEngine(trained, CFG, slots=2, n_blocks=8, block_size=8,
+                      max_seq=72, spec_k=4, mesh=mesh24)
+    with pytest.raises(EngineConfigError, match="draft"):
+        eng.set_draft(trained, CFG)
+
+
+def test_daemon_mesh_knob_validation():
+    """--mesh parses/canonicalizes at the argparse boundary: bad specs
+    and the uncertified int4-spill combo exit 2 before any build."""
+    from tpulab.daemon import main
+
+    for argv in (["--mesh", "nope"], ["--mesh", "2x"],
+                 ["--mesh", "0x4"],
+                 ["--mesh", "2x4", "--prefix-index", "radix",
+                  "--spill-blocks", "8", "--spill-dtype", "int4"]):
+        with pytest.raises(SystemExit) as e:
+            main(argv)
+        assert e.value.code == 2, argv
+
+
+# --------------------------------- shard byte accounting + gauges
+def test_shard_byte_accounting(trained, mesh24):
+    """The round-19 bytes bugfix, asserted structurally: pools shard
+    on model (4-way) and replicate across batch (2-way), so physical
+    pool bytes are exactly 2x logical; per-shard is the even 1/8th;
+    params replicate everywhere, so the physical estimate strictly
+    exceeds pools + one logical param copy."""
+    eng = PagedEngine(trained, CFG, slots=2, n_blocks=8, block_size=8,
+                      max_seq=72, mesh=mesh24)
+    st = eng.stats()
+    assert st["mesh_devices"] == 8
+    assert st["kv_pool_device_bytes"] == 2 * st["kv_pool_bytes"]
+    assert (st["kv_pool_bytes_per_shard"]
+            == st["kv_pool_device_bytes"] // 8)
+    param_logical = sum(
+        int(x.nbytes) for x in jax.tree_util.tree_leaves(trained))
+    est = eng.device_bytes_estimate()
+    # matmul params shard 4-way on model but REPLICATE 2-way across
+    # batch (norms replicate 8-way): physical param bytes are at least
+    # 2x logical, which the logical-bytes accounting this test guards
+    # against would have missed entirely
+    assert est >= st["kv_pool_device_bytes"] + 2 * param_logical
+    ss = eng.shard_stats()
+    assert set(ss) == set(range(8))
+    assert sum(s["kv_pool_bytes"] for s in ss.values()) \
+        == st["kv_pool_device_bytes"]
+    assert sum(s["hbm_bytes_in_use"] for s in ss.values()) == est
+    # off-mesh: the same surface collapses to one shard == the totals
+    eng0 = PagedEngine(trained, CFG, slots=2, n_blocks=8, block_size=8,
+                       max_seq=72)
+    st0 = eng0.stats()
+    assert st0["mesh_devices"] == 1
+    assert st0["kv_pool_device_bytes"] == st0["kv_pool_bytes"]
+    ss0 = eng0.shard_stats()
+    assert set(ss0) == {0}
+    assert ss0[0]["hbm_bytes_in_use"] == eng0.device_bytes_estimate()
+
+
+def test_per_shard_gauges_publish(trained, mesh24):
+    """publish_metrics mirrors the per-shard breakdown into the
+    registry: one ``engine_hbm_bytes_in_use_shard<i>`` and
+    ``engine_kv_pool_bytes_shard<i>`` gauge per mesh device, values
+    matching shard_stats()."""
+    from tpulab import obs
+
+    eng = PagedEngine(trained, CFG, slots=2, n_blocks=8, block_size=8,
+                      max_seq=72, mesh=mesh24)
+    eng.submit(_cycle_prompt(9), max_new=3)
+    eng.run()
+    eng.publish_metrics()
+    ss = eng.shard_stats()
+    for i in range(8):
+        g = obs.REGISTRY.get(f"engine_hbm_bytes_in_use_shard{i}")
+        assert g is not None and g.value == ss[i]["hbm_bytes_in_use"], i
+        g = obs.REGISTRY.get(f"engine_kv_pool_bytes_shard{i}")
+        assert g is not None and g.value == ss[i]["kv_pool_bytes"], i
+
+
+def test_stale_suffix_sweep_spares_base_gauges():
+    """The daemon's stale-breakdown zeroing matches only NUMBERED
+    ``_replica<i>``/``_shard<i>`` suffixes — a bare substring test
+    zeroed ``engine_kv_pool_bytes_per_shard`` (the process-wide sum
+    whose own name ends in ``_shard``) right after publishing it, so
+    every daemon scrape reported 0 for it next to correct _shard<i>
+    mirrors."""
+    from tpulab.daemon import _STALE_SUFFIX_RE as sweep
+
+    assert sweep.search("engine_kv_pool_bytes_shard3")
+    assert sweep.search("engine_hbm_bytes_in_use_shard0")
+    assert sweep.search("engine_ticks_replica12")
+    assert sweep.search("engine_kv_pool_bytes_per_shard_replica0")
+    assert not sweep.search("engine_kv_pool_bytes_per_shard")
+    assert not sweep.search("engine_mesh_devices")
+    assert not sweep.search("engine_shard_xxx")

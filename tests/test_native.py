@@ -572,15 +572,20 @@ class TestDaemonGenerate:
 
     def test_generate_tp_rejected_cleanly(self, daemon):
         """tp config errors come back as error frames BEFORE any engine
-        build: tp < 1, tp > device count, and mesh-incompatible knobs."""
+        build: tp < 1, tp > device count, and mesh-incompatible knobs.
+        (int8 KV and prompt_lookup are mesh-certified as of round 19
+        and no longer reject; the dense-draft ``speculative`` path and
+        host-orchestrated beams still do, as does naming both mesh
+        grammars at once.)"""
         for cfg_d, msg in (
             ({"tp": 0}, b"tp must be >= 1"),
             ({"tp": 4096}, b"devices"),
+            ({"mesh": "64x64"}, b"devices"),
             ({"tp": 2, "attn": "pallas"}, b"mesh serving"),
-            ({"tp": 2, "kv_dtype": "int8"}, b"mesh serving"),
+            ({"tp": 2, "mesh": "1x2"}, b"both"),
+            ({"mesh": "nope"}, b"mesh"),
             ({"tp": 2, "beams": 2}, b"engine decode path"),
-            ({"tp": 2, "speculative": True}, b"engine decode path"),
-            ({"tp": 2, "prompt_lookup": True}, b"engine decode path"),
+            ({"tp": 2, "speculative": True}, b"uncertified on mesh serving"),
         ):
             import json as _json
 

@@ -379,7 +379,7 @@ def test_daemon_metrics_and_trace_dump(trained):
                       max_seq=64)
     out = svc.generate(eng, _cycle_prompt(4), 8)
     assert len(out) == 8
-    key = (None, "gather", "native", 1, 0)
+    key = (None, "gather", "native", 1, 0, "")
     daemon._ENGINES[key] = (None, eng, None)
     try:
         text = handle_request({"lab": "metrics"}, b"").decode("utf-8")
@@ -411,7 +411,7 @@ def test_daemon_metrics_aggregates_across_engines(trained):
         eng.submit(_cycle_prompt(4), max_new=2 + i)
         eng.run()
         engines.append(eng)
-    keys = [(None, "gather", "native", 1, i) for i in range(2)]
+    keys = [(None, "gather", "native", 1, i, "") for i in range(2)]
     for key, eng in zip(keys, engines):
         daemon._ENGINES[key] = (None, eng, None)
     try:

@@ -50,6 +50,90 @@ def test_make_mesh_shapes():
     assert m1.shape["x"] == 8
 
 
+class TestServingMeshHelpers:
+    """Round-19 engine-facing mesh helpers (tpulab/parallel/mesh.py):
+    the 2D ("batch", "model") serving layout, the "AxB" spec grammar,
+    and the axis resolvers that keep the legacy 1D tp mesh working
+    through the same engine code path."""
+
+    def test_parse_mesh_spec(self):
+        from tpulab.parallel import parse_mesh_spec
+
+        assert parse_mesh_spec("2x4") == (2, 4)
+        assert parse_mesh_spec("1x1") == (1, 1)
+        assert parse_mesh_spec("8X1") == (8, 1)  # case-insensitive
+
+    @pytest.mark.parametrize("bad", ["", "8", "2x", "x4", "2x4x2",
+                                     "axb", "2.5x4", "0x4", "2x-1"])
+    def test_parse_mesh_spec_rejects(self, bad):
+        from tpulab.parallel import parse_mesh_spec
+
+        with pytest.raises(ValueError):
+            parse_mesh_spec(bad)
+
+    def test_serving_mesh_axes(self):
+        from tpulab.parallel import serving_mesh
+
+        m = serving_mesh(2, 4)
+        assert m.axis_names == ("batch", "model")
+        assert m.shape == {"batch": 2, "model": 4}
+        assert serving_mesh(1, 1).shape == {"batch": 1, "model": 1}
+        with pytest.raises(ValueError):
+            serving_mesh(0, 4)
+
+    def test_axis_resolvers(self):
+        from tpulab.parallel import serving_mesh
+        from tpulab.parallel.mesh import axis_size, batch_axis, model_axis
+
+        sm = serving_mesh(2, 4)
+        tp = make_mesh({"tp": 4})
+        plain = make_mesh({"x": 8})
+        assert model_axis(sm) == "model" and batch_axis(sm) == "batch"
+        assert model_axis(tp) == "tp" and batch_axis(tp) is None
+        assert model_axis(plain) is None and batch_axis(plain) is None
+        assert model_axis(None) is None and batch_axis(None) is None
+        assert axis_size(sm, "model") == 4
+        assert axis_size(sm, "batch") == 2
+        assert axis_size(tp, None) == 1
+        assert axis_size(None, "model") == 1
+
+    def test_specs(self):
+        from jax.sharding import PartitionSpec as P
+
+        from tpulab.parallel import serving_mesh
+        from tpulab.parallel.mesh import (pool_scale_spec, pool_spec,
+                                          slot_spec)
+
+        sm = serving_mesh(2, 4)
+        tp = make_mesh({"tp": 4})
+        assert pool_spec(sm) == P(None, None, None, "model", None)
+        assert pool_spec(tp) == P(None, None, None, "tp", None)
+        assert pool_scale_spec(sm) == P(None, None, None, "model")
+        assert slot_spec(sm, 1) == P("batch")
+        assert slot_spec(sm, 2) == P("batch", None)
+        # legacy tp mesh has no batch axis: state stays replicated
+        assert slot_spec(tp, 2) == P(None, None)
+
+    def test_serving_param_spec_translation(self):
+        from jax.sharding import PartitionSpec as P
+
+        from tpulab.parallel import serving_mesh
+        from tpulab.parallel.mesh import serving_param_spec
+
+        sm = serving_mesh(2, 4)
+        # training spec ("pp", None, "tp"): pp drops (absent), tp
+        # renames to model — params never shard on batch
+        assert (serving_param_spec(P("pp", None, "tp"), sm)
+                == P(None, None, "model"))
+        assert serving_param_spec(P(None, "tp"), sm) == P(None, "model")
+        # legacy tp mesh: rename is a no-op, pp still drops
+        tp = make_mesh({"tp": 4})
+        assert (serving_param_spec(P("pp", "tp", None), tp)
+                == P(None, "tp", None))
+        # replicated entries stay replicated
+        assert serving_param_spec(P(None, None), sm) == P(None, None)
+
+
 class TestDistributedReduce:
     @pytest.mark.parametrize("op", ["sum", "min", "max", "prod"])
     def test_int_ops_match_numpy(self, mesh8, op, rng):

@@ -412,6 +412,81 @@ def bench_paged_tick(
     }
 
 
+def bench_mesh_tick_overhead(
+    slots: int = 4, steps: int = 48, reps: int = 3
+) -> Dict[str, Any]:
+    """Mesh-sharded decode tick rate: steady-state ticks/s on the full
+    2D serving mesh vs the degenerate ``serving_mesh(1, 1)`` reference
+    — the round-19 A/B.  On the CPU proxy (8 forced host devices) this
+    measures GSPMD partitioning OVERHEAD, not speedup: virtual devices
+    share one physical socket, so sharded dispatch costs cross-"chip"
+    copies with zero extra FLOP throughput to pay for them.  On a real
+    slice the same A/B is the tensor-parallel scaling probe.  The
+    steady window keeps the standing contracts — flat ``h2d_ticks``
+    and zero recompiles — so the number is an engine-decode figure,
+    never an admission artifact."""
+    import time
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpulab.models.labformer import LabformerConfig, init_params
+    from tpulab.models.paged import PagedEngine
+    from tpulab.parallel.mesh import serving_mesh
+    from tpulab.runtime.device import default_device
+
+    n_dev = len(jax.devices())
+    # widest (batch, model) the attached devices allow, capped at the
+    # certified (2, 4): heads=4 bounds the model axis, slots the batch
+    b, m = (2, 4) if n_dev >= 8 else ((1, 2) if n_dev >= 2 else (1, 1))
+    cfg = LabformerConfig(d_model=64, n_heads=4, n_layers=2, d_ff=128,
+                          max_seq=256, dtype=jnp.float32)
+    params = init_params(cfg, seed=0)  # host numpy: commit() places it
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, cfg.vocab, (8,)).astype(np.int32)
+               for _ in range(slots)]
+    warm = 6
+
+    def window(mesh):
+        eng = PagedEngine(params, cfg, slots=slots, n_blocks=64,
+                          block_size=16, max_seq=256, mesh=mesh)
+        for p in prompts:
+            eng.submit(p, max_new=warm + steps + 4)
+        for _ in range(warm):
+            eng.step()
+        h2d0 = eng.counters["h2d_ticks"]
+        rc0 = eng.counters["recompiles"]
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            eng.step()
+        dt = time.perf_counter() - t0
+        assert eng.counters["h2d_ticks"] == h2d0, "steady tick uploaded"
+        assert eng.counters["recompiles"] == rc0, "steady tick recompiled"
+        return dt
+
+    meshes = {"mesh": serving_mesh(b, m), "ref": serving_mesh(1, 1)}
+    for mk in meshes.values():
+        window(mk)  # compile outside the timed windows
+    times = {"mesh": [], "ref": []}
+    for _ in range(max(reps, 3)):
+        for name, mk in meshes.items():
+            times[name].append(window(mk))
+    t_mesh = float(np.median(times["mesh"]))
+    t_ref = float(np.median(times["ref"]))
+    return {
+        "metric": f"mesh_tick_{b * m}dev_ticks_per_s",
+        "value": round(steps / t_mesh, 1),
+        "unit": "ticks/s",
+        "vs_baseline": None,
+        "mesh": f"{b}x{m}",
+        "ref_1x1_ticks_per_s": round(steps / t_ref, 1),
+        "mesh_over_1x1": round(t_ref / t_mesh, 3),
+        "device": default_device().platform,
+        "n_devices": n_dev,
+        **variance_fields([t * 1e3 for t in times["mesh"]]),
+    }
+
+
 def bench_prefill_interleave(
     slots: int = 4, reps: int = 5
 ) -> Dict[str, Any]:
@@ -1461,6 +1536,7 @@ def run_benchmarks(only: Optional[str] = None, yield_markers: bool = False,
         "speculative_decode": bench_speculative_decode,
         "paged_engine": bench_paged_engine,
         "paged_tick_overhead": bench_paged_tick,
+        "mesh_tick_overhead": bench_mesh_tick_overhead,
         "prefill_interleave": bench_prefill_interleave,
         "obs_overhead": bench_obs_overhead,
         "obs_history_overhead": bench_obs_history_overhead,

@@ -98,6 +98,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import signal
 import socket
 import struct
@@ -224,6 +225,14 @@ SPILL_BLOCKS = int(os.environ.get("TPULAB_DAEMON_SPILL_BLOCKS", "0"))
 #: streams vs a spill-disabled reference); "int8"/"int4" shrink the
 #: host footprint at the cost of requantization error on restore.
 SPILL_DTYPE = os.environ.get("TPULAB_DAEMON_SPILL_DTYPE", "native")
+
+#: serving mesh spec "AxB" (batch x model axis sizes; "" = no mesh —
+#: single-device engines).  The round-19 2D mesh: KV pools + attention
+#: heads shard on the model axis, the donated per-slot decode state on
+#: the batch axis.  Daemon-wide default via ``--mesh`` / this env;
+#: per-request override via config {"mesh": "AxB"}.  Mutually
+#: exclusive with the legacy per-request {"tp": N} knob.
+MESH_SPEC = os.environ.get("TPULAB_DAEMON_MESH", "")
 
 #: bounded admission: each serving engine's pending queue caps here and
 #: submit-past-the-bound sheds with retry-after instead of growing an
@@ -542,7 +551,7 @@ def _resume_lookup(rid: str):
         return _RESUME.get(rid)
 
 
-#: (realpath|None, attn, kv_dtype, tp, prefill_chunk) ->
+#: (realpath|None, attn, kv_dtype, tp, prefill_chunk, mesh_spec) ->
 #: (loaded_step, engine, tok); LRU, max 4
 _ENGINES: "dict" = {}
 
@@ -2185,7 +2194,8 @@ class _FleetService:
 
 _FLEET_SERVICE = _FleetService()
 
-#: (realpath|None, attn, kv_dtype, tp, prefill_chunk) -> (stamp, fleet);
+#: (realpath|None, attn, kv_dtype, tp, prefill_chunk, mesh_spec)
+#: -> (stamp, fleet);
 #: LRU, max 4 — the fleet-era sibling of _ENGINES (which stays for the
 #: legacy direct-engine surfaces and tests)
 _FLEETS: "dict" = {}
@@ -2208,13 +2218,14 @@ def _ckpt_stamp(ckpt_dir: str):
 
 
 def _engine_for(ckpt, attn: str = "gather", kv_dtype: str = "native",
-                tp: int = 1, prefill_chunk: Optional[int] = None):
+                tp: int = 1, prefill_chunk: Optional[int] = None,
+                mesh_spec: str = ""):
     """Warm (engine, tokenizer|None) for the demo model or a trainer
     snapshot, with the cache problems a naive dict would have handled:
-    keys are (realpath, attn, kv_dtype, tp, prefill_chunk) — ``ckpts``
-    and ``./ckpts`` alias, and engines built with different serving
-    knobs (paged kernel, int8 KV, tp mesh, prefill window) never
-    collide — a newer checkpoint step
+    keys are (realpath, attn, kv_dtype, tp, prefill_chunk, mesh_spec)
+    — ``ckpts`` and ``./ckpts`` alias, and engines built with
+    different serving knobs (paged kernel, int8 KV, tp or 2D serving
+    mesh, prefill window) never collide — a newer checkpoint step
     evicts the stale engine, and at most 4 engines stay resident (LRU;
     room for one checkpoint's knob variants plus a second checkpoint).
 
@@ -2231,14 +2242,15 @@ def _engine_for(ckpt, attn: str = "gather", kv_dtype: str = "native",
     if prefill_chunk is None:
         prefill_chunk = PREFILL_CHUNK
     path = os.path.realpath(ckpt) if ckpt else None
-    key = (path, attn, kv_dtype, tp, prefill_chunk)
+    key = (path, attn, kv_dtype, tp, prefill_chunk, mesh_spec)
     stamp = _ckpt_stamp(path) if path else None
     with _GEN_SERVICE.lock:
         hit = _ENGINES.get(key)
         if hit is not None and hit[0] == stamp:
             _ENGINES[key] = _ENGINES.pop(key)  # LRU freshen
             return hit[1], hit[2]
-    engine, tok = _build_engine(path, attn, kv_dtype, tp, prefill_chunk)
+    engine, tok = _build_engine(path, attn, kv_dtype, tp, prefill_chunk,
+                                mesh_spec)
     with _GEN_SERVICE.lock:
         hit = _ENGINES.get(key)
         if hit is not None and hit[0] == stamp:
@@ -2254,7 +2266,7 @@ def _engine_for(ckpt, attn: str = "gather", kv_dtype: str = "native",
 
 
 def _build_engine(path, attn: str, kv_dtype: str, tp: int,
-                  prefill_chunk: int):
+                  prefill_chunk: int, mesh_spec: str = ""):
     """Cold-build one serving engine from its recipe (checkpoint
     realpath + serving knobs) — the body ``_engine_for`` runs on a
     cache miss, factored out so the SUPERVISOR can rebuild a
@@ -2274,7 +2286,13 @@ def _build_engine(path, attn: str, kv_dtype: str, tp: int,
 
         params, cfg = merge_lora(params, cfg)
     mesh = None
-    if tp > 1:
+    if mesh_spec:
+        from tpulab.parallel.mesh import parse_mesh_spec, serving_mesh
+
+        b, m = parse_mesh_spec(mesh_spec)
+        if b * m > 1:  # "1x1" means single-device: no mesh machinery
+            mesh = serving_mesh(b, m)
+    elif tp > 1:
         from tpulab.parallel import make_mesh
 
         mesh = make_mesh({"tp": tp})
@@ -2287,29 +2305,33 @@ def _build_engine(path, attn: str, kv_dtype: str, tp: int,
         prefill_chunk=prefill_chunk,
         # spec capability costs nothing until a speculative request
         # arrives (the verify program compiles lazily); the gather-only
-        # constraint is the engine's own (no pallas verify kernel, tp
-        # uncertified)
-        spec_k=_SPEC_K if (attn == "gather" and mesh is None) else 0,
+        # constraint is the engine's own (no pallas verify kernel) —
+        # round 19 certified paged_verify on the mesh, so sharded
+        # engines keep the capability too
+        spec_k=_SPEC_K if attn == "gather" else 0,
         # bounded admission queue: backpressure (shed-with-retry-after)
         # instead of unbounded pending growth
         max_pending=MAX_PENDING,
         # hierarchical cache policy (daemon-wide, --prefix-index /
         # --spill-blocks / --spill-dtype): radix partial-hit index and
-        # the host-RAM spill tier; mesh engines stay on the dict (the
-        # engine rejects spill on sharded pools)
-        prefix_index=PREFIX_INDEX if mesh is None else "dict",
-        spill_blocks=SPILL_BLOCKS if mesh is None else 0,
+        # the host-RAM spill tier — certified on sharded pools in
+        # round 19, so mesh engines get the same policy (the engine
+        # itself still rejects the uncertified int4 host format there)
+        prefix_index=PREFIX_INDEX,
+        spill_blocks=SPILL_BLOCKS,
         spill_dtype=SPILL_DTYPE,
     )
-    engine._build_key = (path, attn, kv_dtype, tp, prefill_chunk)
+    engine._build_key = (path, attn, kv_dtype, tp, prefill_chunk,
+                         mesh_spec)
     engine._build_stamp = _ckpt_stamp(path) if path else None
     engine._rebuild = (lambda: _build_engine(path, attn, kv_dtype, tp,
-                                             prefill_chunk))
+                                             prefill_chunk, mesh_spec))
     return engine, tok
 
 
 def _fleet_for(ckpt, attn: str = "gather", kv_dtype: str = "native",
-               tp: int = 1, prefill_chunk: Optional[int] = None) -> _Fleet:
+               tp: int = 1, prefill_chunk: Optional[int] = None,
+               mesh_spec: str = "") -> _Fleet:
     """Warm :class:`_Fleet` (``REPLICAS`` engines + tokenizer) for a
     serving config — the fleet-era ``_engine_for``: same cache keying
     (realpath + serving knobs), same stamp-based checkpoint staleness
@@ -2319,7 +2341,7 @@ def _fleet_for(ckpt, attn: str = "gather", kv_dtype: str = "native",
     if prefill_chunk is None:
         prefill_chunk = PREFILL_CHUNK
     path = os.path.realpath(ckpt) if ckpt else None
-    key = (path, attn, kv_dtype, tp, prefill_chunk)
+    key = (path, attn, kv_dtype, tp, prefill_chunk, mesh_spec)
     stamp = _ckpt_stamp(path) if path else None
     with _FLEET_SERVICE.lock:
         hit = _FLEETS.get(key)
@@ -2327,7 +2349,7 @@ def _fleet_for(ckpt, attn: str = "gather", kv_dtype: str = "native",
             _FLEETS[key] = _FLEETS.pop(key)  # LRU freshen
             return hit[1]
     builder = (lambda: _build_engine(path, attn, kv_dtype, tp,
-                                     prefill_chunk))
+                                     prefill_chunk, mesh_spec))
     fleet = _make_fleet(builder, REPLICAS, key=key, stamp=stamp)
     with _FLEET_SERVICE.lock:
         hit = _FLEETS.get(key)
@@ -2417,6 +2439,23 @@ def _handle_generate(header: dict, payload: bytes,
     tp = int(config.get("tp", 1))
     if tp < 1:
         raise ValueError(f"tp must be >= 1, got {tp}")
+    # 2D serving mesh "AxB" (batch x model; round 19) — per-request
+    # override of the daemon-wide --mesh default.  Validated HERE so a
+    # typo never pays a cold engine build; mutually exclusive with the
+    # legacy 1D tp knob (two ways to say "shard the model" on one
+    # request is a contradiction, not a merge).
+    mesh_spec = str(config.get("mesh", MESH_SPEC) or "")
+    if mesh_spec:
+        from tpulab.parallel.mesh import parse_mesh_spec
+
+        mesh_b, mesh_m = parse_mesh_spec(mesh_spec)
+        if tp > 1:
+            raise ValueError(
+                "config sets both mesh and tp > 1: the 2D mesh's "
+                "model axis IS the tp role — drop one")
+        mesh_spec = f"{mesh_b}x{mesh_m}"  # canonical cache key ("02x4" etc.)
+        if mesh_b * mesh_m == 1:
+            mesh_spec = ""  # 1x1 == single-device serving
     # deadline/priority: the fault-tolerance protocol fields.
     # ``deadline_ms`` opts the request into queue-wait-based load
     # shedding (a reject-with-retry-after error frame, body prefix
@@ -2451,18 +2490,19 @@ def _handle_generate(header: dict, payload: bytes,
         raise ValueError(
             f"prefill_chunk must be >= 0 (0 = whole-prompt dense "
             f"oracle path), got {prefill_chunk}")
-    if tp > 1:
+    if tp > 1 or mesh_spec:
         # mirror the engine's own mesh-serving constraints BEFORE the
-        # cold build (checkpoint restore) is paid
+        # cold build (checkpoint restore) is paid.  int8 KV pools are
+        # mesh-certified as of round 19 (the scale plane shards with
+        # its data plane), so only the pallas kernel stays refused.
         if attn == "pallas":
             raise ValueError("attn='pallas' does not support mesh serving")
-        if kv_dtype == "int8":
-            raise ValueError("kv_dtype='int8' does not support mesh serving")
         import jax
 
-        if len(jax.devices()) < tp:
+        need = tp if tp > 1 else mesh_b * mesh_m
+        if len(jax.devices()) < need:
             raise ValueError(
-                f"tp={tp} needs {tp} devices; this daemon has "
+                f"mesh serving needs {need} devices; this daemon has "
                 f"{len(jax.devices())}")
     beams = int(config.get("beams", 0))
     deterministic_combo = (
@@ -2521,18 +2561,24 @@ def _handle_generate(header: dict, payload: bytes,
                     f"lookup_ngram must be >= 1, got {spec_ngram}")
         else:
             spec_mode = "draft"
-    if tp > 1 and (beams or bool(config.get("speculative"))
-                   or bool(config.get("prompt_lookup"))):
-        # the host-orchestrated strategies bypass the mesh engine's
-        # decode path (beam_search/speculative run their own loops on
-        # engine.params) — a tp engine build would be paid for nothing
-        # and the tp bit-equality contract is certified for the engine
-        # decode only
+    if (tp > 1 or mesh_spec) and beams:
+        # beam search is host-orchestrated (its loop runs on
+        # engine.params, bypassing the engine decode path the mesh
+        # bit-equality contract certifies) — a mesh engine build would
+        # be paid for nothing
         raise ValueError(
-            "tp > 1 serves the engine decode path only: drop "
-            "beams/speculative/prompt_lookup or tp")
+            "mesh serving covers the engine decode path only: drop "
+            "beams or the mesh/tp knob")
+    if (tp > 1 or mesh_spec) and bool(config.get("speculative")):
+        # prompt_lookup speculation IS mesh-certified (paged_verify is
+        # one of the sharded fixed-shape programs); the dense-draft
+        # proposer behind ``speculative`` is not — its per-slot dense
+        # caches have no certified sharding yet
+        raise ValueError(
+            "speculative (dense-draft) decoding is uncertified on "
+            "mesh serving: use prompt_lookup or drop the mesh/tp knob")
     fleet = _fleet_for(config.get("ckpt_dir"), attn, kv_dtype, tp,
-                       prefill_chunk)
+                       prefill_chunk, mesh_spec)
     # brownout ladder (round 17): degrade NEW admissions by the
     # currently-engaged rungs.  All four apply after parse/validation
     # (a browned-out request still had to be well-formed) and before
@@ -2776,6 +2822,7 @@ def _recovery_params(config: dict) -> dict:
         attn=str(config.get("attn", "gather")),
         kv_dtype=str(config.get("kv_dtype", "native")),
         tp=int(config.get("tp", 1)),
+        mesh=str(config.get("mesh", MESH_SPEC) or ""),
         prefill_chunk=int(config.get("prefill_chunk", PREFILL_CHUNK)),
         temperature=float(config.get("temperature", 0.0)),
         seed=int(config.get("seed", 0)),
@@ -2794,7 +2841,7 @@ def _refinish_completed(e, entry) -> None:
     try:
         p = _recovery_params(e.accept.get("config") or {})
         fleet = _fleet_for(p["ckpt_dir"], p["attn"], p["kv_dtype"],
-                           p["tp"], p["prefill_chunk"])
+                           p["tp"], p["prefill_chunk"], p["mesh"])
         entry.finish(_decode_out(fleet.tok, e.done.get("tokens") or [],
                                  p["stop_byte"]))
     except Exception as err:  # noqa: BLE001 — a failed refinish must
@@ -2821,7 +2868,7 @@ def _recover_one(journal, rid: str, e, entry) -> None:
         payload = durability.decode_payload(e.accept.get("payload", ""))
         tag = str(e.accept.get("tag", ""))
         fleet = _fleet_for(p["ckpt_dir"], p["attn"], p["kv_dtype"],
-                           p["tp"], p["prefill_chunk"])
+                           p["tp"], p["prefill_chunk"], p["mesh"])
         tok = fleet.tok
         if tok is None:
             prompt = np.frombuffer(payload, np.uint8).astype(np.int32)
@@ -2955,7 +3002,8 @@ def _handle_generate_stats(header: dict) -> bytes:
            str(config.get("attn", "gather")),
            str(config.get("kv_dtype", "native")),
            int(config.get("tp", 1)),
-           int(config.get("prefill_chunk", PREFILL_CHUNK)))
+           int(config.get("prefill_chunk", PREFILL_CHUNK)),
+           str(config.get("mesh", MESH_SPEC) or ""))
     with _FLEET_SERVICE.lock:
         fhit = _FLEETS.get(key)
     if fhit is not None:
@@ -2993,6 +3041,11 @@ def _handle_generate_stats(header: dict) -> bytes:
 #: tear the exposition, while the refresh helper takes it for its own
 #: standalone (sampler-tick) callers.
 _METRICS_RENDER_LOCK = threading.RLock()
+
+#: the numbered breakdown suffixes the stale-gauge sweep may zero
+#: (engine_<key>_replica<i> / engine_<key>_shard<i>) — never a base
+#: gauge whose own name merely ends in "_shard"
+_STALE_SUFFIX_RE = re.compile(r"_(?:replica|shard)\d+$")
 
 
 def _refresh_engine_gauges() -> None:
@@ -3037,9 +3090,21 @@ def _refresh_engine_gauges() -> None:
     from tpulab.obs import roofline as _roofline
 
     estimate = 0
+    per_shard: dict = {}
+    n_devices = 1
     for eng in all_engines:
         try:
             estimate += eng.device_bytes_estimate()
+            # round-19 per-shard mirror: sum each mesh shard's bytes
+            # across engines (engines on different mesh shapes share
+            # shard indices — the gauge is "bytes on device i of the
+            # serving mesh", mesh-order); the MFU peak scales by the
+            # WIDEST warm mesh, the one the dispatches span
+            for i, st in eng.shard_stats().items():
+                agg = per_shard.setdefault(i, {})
+                for k, v in st.items():
+                    agg[k] = agg.get(k, 0) + v
+            n_devices = max(n_devices, getattr(eng, "_mesh_devices", 1))
         except Exception:
             pass
     # gauge rewrite + render under ONE scrape lock: the stale-suffix
@@ -3056,11 +3121,21 @@ def _refresh_engine_gauges() -> None:
             # suffixed gauges (an evicted fleet's replicas) zero first
             # so they can't freeze their final values into every
             # scrape.
+            # suffix match must require the NUMBERED form: the
+            # unsuffixed process-wide sum includes gauges whose own
+            # names end in "_shard" (engine_kv_pool_bytes_per_shard),
+            # and a bare substring test zeroes them right after the
+            # publish above
             for name in obs.REGISTRY.names():
-                if name.startswith("engine_") and "_replica" in name:
+                if name.startswith("engine_") and _STALE_SUFFIX_RE.search(
+                        name):
                     obs.REGISTRY.get(name).set(0)
             for i, st in sorted(per_replica.items()):
                 publish_engine_stats(st, suffix=f"_replica{i}")
+            # round-19 per-shard breakdown (engine_<key>_shard<i>):
+            # same stale-suffix discipline as the replica gauges
+            for i, st in sorted(per_shard.items()):
+                publish_engine_stats(st, suffix=f"_shard{i}")
         else:
             # no warm engines (none built yet, or the last one was
             # evicted after a stepper failure): zero the mirror instead
@@ -3073,8 +3148,11 @@ def _refresh_engine_gauges() -> None:
         # pass (the zero loop above matches the engine_ prefix, and a
         # no-warm-engine TPU daemon still holds real allocations the
         # memory_stats-backed gauges must keep reporting)
-        _roofline.update_device_memory_gauges(estimate)
-        _roofline.update_mfu_gauges()
+        _roofline.update_device_memory_gauges(
+            estimate,
+            per_shard={i: st.get("hbm_bytes_in_use", 0)
+                       for i, st in per_shard.items()} or None)
+        _roofline.update_mfu_gauges(n_devices=n_devices)
 
 
 def _handle_metrics(header: dict) -> bytes:
@@ -3447,14 +3525,16 @@ def _resolve_fleet(config: dict) -> Optional[_Fleet]:
     if not fleets:
         return None
     explicit = any(k in config for k in
-                   ("ckpt_dir", "attn", "kv_dtype", "tp", "prefill_chunk"))
+                   ("ckpt_dir", "attn", "kv_dtype", "tp",
+                    "prefill_chunk", "mesh"))
     if explicit or len(fleets) > 1:
         path = config.get("ckpt_dir")
         key = (os.path.realpath(path) if path else None,
                str(config.get("attn", "gather")),
                str(config.get("kv_dtype", "native")),
                int(config.get("tp", 1)),
-               int(config.get("prefill_chunk", PREFILL_CHUNK)))
+               int(config.get("prefill_chunk", PREFILL_CHUNK)),
+               str(config.get("mesh", MESH_SPEC) or ""))
         hit = fleets.get(key)
         return hit[1] if hit else None
     return next(iter(fleets.values()))[1]
@@ -3763,7 +3843,7 @@ def serve(socket_path: str, *, max_requests: Optional[int] = None) -> None:
 def main(argv=None) -> int:
     global PREFILL_CHUNK, REPLICAS, HEDGE_MS, METRICS_INTERVAL_S, \
         _JOURNAL, AUTOSCALE_MIN, AUTOSCALE_MAX, PREFIX_INDEX, \
-        SPILL_BLOCKS, SPILL_DTYPE
+        SPILL_BLOCKS, SPILL_DTYPE, MESH_SPEC
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--socket", default=os.environ.get("TPULAB_DAEMON_SOCKET", "/tmp/tpulab.sock"))
     ap.add_argument("--max-requests", type=int, default=None, help="exit after N requests (tests)")
@@ -3841,6 +3921,14 @@ def main(argv=None) -> int:
                          "spill-disabled reference); int8/int4 shrink "
                          "host bytes, lossy on restore (default "
                          "TPULAB_DAEMON_SPILL_DTYPE or native)")
+    ap.add_argument("--mesh", default=MESH_SPEC, metavar="AxB",
+                    help="2D serving mesh 'batch x model' for the "
+                         "daemon's engines (e.g. '2x4'): KV pools and "
+                         "attention heads shard on the model axis, the "
+                         "per-slot decode state on the batch axis; "
+                         "'1x1' or '' serves single-device (default "
+                         "TPULAB_DAEMON_MESH or ''; per-request "
+                         "'mesh' config overrides)")
     ap.add_argument("--slowlog", type=int, default=None, metavar="N",
                     help="per-request slow-log window: keep the worst N "
                          "requests by e2e latency (default 64; 0 "
@@ -3865,6 +3953,20 @@ def main(argv=None) -> int:
     if args.spill_blocks and args.prefix_index != "radix":
         ap.error("--spill-blocks > 0 requires --prefix-index radix "
                  "(the spill tier keys host payloads by radix paths)")
+    if args.mesh:
+        from tpulab.parallel.mesh import parse_mesh_spec
+
+        try:
+            mesh_b, mesh_m = parse_mesh_spec(args.mesh)
+        except ValueError as e:
+            ap.error(f"--mesh: {e}")
+        # mirror the engine's uncertified-combination refusals at the
+        # knob, before any client pays a cold build to find out
+        if mesh_b * mesh_m > 1 and args.spill_blocks \
+                and args.spill_dtype == "int4":
+            ap.error("--spill-dtype int4 is uncertified on mesh "
+                     "serving (use native or int8)")
+        args.mesh = f"{mesh_b}x{mesh_m}" if mesh_b * mesh_m > 1 else ""
     # elastic-fleet bounds: reject misconfiguration HERE with a
     # parseable argparse error (exit 2, message on stderr) instead of
     # a late crash inside the first fleet build
@@ -3892,6 +3994,7 @@ def main(argv=None) -> int:
     PREFIX_INDEX = args.prefix_index
     SPILL_BLOCKS = args.spill_blocks
     SPILL_DTYPE = args.spill_dtype
+    MESH_SPEC = args.mesh
     METRICS_INTERVAL_S = args.metrics_interval
     AUTOSCALE_MIN = args.autoscale_min
     AUTOSCALE_MAX = args.autoscale_max

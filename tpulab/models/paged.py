@@ -145,6 +145,17 @@ class QueueFullError(RuntimeError):
     to a reject-with-retry-after shedding response instead of letting
     the pending list grow without bound."""
 
+
+class EngineConfigError(ValueError):
+    """A serving-knob combination the engine refuses to build — either
+    nonsensical (indivisible head/slot sharding) or NOT YET CERTIFIED
+    on this configuration (the pallas kernel or the dense-draft
+    proposer on a mesh, the int4 host spill format on sharded pools).
+    A ValueError subclass so pre-round-19 ``except ValueError`` callers
+    and tests keep working; a distinct type so the daemon can tell a
+    config refusal from a genuine bad argument.  Uncertified combos
+    raise THIS, loudly — never a silent fallback to a weaker config."""
+
 # Per-request serving latency histograms (tpulab.obs process-global
 # registry; the daemon's ``metrics`` request renders them as Prometheus
 # text).  Every observation happens at a host-side boundary where the
@@ -219,11 +230,36 @@ def _pool_gather(pool, idx, dtype):
 
 
 def _pool_nbytes(pool) -> int:
-    """Device bytes one pool occupies (int8 pools: data + scale) — the
-    KV-occupancy gauge's static size term."""
+    """LOGICAL bytes one pool holds (int8 pools: data + scale) — the
+    KV-occupancy gauge's static size term.  ``jax.Array.nbytes`` is the
+    GLOBAL logical size regardless of sharding, so this stays the
+    single-copy figure on a mesh; see :func:`_device_nbytes` for what
+    the devices actually spend."""
     if isinstance(pool, tuple):
         return int(pool[0].nbytes) + int(pool[1].nbytes)
     return int(pool.nbytes)
+
+
+def _device_nbytes(x) -> int:
+    """PHYSICAL device bytes an array occupies, summed over its
+    addressable shards.  This is what HBM accounting must use on a
+    mesh: a replicated leaf costs ``n_devices x nbytes`` and a sharded
+    leaf costs ~``nbytes`` total — ``x.nbytes`` alone double-counts
+    nothing but also replicates nothing (the round-19 bytes bugfix)."""
+    shards = getattr(x, "addressable_shards", None)
+    if shards:
+        return int(sum(s.data.nbytes for s in shards))
+    return int(getattr(x, "nbytes", 0))
+
+
+def _shard_nbytes(x, index: Dict[int, int], out: Dict[int, int]) -> None:
+    """Accumulate ``x``'s per-shard bytes into ``out`` keyed by the
+    mesh-order shard index (``index`` maps device id -> shard index);
+    shards on devices outside the mesh are ignored."""
+    for s in getattr(x, "addressable_shards", ()) or ():
+        i = index.get(s.device.id)
+        if i is not None:
+            out[i] = out.get(i, 0) + int(s.data.nbytes)
 
 
 def _rope_at(x, pos, theta: float):
@@ -920,9 +956,6 @@ class PagedEngine:
             # would also break the spec-vs-plain bit-equality contract
             raise ValueError("spec_k > 0 requires attn='gather' "
                              "(no pallas verify kernel)")
-        if spec_k and mesh is not None:
-            raise ValueError("spec_k > 0 does not support mesh serving "
-                             "(the verify program is uncertified on tp)")
         if cfg.lora_rank:
             # the paged decode reads base weights only — serving an
             # adapter-active model would silently drop the finetune
@@ -939,12 +972,10 @@ class PagedEngine:
             raise ValueError(
                 f"kv_dtype={kv_dtype!r}; expected 'native' or 'int8'")
         if attn == "pallas" and mesh is not None:
-            # the kernel is single-device; under tp the gather path's
-            # GSPMD partitioning is the supported route
-            raise ValueError("attn='pallas' does not support mesh serving")
-        if kv_dtype == "int8" and mesh is not None:
-            raise ValueError("kv_dtype='int8' does not support mesh "
-                             "serving (scale pools are unsharded)")
+            # the kernel is single-device; on a mesh the gather path's
+            # GSPMD partitioning is the certified route
+            raise EngineConfigError(
+                "attn='pallas' does not support mesh serving")
         if prefix_index not in ("dict", "radix"):
             raise ValueError(f"prefix_index={prefix_index!r}; expected "
                              "'dict' or 'radix'")
@@ -956,14 +987,16 @@ class PagedEngine:
             # the dict index cannot name a single evicted block
             raise ValueError(
                 "spill_blocks > 0 requires prefix_index='radix'")
-        if spill_blocks and mesh is not None:
-            raise ValueError("spill_blocks > 0 does not support mesh "
-                             "serving (block d2h/restore is uncertified "
-                             "on sharded pools)")
         if spill_dtype not in _spill_mod.SPILL_DTYPES:
             raise ValueError(
                 f"spill_dtype={spill_dtype!r}; expected one of "
                 f"{_spill_mod.SPILL_DTYPES}")
+        if spill_blocks and spill_dtype == "int4" and mesh is not None:
+            # native and int8 host payloads are roundtrip-certified on
+            # sharded pools (round 19); the int4 nibble repack is not
+            raise EngineConfigError(
+                "spill_dtype='int4' is uncertified on mesh serving "
+                "(use 'native' or 'int8')")
         self.params = params
         self.cfg = cfg
         self.slots = slots
@@ -979,34 +1012,58 @@ class PagedEngine:
             self.kpool, self.vpool = init_pools(cfg, n_blocks, block_size,
                                                 kv_dtype)
         else:
-            # tensor-parallel serving: params take their tp shardings
-            # and the pools shard on the kv-head axis — GSPMD partitions
-            # the SAME jitted decode/extend programs across the mesh
+            # mesh serving: params take their model-axis shardings and
+            # the pools shard on the kv-head axis — GSPMD partitions the
+            # SAME jitted decode/verify/extend programs across the mesh
             # (attention is head-independent; the MLP's hidden split
-            # psums exactly like the training step)
-            from jax.sharding import NamedSharding, PartitionSpec as P
+            # psums exactly like the training step).  A 2D serving mesh
+            # additionally shards the per-slot decode state on its
+            # batch axis (_init_dev_state); the legacy 1D {"tp": N}
+            # mesh has no batch axis and keeps its replicated state.
+            from jax.sharding import NamedSharding
 
-            from tpulab.models.labformer import _restrict, shard_params
+            from tpulab.parallel.mesh import (axis_size, batch_axis,
+                                              model_axis, pool_scale_spec,
+                                              pool_spec,
+                                              shard_serving_params)
             from tpulab.runtime.device import commit
 
-            tp = mesh.shape.get("tp", 1)
-            if cfg.kv_heads % tp or cfg.n_heads % tp:
-                raise ValueError(
-                    f"tp={tp} must divide kv_heads={cfg.kv_heads} "
-                    f"and n_heads={cfg.n_heads}"
+            m_ax = model_axis(mesh)
+            m_sz = axis_size(mesh, m_ax)
+            if cfg.kv_heads % m_sz or cfg.n_heads % m_sz:
+                raise EngineConfigError(
+                    f"{m_ax or 'model'}={m_sz} must divide "
+                    f"kv_heads={cfg.kv_heads} and n_heads={cfg.n_heads}"
                 )
-            self.params = shard_params(params, cfg, mesh)
-            pool_sharding = NamedSharding(
-                mesh, _restrict(P(None, None, None, "tp", None), mesh)
-            )
-            # allocate pools INTO the sharding from host zeros — a
+            b_sz = axis_size(mesh, batch_axis(mesh))
+            if slots % b_sz:
+                raise EngineConfigError(
+                    f"slots={slots} must be a multiple of the mesh "
+                    f"batch axis size {b_sz}")
+            self.params = shard_serving_params(params, cfg, mesh)
+            # allocate pools INTO their shardings from host zeros — a
             # full-size device array staged on one chip first would OOM
-            # exactly the configurations tp-sharded pools exist to fit
+            # exactly the configurations sharded pools exist to fit
             shape = (cfg.n_layers, n_blocks, block_size, cfg.kv_heads,
                      cfg.head_dim)
-            host = np.zeros(shape, jnp.zeros((), cfg.dtype).dtype)
-            self.kpool = commit(host, pool_sharding)
-            self.vpool = commit(host, pool_sharding)
+            data_sh = NamedSharding(mesh, pool_spec(mesh))
+            if kv_dtype == "int8":
+                # quantized pools: the (int8 data, f32 scale) pair with
+                # BOTH planes sharded on the kv-head axis, so
+                # quantize-on-write and dequant-on-read never cross
+                # shards (zeros match init_pools bit-for-bit)
+                scale_sh = NamedSharding(mesh, pool_scale_spec(mesh))
+
+                def _qpool():
+                    return (commit(np.zeros(shape, np.int8), data_sh),
+                            commit(np.zeros(shape[:-1], np.float32),
+                                   scale_sh))
+
+                self.kpool, self.vpool = _qpool(), _qpool()
+            else:
+                host = np.zeros(shape, jnp.zeros((), cfg.dtype).dtype)
+                self.kpool = commit(host, data_sh)
+                self.vpool = commit(host, data_sh)
         self.mesh = mesh
         self.n_usable_blocks = n_blocks - 1
         self.free = list(range(1, n_blocks))  # block 0 is TRASH
@@ -1181,7 +1238,24 @@ class PagedEngine:
         self._kv_pool_bytes = (_pool_nbytes(self.kpool)
                                + _pool_nbytes(self.vpool))
         self._block_bytes = self._kv_pool_bytes // n_blocks
+        # shard-aware byte accounting: _kv_pool_bytes above is the
+        # LOGICAL single-copy size (block math, spill budgets); the
+        # device-bytes figures below are PHYSICAL, summed over
+        # addressable shards — on a 2D serving mesh the pools shard on
+        # model but replicate across batch, so the two genuinely differ
+        if mesh is not None:
+            devs = np.asarray(mesh.devices).flat
+            self._mesh_devices = len(devs)
+            self._shard_index = {d.id: i for i, d in enumerate(devs)}
+        else:
+            self._mesh_devices = 1
+            self._shard_index = None
+        self._kv_pool_device_bytes = int(sum(
+            _device_nbytes(x)
+            for pool in (self.kpool, self.vpool)
+            for x in (pool if isinstance(pool, tuple) else (pool,))))
         self._dev_bytes_est: Optional[int] = None
+        self._shard_stats_cache: Optional[Dict[int, Dict[str, int]]] = None
         from tpulab.obs.roofline import per_token_flops as _ptf
 
         _cstats.COMPILESTATS.set_model_flops(
@@ -1213,11 +1287,21 @@ class PagedEngine:
             "active": jnp.zeros(self.slots, bool),
         }
         if self.mesh is not None:
-            from jax.sharding import NamedSharding, PartitionSpec as P
+            from jax.sharding import NamedSharding
 
-            sh = NamedSharding(self.mesh, P())
-            # device->device replication: fresh per-device buffers
-            return {k: jax.device_put(v, sh) for k, v in dev.items()}
+            from tpulab.parallel.mesh import slot_spec
+
+            # explicit per-tensor placements: the slot (leading) axis
+            # shards on the mesh's batch axis (replicated on the legacy
+            # batch-less tp mesh — slot_spec degrades to P()), so the
+            # donated state round-trips through every tick with a
+            # STABLE sharding and jit never re-specializes mid-decode
+            return {
+                k: jax.device_put(
+                    v, NamedSharding(self.mesh,
+                                     slot_spec(self.mesh, v.ndim)))
+                for k, v in dev.items()
+            }
         return dev
 
     def _push_slot(self, s: int, active: bool):
@@ -1245,6 +1329,10 @@ class PagedEngine:
         speculative request, possibly from racing threads."""
         if self.draft_params is not None:
             return
+        if self.mesh is not None:
+            raise EngineConfigError(
+                "the dense-draft proposer is uncertified on mesh "
+                "serving (use spec='lookup')")
         if self.spec_k <= 0:
             raise ValueError("set_draft on an engine with spec_k=0: "
                              "build the engine with spec_k > 0")
@@ -1270,6 +1358,7 @@ class PagedEngine:
         self.d_kc = jnp.zeros(shape, cfg.dtype)
         self.d_vc = jnp.zeros(shape, cfg.dtype)
         self._dev_bytes_est = None  # the footprint just grew: re-sum
+        self._shard_stats_cache = None
 
     # ------------------------------------------------------------- admission
     def submit(self, prompt, max_new: int, *, temperature: float = 0.0,
@@ -2588,9 +2677,19 @@ class PagedEngine:
                                       if self._spill is not None else 0),
             "spill_dropped": (self._spill.dropped
                               if self._spill is not None else 0),
-            # static device footprint of the K+V pools (int8 pools
-            # include their scale planes)
+            # static footprint of the K+V pools (int8 pools include
+            # their scale planes): kv_pool_bytes is the LOGICAL single-
+            # copy size; kv_pool_device_bytes is the PHYSICAL total
+            # summed over addressable shards (== logical off-mesh; on a
+            # 2D serving mesh = batch_size x logical, since pools shard
+            # on model but replicate across batch); _per_shard is one
+            # device's share (uniform — pools shard evenly), the
+            # figure that must fit a single chip's HBM
             "kv_pool_bytes": self._kv_pool_bytes,
+            "kv_pool_device_bytes": self._kv_pool_device_bytes,
+            "kv_pool_bytes_per_shard": (
+                self._kv_pool_device_bytes // self._mesh_devices),
+            "mesh_devices": self._mesh_devices,
             "compile_buckets_dense": len(self._dense_buckets),
             "compile_buckets_extend": len(self._extend_buckets),
             "inflight_depth": self.inflight_depth,
@@ -2602,18 +2701,54 @@ class PagedEngine:
         }
 
     def device_bytes_estimate(self) -> int:
-        """Estimated device bytes this engine holds (params + KV pools
-        + draft caches + per-slot decode state) — the CPU-proxy stand-
-        in for ``memory_stats()['bytes_in_use']`` the ``engine_hbm_*``
-        gauges fall back to (tpulab.obs.roofline).  Sizes are static
-        per engine, so the sum is computed once and cached."""
+        """Estimated PHYSICAL device bytes this engine holds (params +
+        KV pools + draft caches + per-slot decode state), summed over
+        every shard of every leaf — the CPU-proxy stand-in for
+        ``memory_stats()['bytes_in_use']`` the ``engine_hbm_*`` gauges
+        fall back to (tpulab.obs.roofline).  Per-shard summation (not
+        ``.nbytes``, which is the global logical size) is the round-19
+        bugfix: on a mesh, replicated leaves genuinely cost
+        ``n_devices x nbytes`` and model-sharded leaves cost ~1x —
+        counting logical bytes under-reported the former and the old
+        single-shard reading under-reported the latter.  Sizes are
+        static per engine, so the sum is computed once and cached."""
         if self._dev_bytes_est is None:
             leaves = jax.tree_util.tree_leaves(
                 (self.params, self.draft_params, self.d_kc, self.d_vc,
                  list(self._dev.values())))
-            self._dev_bytes_est = self._kv_pool_bytes + int(sum(
-                int(getattr(x, "nbytes", 0)) for x in leaves))
+            self._dev_bytes_est = self._kv_pool_device_bytes + int(sum(
+                _device_nbytes(x) for x in leaves))
         return self._dev_bytes_est
+
+    def shard_stats(self) -> Dict[int, Dict[str, int]]:
+        """Per-shard byte breakdown, keyed by mesh-order shard index:
+        ``{i: {"hbm_bytes_in_use": ..., "kv_pool_bytes": ...}}``.
+        Off-mesh this is one shard 0 mirroring the engine totals, so
+        the gauge surface is config-independent.  Cached — the sharded
+        footprint is static per engine (same invalidation as
+        :meth:`device_bytes_estimate`)."""
+        if self._shard_stats_cache is None:
+            if self._shard_index is None:
+                self._shard_stats_cache = {0: {
+                    "hbm_bytes_in_use": self.device_bytes_estimate(),
+                    "kv_pool_bytes": self._kv_pool_device_bytes,
+                }}
+            else:
+                pool_by, all_by = {}, {}
+                for pool in (self.kpool, self.vpool):
+                    for x in (pool if isinstance(pool, tuple)
+                              else (pool,)):
+                        _shard_nbytes(x, self._shard_index, pool_by)
+                        _shard_nbytes(x, self._shard_index, all_by)
+                for x in jax.tree_util.tree_leaves(
+                        (self.params, self.draft_params, self.d_kc,
+                         self.d_vc, list(self._dev.values()))):
+                    _shard_nbytes(x, self._shard_index, all_by)
+                self._shard_stats_cache = {
+                    i: {"hbm_bytes_in_use": all_by.get(i, 0),
+                        "kv_pool_bytes": pool_by.get(i, 0)}
+                    for i in range(self._mesh_devices)}
+        return self._shard_stats_cache
 
     def publish_metrics(self) -> Dict[str, int]:
         """Mirror :meth:`stats` into the process-global registry as
@@ -2627,13 +2762,22 @@ class PagedEngine:
         Also refreshes the round-14 device-tier gauges: ``engine_hbm_
         bytes_in_use``/``_limit`` (live ``memory_stats()`` where the
         backend has it, this engine's byte estimate on the CPU proxy)
-        and the ``engine_mfu``/``train_mfu`` roofline gauges."""
+        and the ``engine_mfu``/``train_mfu`` roofline gauges.  Round 19
+        adds the per-shard mirrors — ``engine_hbm_bytes_in_use_
+        shard<i>`` / ``engine_kv_pool_bytes_shard<i>`` for each mesh
+        device — and scales the roofline peak by the mesh size (eight
+        chips have eight chips' worth of FLOPs)."""
         from tpulab.obs import roofline as _roofline
 
         st = self.stats()
         publish_engine_stats(st)
-        _roofline.update_device_memory_gauges(self.device_bytes_estimate())
-        _roofline.update_mfu_gauges()
+        for i, srow in self.shard_stats().items():
+            publish_engine_stats(srow, suffix=f"_shard{i}")
+        _roofline.update_device_memory_gauges(
+            self.device_bytes_estimate(),
+            per_shard={i: s["hbm_bytes_in_use"]
+                       for i, s in self.shard_stats().items()})
+        _roofline.update_mfu_gauges(n_devices=self._mesh_devices)
         return st
 
     def run(self) -> Dict[int, np.ndarray]:

@@ -187,7 +187,8 @@ def roofline_rows(compile_stats: Optional[Dict] = None,
 
 
 def update_mfu_gauges(peaks: Optional[Dict] = None,
-                      registry=None) -> Dict[str, float]:
+                      registry=None, n_devices: int = 1
+                      ) -> Dict[str, float]:
     """Recompute + publish the ``engine_mfu`` / ``train_mfu`` gauges
     (percent of bf16 peak; 0.0 on the CPU proxy or before traffic).
 
@@ -204,13 +205,20 @@ def update_mfu_gauges(peaks: Optional[Dict] = None,
       ``tpulab.train`` at its metrics barriers) — wall-clock MFU, the
       honest number under the async overlap window.
 
+    ``n_devices > 1`` (a mesh-sharded engine) scales the peak by the
+    mesh size: eight chips have eight chips' worth of FLOPs, and a
+    sharded dispatch that used one chip's peak as its denominator would
+    report an MFU ``n_devices`` times too flattering.
+
     Scrape-path only (the daemon's ``metrics`` handler and
     ``PagedEngine.publish_metrics`` call it) — never per tick."""
     from tpulab.obs.compilestats import COMPILESTATS
     from tpulab.obs.registry import REGISTRY
 
     reg = registry if registry is not None else REGISTRY
-    peaks = peaks if peaks is not None else device_peaks()
+    peaks = dict(peaks if peaks is not None else device_peaks())
+    if n_devices > 1 and peaks.get("peak_tflops"):
+        peaks["peak_tflops"] = peaks["peak_tflops"] * n_devices
     out = {"engine_mfu": 0.0, "train_mfu": 0.0}
     # 4 SIGNIFICANT digits, not fixed decimals: a CPU-proxy smoke model
     # has a genuinely tiny MFU and fixed rounding would print it as an
@@ -236,12 +244,18 @@ def update_mfu_gauges(peaks: Optional[Dict] = None,
 
 
 def update_device_memory_gauges(estimate_bytes: int = 0,
-                                registry=None) -> Dict[str, int]:
+                                registry=None,
+                                per_shard: Optional[Dict[int, int]] = None
+                                ) -> Dict[str, int]:
     """Publish ``engine_hbm_bytes_in_use`` / ``engine_hbm_bytes_limit``
     from the device runtime's ``memory_stats()`` where the backend
     exposes it (TPU), falling back to ``estimate_bytes`` — the summed
     pool/param/state estimate the engines report — on backends without
-    it (the CPU proxy; limit publishes 0 there).  Scrape-path only."""
+    it (the CPU proxy; limit publishes 0 there).  ``per_shard``
+    ({shard index: bytes}, a mesh engine's :meth:`shard_stats` view)
+    additionally publishes one ``engine_hbm_bytes_in_use_shard<i>``
+    gauge per mesh device — the per-chip fit signal the summed gauge
+    hides.  Scrape-path only."""
     from tpulab.obs.registry import REGISTRY
 
     reg = registry if registry is not None else REGISTRY
@@ -263,5 +277,12 @@ def update_device_memory_gauges(estimate_bytes: int = 0,
     reg.gauge("engine_hbm_bytes_limit",
               "device memory limit (0 when the backend reports none)"
               ).set(limit)
-    return {"engine_hbm_bytes_in_use": in_use,
-            "engine_hbm_bytes_limit": limit}
+    out = {"engine_hbm_bytes_in_use": in_use,
+           "engine_hbm_bytes_limit": limit}
+    for i, b in sorted((per_shard or {}).items()):
+        name = f"engine_hbm_bytes_in_use_shard{i}"
+        reg.gauge(name,
+                  "device memory one mesh shard holds (engine byte "
+                  "estimate; per-chip fit signal)").set(int(b))
+        out[name] = int(b)
+    return out

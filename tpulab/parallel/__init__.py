@@ -19,7 +19,9 @@ collectives onto ICI; tests run on an 8-virtual-device CPU mesh
 section 4 prescribes.
 """
 
-from tpulab.parallel.mesh import best_factorization, make_mesh, mesh_anchor, mesh_devices
+from tpulab.parallel.mesh import (best_factorization, make_mesh,
+                                  mesh_anchor, mesh_devices,
+                                  parse_mesh_spec, serving_mesh)
 from tpulab.parallel.ring import attention_reference, ring_attention, ulysses_attention
 from tpulab.parallel.collectives import (
     all_gather_op,
@@ -43,6 +45,8 @@ __all__ = [
     "make_mesh",
     "mesh_devices",
     "best_factorization",
+    "parse_mesh_spec",
+    "serving_mesh",
     "distributed_reduce",
     "distributed_mean",
     "all_gather_op",

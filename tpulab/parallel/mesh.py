@@ -20,6 +20,15 @@ Axis conventions used across the framework:
 Expert parallelism (``ep``) reuses the ``(dp, sp)`` submesh —
 DeepSpeed-MoE style — so experts shard over the data axes without
 spending a dedicated mesh dimension (see tpulab.models.labformer).
+
+The SERVING mesh (round 19) is a separate 2D layout with its own axis
+names — ``("batch", "model")`` — built by :func:`serving_mesh` and
+consumed by the PagedEngine: KV pools and attention heads shard on
+``model`` (the tp role), the donated per-slot decode state shards on
+``batch``, params replicate across ``batch`` and shard across
+``model``.  :func:`model_axis` / :func:`batch_axis` resolve either the
+serving layout or the legacy 1D ``{"tp": N}`` mesh, so both keep
+working through one engine code path.
 """
 
 from __future__ import annotations
@@ -29,7 +38,8 @@ from typing import Dict, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
 
 
 def mesh_devices(n: Optional[int] = None, *, backend: Optional[str] = None):
@@ -113,3 +123,138 @@ def cpu_test_mesh(axis_sizes: Dict[str, int]) -> Mesh:
     """Mesh over virtual CPU devices (test tier; requires
     ``--xla_force_host_platform_device_count``)."""
     return make_mesh(axis_sizes, backend="cpu")
+
+
+# --------------------------------------------------- serving mesh (2D)
+# Engine-facing helpers for the mesh-sharded PagedEngine: a 2D
+# ``(batch, model)`` mesh where attention heads and the KV pools shard
+# on the MODEL axis (the tp role) and the per-slot decode state shards
+# on the BATCH axis.  The legacy 1D ``{"tp": N}`` serving mesh keeps
+# working — :func:`model_axis` resolves either layout, so the engine
+# never hard-codes an axis name.
+
+#: canonical serving-mesh axis names (``--mesh AxB`` = batch x model)
+BATCH_AXIS = "batch"
+MODEL_AXIS = "model"
+
+
+def parse_mesh_spec(spec: str) -> Tuple[int, int]:
+    """``"AxB"`` -> ``(batch, model)`` axis sizes (the daemon's
+    ``--mesh`` grammar).  Both factors must be positive integers."""
+    parts = str(spec).lower().split("x")
+    if len(parts) != 2:
+        raise ValueError(
+            f"mesh spec {spec!r}: expected 'AxB' (batch x model), "
+            f"e.g. '2x4'")
+    try:
+        batch, model = int(parts[0]), int(parts[1])
+    except ValueError:
+        raise ValueError(
+            f"mesh spec {spec!r}: both factors must be integers") from None
+    if batch < 1 or model < 1:
+        raise ValueError(
+            f"mesh spec {spec!r}: axis sizes must be >= 1")
+    return batch, model
+
+
+def serving_mesh(batch: int = 1, model: int = 1,
+                 *, backend: Optional[str] = None) -> Mesh:
+    """The engine's 2D serving mesh: axes ``("batch", "model")`` over
+    the first ``batch * model`` devices.  ``serving_mesh(1, 1)`` is the
+    degenerate single-device mesh (bit-identical to ``mesh=None``
+    serving — the certification anchor)."""
+    if batch < 1 or model < 1:
+        raise ValueError(
+            f"serving mesh axes must be >= 1, got batch={batch} "
+            f"model={model}")
+    return make_mesh({BATCH_AXIS: batch, MODEL_AXIS: model},
+                     backend=backend)
+
+
+def model_axis(mesh: Optional[Mesh]) -> Optional[str]:
+    """The axis attention heads / KV pools shard on: ``"model"`` on a
+    serving mesh, ``"tp"`` on the legacy 1D tp mesh, None when the mesh
+    has neither (everything head-sharded stays replicated)."""
+    if mesh is None:
+        return None
+    for ax in (MODEL_AXIS, "tp"):
+        if ax in mesh.axis_names:
+            return ax
+    return None
+
+
+def batch_axis(mesh: Optional[Mesh]) -> Optional[str]:
+    """The axis the per-slot decode state shards on (None on the
+    legacy tp mesh — state stays replicated, the pre-round-19
+    behavior)."""
+    if mesh is not None and BATCH_AXIS in mesh.axis_names:
+        return BATCH_AXIS
+    return None
+
+
+def axis_size(mesh: Optional[Mesh], axis: Optional[str]) -> int:
+    """Size of ``axis`` on ``mesh`` (1 for an absent axis or mesh)."""
+    if mesh is None or axis is None:
+        return 1
+    return int(mesh.shape[axis])
+
+
+def pool_spec(mesh: Mesh) -> P:
+    """PartitionSpec of one KV pool ``(L, P, BS, kv, d)``: the kv-head
+    axis shards on the model axis; everything else (including the
+    batch axis — pools are a shared resource every slot reads) is
+    replicated."""
+    return P(None, None, None, model_axis(mesh), None)
+
+
+def pool_scale_spec(mesh: Mesh) -> P:
+    """PartitionSpec of an int8 pool's f32 scale plane
+    ``(L, P, BS, kv)`` — sharded on the kv-head axis exactly like the
+    data plane, so quantize-on-write never crosses shards."""
+    return P(None, None, None, model_axis(mesh))
+
+
+def slot_spec(mesh: Mesh, ndim: int) -> P:
+    """PartitionSpec of one donated per-slot decode-state tensor whose
+    LEADING dim is the slot axis (``last_tok (S,)``, ``tables (S, M)``,
+    ``seen (S, vocab)``, ...): slots shard on the batch axis, trailing
+    dims replicate.  On a batch-less (legacy tp) mesh this is fully
+    replicated — the pre-round-19 placement."""
+    return P(batch_axis(mesh), *([None] * (ndim - 1)))
+
+
+def serving_param_spec(spec: P, mesh: Mesh) -> P:
+    """A labformer ``param_specs`` entry translated for the serving
+    mesh: the training specs name the tensor-parallel axis ``"tp"`` —
+    rename it to the mesh's model axis (a no-op on a legacy tp mesh),
+    then drop axis names the mesh doesn't carry (``dp``/``sp``/``pp``
+    replicate, exactly like labformer's ``_restrict``).  Params never
+    shard on the batch axis — they are replicated across it."""
+    target = model_axis(mesh)
+
+    def keep(entry):
+        if entry is None:
+            return None
+        names = entry if isinstance(entry, tuple) else (entry,)
+        names = tuple(target if n == "tp" and target else n for n in names)
+        kept = tuple(n for n in names if n in mesh.axis_names)
+        return kept if len(kept) > 1 else (kept[0] if kept else None)
+
+    return P(*(keep(e) for e in spec))
+
+
+def shard_serving_params(params, cfg, mesh: Mesh):
+    """Place serving params into their mesh shardings (labformer's
+    ``shard_params`` with the tp->model translation) via
+    ``runtime.device.commit`` — never a raw ``device_put``, which would
+    pay the cross-backend transfer that degrades the tunneled TPU."""
+    from tpulab.models.labformer import param_specs
+    from tpulab.runtime.device import commit
+
+    specs = param_specs(cfg)
+    return jax.tree_util.tree_map(
+        lambda x, s: commit(
+            x, NamedSharding(mesh, serving_param_spec(s, mesh))),
+        params,
+        specs,
+    )

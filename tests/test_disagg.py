@@ -1,0 +1,292 @@
+"""Disaggregated prefill/decode serving: the round-20 suite.
+
+The fleet layer now splits a serving fleet into POOLS by phase
+(``--pool-spec prefill=1..2,decode=1``): placement is phase-aware
+(a request enters through the prefill pool, decodes in the decode
+pool), and at the PREFILLING→DECODING boundary the prefill engine
+exports the request's KV blocks in the digest-keyed host-block format
+(the PR-13 spill tier's wire format) for the decode engine to import —
+admission's spill prefetch restores the prefix to HBM and recomputes
+only the sub-block tail, so the handoff moves bytes, not compute.
+
+Certified here:
+
+  * ``_parse_pool_spec`` accepts fixed (``role=N``) and ranged
+    (``role=MIN..MAX``) pools and rejects unknown/duplicate roles and
+    inverted bounds;
+  * ``choose_replica`` routes each phase to its pool and lets unified
+    replicas serve anything;
+  * a decode pool's AutoscalePolicy scales on ITL p99
+    (``latency_high_s``) with the same half-mark hysteresis as
+    queue-wait — the pools' burn signals are independent;
+  * a pooled fleet serves greedy AND sampled streams BIT-IDENTICAL to
+    unified serving, with the handoff counters advancing, the decode
+    engine's admission prefetch actually consuming the imported
+    blocks, and exact block accounting on both pools afterwards;
+  * the pool-scoped park frame (``rebuilding pool=<role>
+    retry_after_ms=N``) parses through ``loadgen.SHED_RE`` with the
+    same group numbering as the whole-fleet frame;
+  * pools scale INDEPENDENTLY through the round-17 reconcile
+    machinery: a prefill reconcile adds/retires prefill replicas only,
+    and ``scale_in`` refuses to dip a pool below its floor.
+"""
+
+import numpy as np
+import pytest
+
+import tpulab.daemon as daemon_mod
+from tpulab import autoscale, faults, loadgen, router
+from tpulab.models.labformer import LabformerConfig
+from tpulab.models.paged import PagedEngine
+
+CFG = LabformerConfig(d_model=32, n_heads=4, n_layers=2, d_ff=64, max_seq=128)
+
+
+@pytest.fixture(scope="module")
+def trained(trained_small, trained_small_cfg):
+    assert CFG == trained_small_cfg  # shared-model drift fails loudly
+    return trained_small
+
+
+@pytest.fixture(autouse=True)
+def _injector_always_reset():
+    yield
+    faults.disable()
+
+
+def _cycle_prompt(p):
+    return (np.arange(p) % 7).astype(np.int32)
+
+
+def _mk_engine(params, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("n_blocks", 32)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("max_seq", 64)
+    # the disaggregated serving arrangement: radix index + armed spill
+    # tier on every replica (the handoff wire format IS the host tier)
+    kw.setdefault("prefix_index", "radix")
+    kw.setdefault("spill_blocks", 16)
+    return PagedEngine(params, CFG, **kw)
+
+
+def _mk_fleet(params, n, pools=None, **eng_kw):
+    def builder():
+        return _mk_engine(params, **eng_kw), None
+
+    return daemon_mod._make_fleet(builder, n, pools=pools)
+
+
+def _no_leaks(eng):
+    """Radix-aware exact block accounting: every non-free block is
+    held by the prefix cache (one ref per radix node)."""
+    cached = set(eng._radix.blocks())
+    assert len(eng.free) + len(cached) == eng.n_usable_blocks, (
+        len(eng.free), sorted(cached), eng.n_usable_blocks)
+    assert len(set(eng.free)) == len(eng.free), "double-freed block"
+    assert all(eng.block_refs[b] == 0 for b in eng.free)
+
+
+def _engines(fleet):
+    out = []
+    for r in fleet.replicas:
+        with r.cond:
+            if not r.dead:
+                out.append((r.role, r.engine))
+    return out
+
+
+# ------------------------------------------------------- pool-spec units
+def test_parse_pool_spec_fixed_and_ranged():
+    assert daemon_mod._parse_pool_spec("prefill=1,decode=1") == [
+        ("prefill", 1, 1), ("decode", 1, 1)]
+    assert daemon_mod._parse_pool_spec("prefill=1..3, decode=2") == [
+        ("prefill", 1, 3), ("decode", 2, 2)]
+    assert daemon_mod._parse_pool_spec("unified=2") == [("unified", 2, 2)]
+
+
+@pytest.mark.parametrize("bad", [
+    "", "  ", "draft=1", "prefill", "prefill=0", "prefill=3..2",
+    "prefill=1,prefill=2", "prefill=x", "prefill=1..y",
+])
+def test_parse_pool_spec_rejects(bad):
+    with pytest.raises(ValueError):
+        daemon_mod._parse_pool_spec(bad)
+
+
+# ---------------------------------------------------------- router units
+def test_choose_replica_is_phase_aware():
+    views = [
+        router.ReplicaView(0, True, False, 0, 0, role=router.ROLE_PREFILL),
+        router.ReplicaView(1, True, False, 0, 0, role=router.ROLE_DECODE),
+    ]
+    assert router.choose_replica(views, phase=router.ROLE_PREFILL) == 0
+    assert router.choose_replica(views, phase=router.ROLE_DECODE) == 1
+    # a unified replica serves BOTH phases; a pool replica never
+    # serves the other pool's phase
+    uni = [router.ReplicaView(2, True, False, 0, 0)]
+    assert router.choose_replica(uni, phase=router.ROLE_PREFILL) == 2
+    assert router.choose_replica(uni, phase=router.ROLE_DECODE) == 2
+    only_prefill = views[:1]
+    assert router.choose_replica(
+        only_prefill, phase=router.ROLE_DECODE) is None
+
+
+def test_entry_phase_only_on_pooled_fleets(trained):
+    unified = _mk_fleet(trained, 1)
+    assert daemon_mod._FleetService._entry_phase(unified) is None
+    pooled = _mk_fleet(trained, 0, pools=[("prefill", 1, 1),
+                                          ("decode", 1, 1)])
+    assert (daemon_mod._FleetService._entry_phase(pooled)
+            == router.ROLE_PREFILL)
+
+
+# ------------------------------------------------------- autoscale units
+def test_decode_pool_scales_on_itl_signal():
+    pol = autoscale.AutoscalePolicy(1, 2, latency_high_s=0.5,
+                                    out_after=2, out_cooldown_s=0.0)
+    hot = autoscale.Signals(active_replicas=1, load_per_replica=0.0,
+                            latency_p99_s=0.9)
+    assert pol.observe(0.0, hot) == 1     # one tick: streak, no move
+    assert pol.observe(1.0, hot) == 2     # sustained ITL burn scales
+    # half-mark hysteresis: ITL between half and full threshold is
+    # ambiguous, never shrinkable
+    warm = autoscale.Signals(active_replicas=2, load_per_replica=0.0,
+                             latency_p99_s=0.3)
+    assert not pol.underloaded(warm)
+    calm = autoscale.Signals(active_replicas=2, load_per_replica=0.0,
+                             latency_p99_s=0.1)
+    assert pol.underloaded(calm)
+
+
+def test_latency_signal_ignored_without_threshold():
+    pol = autoscale.AutoscalePolicy(1, 2)
+    hot = autoscale.Signals(active_replicas=1, load_per_replica=0.0,
+                            latency_p99_s=10.0)
+    assert not pol.overloaded(hot)  # pre-round-20 policies are blind
+
+
+# ----------------------------------------------------- handoff end-to-end
+def test_pooled_fleet_greedy_bit_identical_with_handoff(trained):
+    svc = daemon_mod._FleetService()
+    prompt = _cycle_prompt(20)
+
+    unified = _mk_fleet(trained, 1)
+    want = svc.generate(unified, prompt, 12)
+
+    pooled = _mk_fleet(trained, 0, pools=[("prefill", 1, 1),
+                                          ("decode", 1, 1)])
+    h0 = daemon_mod._C_HANDOFFS.value
+    b0 = daemon_mod._C_HANDOFF_BYTES.value
+    got = svc.generate(pooled, prompt, 12)
+    assert np.array_equal(want, got)
+    assert daemon_mod._C_HANDOFFS.value == h0 + 1
+    assert daemon_mod._C_HANDOFF_BYTES.value > b0
+
+    roles = dict(_engines(pooled))
+    prefill_eng = roles[router.ROLE_PREFILL]
+    decode_eng = roles[router.ROLE_DECODE]
+    # the work actually split by phase: the prefill engine finished
+    # nothing, the decode engine emitted every token — and it did so
+    # from the IMPORTED blocks, not a recompute
+    assert prefill_eng.counters["requests_done"] == 0
+    assert decode_eng.counters["requests_done"] == 1
+    assert decode_eng.counters["tokens_out"] == 12
+    assert decode_eng.counters["spill_prefetched"] >= 1
+    for _, eng in _engines(pooled):
+        _no_leaks(eng)
+
+
+def test_pooled_fleet_sampled_bit_identical(trained):
+    svc = daemon_mod._FleetService()
+    prompt = _cycle_prompt(20)
+    unified = _mk_fleet(trained, 1)
+    want = svc.generate(unified, prompt, 12, temperature=0.8, seed=3)
+    pooled = _mk_fleet(trained, 0, pools=[("prefill", 1, 1),
+                                          ("decode", 1, 1)])
+    got = svc.generate(pooled, prompt, 12, temperature=0.8, seed=3)
+    # resubmit's resume-key contract, applied across the handoff: the
+    # decode engine re-seeds the slot's key chain where the prefill
+    # engine would have started drawing
+    assert np.array_equal(want, got)
+    for _, eng in _engines(pooled):
+        _no_leaks(eng)
+
+
+def test_fleet_status_surfaces_roles_and_pools(trained):
+    svc = daemon_mod._FleetService()
+    pooled = _mk_fleet(trained, 0, pools=[("prefill", 1, 2),
+                                          ("decode", 1, 1)])
+    st = svc.fleet_status(pooled)
+    assert [r["role"] for r in st["replica"]] == [
+        router.ROLE_PREFILL, router.ROLE_DECODE]
+    assert st["pools"]["prefill"]["min"] == 1
+    assert st["pools"]["prefill"]["max"] == 2
+    assert st["pools"]["prefill"]["autoscale"]["target"] == 1
+    # a fixed pool has no policy to snapshot
+    assert st["pools"]["decode"]["autoscale"] is None
+    # unified fleets don't grow the key (wire-compat with round 13)
+    unified = _mk_fleet(trained, 1)
+    assert "pools" not in svc.fleet_status(unified)
+
+
+# ------------------------------------------------------- park-frame wire
+def test_pool_park_frame_matches_shed_re():
+    err = daemon_mod.PoolRebuildingError(250, router.ROLE_PREFILL,
+                                         "no placeable replica in pool")
+    m = loadgen.SHED_RE.search(str(err))
+    assert m is not None, str(err)
+    assert m.group(1) == "rebuilding"
+    assert m.group(2) == "250"
+    # a pool park IS a RebuildingError: every round-13 client handler
+    # (park-and-retry, never a hard failure) applies unchanged
+    assert isinstance(err, daemon_mod.RebuildingError)
+    # and the whole-fleet frame still parses with the same groups
+    m2 = loadgen.SHED_RE.search(
+        str(daemon_mod.RebuildingError(100, "rolling restart")))
+    assert m2 is not None
+    assert (m2.group(1), m2.group(2)) == ("rebuilding", "100")
+
+
+# ------------------------------------------------- independent pool scale
+def test_pools_scale_independently(trained):
+    pooled = _mk_fleet(trained, 0, pools=[("prefill", 1, 2),
+                                          ("decode", 1, 1)])
+    svc = daemon_mod._FLEET_SERVICE
+
+    def count(role):
+        with pooled.cv:
+            return sum(1 for r in pooled.replicas
+                       if not r.retired and r.role == role)
+
+    # a prefill-scoped reconcile grows ONLY the prefill pool, through
+    # the round-17 machinery (fresh engine, stepper, router views)
+    daemon_mod._reconcile_fleet(pooled, 2, router.ROLE_PREFILL)
+    assert count(router.ROLE_PREFILL) == 2
+    assert count(router.ROLE_DECODE) == 1
+    new = pooled.replicas[-1]
+    assert new.role == router.ROLE_PREFILL
+    with new.cond:
+        assert new.engine.handoff_at_boundary  # pool role arms the edge
+
+    # role-scoped scale-in honours the pool floor: prefill shrinks
+    # back to 1, then refuses; the decode pool never had headroom
+    assert svc.scale_in(pooled, role=router.ROLE_PREFILL) is not None
+    assert count(router.ROLE_PREFILL) == 1
+    assert svc.scale_in(pooled, role=router.ROLE_PREFILL) is None
+    assert svc.scale_in(pooled, role=router.ROLE_DECODE) is None
+    assert count(router.ROLE_DECODE) == 1
+
+
+def test_pooled_fleet_serves_after_prefill_scale_out(trained):
+    svc = daemon_mod._FleetService()
+    prompt = _cycle_prompt(20)
+    unified = _mk_fleet(trained, 1)
+    want = svc.generate(unified, prompt, 12)
+    pooled = _mk_fleet(trained, 0, pools=[("prefill", 1, 2),
+                                          ("decode", 1, 1)])
+    daemon_mod._reconcile_fleet(pooled, 2, router.ROLE_PREFILL)
+    got = svc.generate(pooled, prompt, 12)
+    assert np.array_equal(want, got)
+    for _, eng in _engines(pooled):
+        _no_leaks(eng)

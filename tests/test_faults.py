@@ -636,6 +636,57 @@ def test_chaos_schedule_end_to_end(trained):
         assert re.search(pat, text, re.M), pat
 
 
+# ------------------------------------------------- handoff chaos (r20)
+def test_handoff_crash_replays_from_journaled_prompt(trained):
+    """The ``daemon.handoff`` site (round 20): a crash between the
+    prefill engine's KV export and the decode-side admit loses the
+    payload at its most exposed moment — exported (prefill blocks
+    already released) but not yet imported.  The supervisor replays
+    the request from the prompt the ticket still journals, re-entering
+    through the PREFILL pool like any migration: the retry prefills,
+    parks at the boundary, and hands off cleanly (the ``at=1`` rule is
+    spent).  Greedy stream bit-identical to unified serving, the
+    replay charged like a replica failure, zero leaked blocks on
+    either pool."""
+    import tpulab.daemon as daemon_mod
+    from tpulab import router
+
+    svc = daemon_mod._FleetService()
+    prompt = _cycle_prompt(20)
+
+    def builder():
+        return _mk_engine(trained, prefix_index="radix",
+                          spill_blocks=16), None
+
+    unified = daemon_mod._make_fleet(builder, 1)
+    want = svc.generate(unified, prompt, 12)
+
+    pooled = daemon_mod._make_fleet(
+        builder, 0, pools=[("prefill", 1, 1), ("decode", 1, 1)])
+    h0 = daemon_mod._C_HANDOFFS.value
+    m0 = obs.REGISTRY.get("daemon_migrations").value
+    with faults.active([{"site": "daemon.handoff", "kind": "raise",
+                         "at": 1}]) as inj:
+        got = svc.generate(pooled, prompt, 12)
+        assert inj.fired().get("daemon.handoff") == 1
+    assert np.array_equal(want, got)
+    # the crashed attempt is charged as a migration (the journaled-
+    # prompt replay path); the RETRY's boundary handoff then lands
+    assert obs.REGISTRY.get("daemon_migrations").value == m0 + 1
+    assert daemon_mod._C_HANDOFFS.value == h0 + 1
+    for r in pooled.replicas:
+        with r.cond:
+            assert not r.dead
+            eng = r.engine
+            cached = set(eng._radix.blocks())
+            assert (len(eng.free) + len(cached)
+                    == eng.n_usable_blocks), (
+                r.role, len(eng.free), sorted(cached))
+            assert all(eng.block_refs[b] == 0 for b in eng.free)
+            if r.role == router.ROLE_DECODE:
+                assert eng.counters["requests_done"] == 1
+
+
 # ------------------------------------------------------------------ lint
 def test_fault_counters_registered_and_documented():
     """The round-11 lint (tests/test_obs.py pattern): every new
@@ -646,7 +697,11 @@ def test_fault_counters_registered_and_documented():
 
     docs = (ROOT / "docs" / "ARCHITECTURE.md").read_text()
     for name in ("daemon_engine_restarts", "daemon_replays",
-                 "daemon_shed_requests"):
+                 "daemon_shed_requests",
+                 # round 20: the disaggregated-serving surface
+                 "daemon_handoffs", "handoff_bytes",
+                 "pool_prefill_replicas", "pool_prefill_target",
+                 "pool_decode_replicas", "pool_decode_target"):
         assert obs.REGISTRY.get(name) is not None, name
         assert name in docs, f"{name} missing from docs/ARCHITECTURE.md"
     assert "engine_preemptions" in docs

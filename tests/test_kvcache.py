@@ -28,8 +28,8 @@ Covers the round-18 ISSUE checklist:
     transfer programs;
   * constructor validation: spill requires the radix index, bounds,
     and dtype names.  (Round 19 certified the tier on mesh-sharded
-    pools — the spill-on-mesh arms live in tests/test_mesh_serving.py;
-    only the int4 host format still rejects there.)
+    pools and round 20 extended that to the int4 host format — the
+    spill-on-mesh arms live in tests/test_mesh_serving.py.)
 """
 
 import random

@@ -110,6 +110,38 @@ def test_trace_workload_features():
     assert lens[-1] >= 2 * lens[len(lens) // 2]
 
 
+def test_shed_re_wire_contract():
+    """SHED_RE is THE one copy of the client-side park/shed pattern:
+    it must accept all three wire frames — load shed, whole-fleet
+    rebuilding park, and the round-20 pool-scoped rebuilding park —
+    with stable group numbering (1 = arm, 2 = retry-after ms), and the
+    optional pool tag must never let the arms blur together."""
+    cases = [
+        ("req shed retry_after_ms=40 (queue past deadline)",
+         ("shed", "40")),
+        ("rebuilding retry_after_ms=120 (rolling restart)",
+         ("rebuilding", "120")),
+        # round 20: disaggregated pool park tags the frame with the
+        # pool role; the non-capturing tag keeps group numbers stable
+        ("rebuilding pool=prefill retry_after_ms=250 (no placeable "
+         "replica in pool)", ("rebuilding", "250")),
+        ("rebuilding pool=decode retry_after_ms=75 (scale-in drain)",
+         ("rebuilding", "75")),
+    ]
+    for text, want in cases:
+        m = loadgen.SHED_RE.search(text)
+        assert m is not None, text
+        assert m.groups() == want, text
+    # a pool tag on the SHED arm would be a protocol violation today,
+    # but the regex still parses arm+ms correctly if one ever appears
+    m = loadgen.SHED_RE.search("shed pool=decode retry_after_ms=10")
+    assert m.groups() == ("shed", "10")
+    # non-frames must not match: no ms, wrong keyword, malformed tag
+    for text in ("shed", "rebuilding pool=prefill", "parked for 100ms",
+                 "rebuilding retry_after_ms=abc"):
+        assert loadgen.SHED_RE.search(text) is None, text
+
+
 def test_arrival_processes():
     from dataclasses import replace
 

@@ -14,10 +14,12 @@ re-certified on-mesh:
   * obs on/off stats bit-equality unchanged by sharding;
   * the PR-13 spill tier CERTIFIED on sharded pools: d2h -> evict ->
     prefetch -> restore round-trips bit-identical for native and int8
-    host payloads with the spill counters advancing, plus the armed-
+    host payloads with the spill counters advancing, the lossy
+    int8/int4 host formats serving end-to-end (int4 certified in
+    round 20 — it unblocks int4 handoff payloads), plus the armed-
     tier flat-h2d/zero-recompile recert on-mesh;
   * ``EngineConfigError`` arms for every still-uncertified combination
-    (pallas kernel, int4 host format, dense-draft proposer) and the
+    (pallas kernel, dense-draft proposer) and the
     indivisible head/slot sharding rejections;
   * the round-19 byte-accounting fix: ``kv_pool_device_bytes`` /
     ``device_bytes_estimate()`` sum PHYSICAL per-shard bytes
@@ -282,13 +284,19 @@ def test_spill_on_mesh_roundtrip_bit_equality(trained, mesh24, kv_dtype,
             assert np.array_equal(ref[i], run[i]), (w, i)
 
 
-def test_spill_on_mesh_int8_host_format_serves(trained, mesh24):
-    """The lossy arm: int8 HOST payloads over f32 sharded pools must
-    serve end-to-end with the counters advancing (bit-equality is not
-    the contract there — requantization error is documented)."""
+@pytest.mark.parametrize("spill_dtype", ["int8", "int4"])
+def test_spill_on_mesh_lossy_host_formats_serve(trained, mesh24,
+                                                spill_dtype):
+    """The lossy arms: int8 AND int4 (round 20 — previously rejected on
+    mesh) HOST payloads over f32 sharded pools must serve end-to-end
+    with the counters advancing (bit-equality is not the contract there
+    — requantization error is documented).  The int4 round-trip
+    exercises the full nibble-pack/unpack path against ``_spill_read``
+    gathers and ``_spill_restore`` re-placements on sharded pools —
+    the certification that unblocks int4 handoff payloads."""
     eng = PagedEngine(trained, CFG, slots=2, n_blocks=8, block_size=8,
                       max_seq=72, mesh=mesh24, prefix_index="radix",
-                      spill_blocks=16, spill_dtype="int8")
+                      spill_blocks=16, spill_dtype=spill_dtype)
     a = _cycle_prompt(17)
     _spin_waves(eng, [a])
     for f in [(np.arange(i, i + 17) % 11).astype(np.int32)
@@ -331,6 +339,46 @@ def test_spill_armed_on_mesh_steady_contracts(trained, mesh24,
     eng.run()
 
 
+def test_handoff_between_mesh_engines_bit_identical(trained, mesh24):
+    """The round-20 cross-engine handoff with mesh(2x4) engines on
+    BOTH ends: the prefill engine's export d2h-gathers SHARDED pool
+    blocks into the digest-keyed host format, the decode engine's
+    import + admission prefetch restores them into its OWN sharded
+    pools, and the resumed stream equals unified mesh serving
+    bit-for-bit — the disaggregated daemon's tensor-parallel
+    arrangement, driven at engine level."""
+    kw = dict(slots=2, n_blocks=16, block_size=8, max_seq=72,
+              prefix_index="radix", spill_blocks=16, mesh=mesh24)
+    prompt = _cycle_prompt(17)
+    uni = PagedEngine(trained, CFG, **kw)
+    rid = uni.submit(prompt, max_new=8)
+    want = uni.run()[rid]
+
+    engp = PagedEngine(trained, CFG, **kw)
+    engd = PagedEngine(trained, CFG, **kw)
+    engp.handoff_at_boundary = True
+    engp.submit(prompt, max_new=8)
+    while not engp.handoff_ready:
+        engp.step()
+    (req, payload), = engp.export_handoff()
+    assert len(payload) == 2, "17-token prompt exports 2 full blocks"
+    assert engd.import_handoff(payload) > 0
+    engd.resubmit(req, fresh_id=True)
+    (got,) = engd.run().values()
+    assert np.array_equal(want, got)
+    # the decode side actually CONSUMED the imported blocks (a silent
+    # recompute would pass bit-equality)
+    assert engd.counters["spill_prefetched"] >= 1
+    assert engp.counters["requests_done"] == 0
+    # exact accounting on both ends: the exporter released its slot's
+    # blocks (its radix keeps the registered prefix refs), the
+    # importer holds only cache-referenced blocks
+    for eng in (engp, engd):
+        cached = set(eng._radix.blocks())
+        assert len(eng.free) + len(cached) == eng.n_usable_blocks, (
+            len(eng.free), sorted(cached), eng.n_usable_blocks)
+
+
 # ------------------------------------------------ config-error arms
 def test_engine_config_error_arms(trained, mesh24):
     """Every still-uncertified combination refuses LOUDLY with
@@ -341,10 +389,9 @@ def test_engine_config_error_arms(trained, mesh24):
     with pytest.raises(EngineConfigError, match="pallas"):
         PagedEngine(trained, CFG, slots=2, n_blocks=8, block_size=8,
                     max_seq=72, mesh=mesh24, attn="pallas")
-    with pytest.raises(EngineConfigError, match="int4"):
-        PagedEngine(trained, CFG, slots=2, n_blocks=8, block_size=8,
-                    max_seq=72, mesh=mesh24, prefix_index="radix",
-                    spill_blocks=8, spill_dtype="int4")
+    # (int4 host spill on mesh was certified in round 20 — see
+    # test_spill_on_mesh_lossy_host_formats_serve — so it no longer
+    # appears here)
     # slots must split evenly over the batch axis (batch=2 here)
     with pytest.raises(EngineConfigError, match="slots"):
         PagedEngine(trained, CFG, slots=3, n_blocks=8, block_size=8,
@@ -363,13 +410,12 @@ def test_engine_config_error_arms(trained, mesh24):
 
 def test_daemon_mesh_knob_validation():
     """--mesh parses/canonicalizes at the argparse boundary: bad specs
-    and the uncertified int4-spill combo exit 2 before any build."""
+    exit 2 before any build (the int4-spill combo certified in round
+    20 and is accepted now — only malformed specs remain)."""
     from tpulab.daemon import main
 
     for argv in (["--mesh", "nope"], ["--mesh", "2x"],
-                 ["--mesh", "0x4"],
-                 ["--mesh", "2x4", "--prefix-index", "radix",
-                  "--spill-blocks", "8", "--spill-dtype", "int4"]):
+                 ["--mesh", "0x4"]):
         with pytest.raises(SystemExit) as e:
             main(argv)
         assert e.value.code == 2, argv

@@ -50,6 +50,24 @@ reassemble exactly, surviving outputs are BIT-IDENTICAL to the
 reference (migration loses/duplicates zero tokens), and a full
 rolling restart under steady load serves with zero shed requests.
 The ``goodput_chaos_*`` rows ride the same baselines ratchet.
+
+Disaggregated scenario (round 20, tools/onchip_queue_r20.sh runs):
+
+    python tools/goodput_gate.py --spawn-daemon --spec disagg \
+        --disagg --out results/goodput_disagg_r20.json \
+        --check-baselines
+
+replays the heavy-tail trace twice — against a unified single-engine
+daemon (reference outputs + the decode-latency floor), then against a
+phase-disaggregated fleet (``--pool-spec prefill=1..2,decode=1``)
+where every prompt prefills in the prefill pool and hands its KV
+blocks to the decode pool through the digest-keyed host tier — and
+gates: handoffs fired, decode ITL p99 flat vs unified while the long
+prefills saturate the prefill pool, attainment 1.0, every stream
+bit-identical to unified serving, zero leaked blocks in both pools,
+and the prefill pool scaling on its own queue-wait signal while the
+decode pool holds its floor.  ``goodput_disagg_*`` rows ride the
+same ratchet.
 """
 
 from __future__ import annotations
@@ -91,7 +109,9 @@ _COUNTERS = ("daemon_shed_requests", "daemon_replays",
              # round 17: the elastic-fleet surface
              "daemon_scale_outs", "daemon_scale_ins",
              "daemon_spot_preemptions", "daemon_brownout_steps",
-             "daemon_brownout_reversals")
+             "daemon_brownout_reversals",
+             # round 20: the disaggregated prefill/decode handoff
+             "daemon_handoffs", "handoff_bytes")
 
 #: the chaos fault schedule (--chaos, replayed via TPULAB_FAULTS in
 #: the spawned daemon's environment): CRASH replica1 mid-trace (its
@@ -259,11 +279,16 @@ def rolling_restart(rep, sock: str, n_replicas: int, log) -> dict:
                 with lock:
                     tally["ok"] += 1
             except (RuntimeError, OSError, ConnectionError) as e:
-                msg = str(e)
+                # classify through THE shed/park pattern
+                # (loadgen.SHED_RE) rather than a private substring:
+                # round 20's pool-scoped park frame ("rebuilding
+                # pool=<role> retry_after_ms=N") must tally as
+                # rebuilding, not as a hard error
+                m = loadgen.SHED_RE.search(str(e))
                 with lock:
-                    if "shed retry_after_ms" in msg:
+                    if m is not None and m.group(1) == "shed":
                         tally["shed"] += 1
-                    elif "rebuilding retry_after_ms" in msg:
+                    elif m is not None:
                         tally["rebuilding"] += 1
                     else:
                         tally["errors"] += 1
@@ -297,6 +322,38 @@ def rolling_restart(rep, sock: str, n_replicas: int, log) -> dict:
         for t in threads:
             t.join(timeout=10)
     return tally
+
+
+def leaked_blocks(after: dict) -> dict:
+    """Per-replica leaked-block census from a QUIESCED after-scrape
+    (--disagg): once every stream has completed, each engine's used
+    blocks must be exactly the blocks its prefix cache holds references
+    on — anything above that is a block the handoff path allocated and
+    never released.  The scrape carries ``engine_blocks_used_replica<i>``
+    plus the cache's byte footprint; block size falls out of the pool
+    bytes (``blocks_total`` is the USABLE count — one block of the
+    constructor's pool is reserved, hence the +1)."""
+    import re as _re
+
+    leaks = {}
+    for key, metric in after.items():
+        m = _re.match(r"engine_blocks_used_replica(\d+)$", key)
+        if not m:
+            continue
+        i = m.group(1)
+
+        def g(name):
+            return int(after.get(f"engine_{name}_replica{i}",
+                                 {}).get("value") or 0)
+
+        used, total, pool = g("blocks_used"), g("blocks_total"), \
+            g("kv_pool_bytes")
+        if total <= 0 or pool <= 0:
+            continue  # retired slot: the stale-gauge sweep zeroed it
+        block_bytes = pool // (total + 1)
+        cached = (g("cache_bytes") // block_bytes) if block_bytes else 0
+        leaks[f"replica{i}"] = used - cached
+    return leaks
 
 
 def compare_streams(ref_results: list, chaos_results: list):
@@ -567,7 +624,32 @@ def main(argv=None) -> int:
                          "disabled reference (use with --spec prefix)")
     ap.add_argument("--spill-blocks", type=int, default=512, metavar="N",
                     help="host spill-tier capacity (blocks) for the "
-                         "armed daemon in the --prefix-cache scenario")
+                         "armed daemon in the --prefix-cache scenario "
+                         "and BOTH daemons of the --disagg scenario")
+    ap.add_argument("--disagg", action="store_true",
+                    help="disaggregated-serving certification (round "
+                         "20): replay the trace against a UNIFIED "
+                         "single-engine daemon (same radix + spill "
+                         "config — the reference outputs and the "
+                         "decode-latency floor), then again against a "
+                         "phase-disaggregated fleet (--pool-spec) "
+                         "where every request prefills in the prefill "
+                         "pool and hands its KV blocks to the decode "
+                         "pool over the host spill tier — gate on "
+                         "handoffs actually firing, decode ITL p99 "
+                         "staying flat vs the unified reference while "
+                         "the heavy-tail prefills run, attainment 1.0, "
+                         "every stream bit-identical to the reference, "
+                         "zero leaked blocks in BOTH pools, and the "
+                         "prefill pool scaling on its own signal while "
+                         "the decode pool holds its floor (use with "
+                         "--spec disagg)")
+    ap.add_argument("--pool-spec", default="prefill=1..2,decode=1",
+                    metavar="SPEC",
+                    help="pool layout handed to the disaggregated "
+                         "daemon in the --disagg scenario (the default "
+                         "gives the prefill pool scale-out headroom "
+                         "and pins the decode pool)")
     ap.add_argument("--kill-at", type=float, default=0.4, metavar="F",
                     help="when to SIGKILL, as a fraction of the "
                          "reference replay's wall time (default 0.4)")
@@ -614,6 +696,7 @@ def main(argv=None) -> int:
     kill = None
     autoscale = None
     prefix_cache = None
+    disagg = None
     if args.autoscale and (args.chaos or args.kill_daemon):
         ap.error("--autoscale is its own scenario: run --chaos/"
                  "--kill-daemon as separate invocations")
@@ -621,6 +704,11 @@ def main(argv=None) -> int:
                               or args.autoscale):
         ap.error("--prefix-cache is its own scenario: run --chaos/"
                  "--kill-daemon/--autoscale as separate invocations")
+    if args.disagg and (args.chaos or args.kill_daemon
+                        or args.autoscale or args.prefix_cache):
+        ap.error("--disagg is its own scenario: run --chaos/"
+                 "--kill-daemon/--autoscale/--prefix-cache as "
+                 "separate invocations")
     if args.kill_daemon:
         if not args.spawn_daemon:
             ap.error("--kill-daemon needs --spawn-daemon (the gate "
@@ -772,6 +860,60 @@ def main(argv=None) -> int:
             "spill_admission_hits": _gdelta(run, "engine_spill_hits"),
             "reference_attainment": ref_overall["attainment"],
             "reference_wall_s": round(ref["wall_s"], 3)}
+    elif args.disagg:
+        if not args.spawn_daemon:
+            ap.error("--disagg needs --spawn-daemon (the unified "
+                     "reference and pooled replays each own a private "
+                     "daemon)")
+        if args.replicas != 1:
+            ap.error("--disagg measures the pooled fleet against a "
+                     "UNIFIED single-engine reference: use --replicas 1")
+        if args.spill_blocks < 1:
+            ap.error("--spill-blocks must be >= 1 (the handoff wire "
+                     "format IS the host spill tier)")
+        # UNIFIED reference first, with the SAME radix + spill config
+        # the pooled fleet runs (the only variable under test is WHERE
+        # each phase executes): its per-request shas are the
+        # bit-equality contract and its decode ITL p99 is the
+        # latency floor the disaggregated fleet must not degrade —
+        # on the unified engine the heavy-tail prefills time-share the
+        # one engine with every decoding stream, which is exactly the
+        # interference disaggregation removes.
+        cache_args = ["--prefix-index", "radix",
+                      "--spill-blocks", str(args.spill_blocks)]
+        ref = run_replay(args, rep, trace, label="[unified] ",
+                         extra_args=cache_args)
+        run = run_replay(
+            args, rep, trace, label="[disagg] ",
+            extra_args=cache_args + [
+                "--pool-spec", args.pool_spec,
+                # a tight control-loop cadence so the prefill pool's
+                # queue-wait burn can act within the trace window
+                "--metrics-interval", "0.5"])
+        compared, mismatches = compare_streams(ref["results"],
+                                               run["results"])
+        ref_win = window_percentiles(ref["before"], ref["after"])
+        run_win = window_percentiles(run["before"], run["after"])
+        ref_itl = (ref_win.get("itl_seconds") or {}).get("p99_ms")
+        run_itl = (run_win.get("itl_seconds") or {}).get("p99_ms")
+        # "flat within noise": the CPU proxy's bucket-granular p99 and
+        # scheduler jitter need both a relative band and an absolute
+        # floor — a 2 ms reference p99 must not fail on a 3 ms reading
+        itl_budget = (max(1.5 * ref_itl, ref_itl + 50.0)
+                      if ref_itl is not None else None)
+        ref_overall = loadgen.summarize(
+            ref["results"], trace, ref["wall_s"])["overall"]
+        disagg = {
+            "pool_spec": args.pool_spec,
+            "spill_blocks": args.spill_blocks,
+            "compared": compared, "mismatches": mismatches,
+            "reference_itl_p99_ms": ref_itl,
+            "disagg_itl_p99_ms": run_itl,
+            "itl_budget_ms": (round(itl_budget, 3)
+                              if itl_budget is not None else None),
+            "leaked_blocks": leaked_blocks(run["after"]),
+            "reference_attainment": ref_overall["attainment"],
+            "reference_wall_s": round(ref["wall_s"], 3)}
     else:
         run = run_replay(args, rep, trace,
                          rolling=args.rolling_restart)
@@ -800,6 +942,8 @@ def main(argv=None) -> int:
         report["autoscale"] = autoscale
     if prefix_cache is not None:
         report["prefix_cache"] = prefix_cache
+    if disagg is not None:
+        report["disagg"] = disagg
     if run["roll"] is not None:
         report["rolling_restart"] = run["roll"]
     if args.out:
@@ -1049,6 +1193,92 @@ def main(argv=None) -> int:
               f"{pc['hbm_hit_rate']} -> {pc['spill_hit_rate']}, "
               f"{pc['spilled_blocks']} spill(s) / "
               f"{pc['prefetched_blocks']} prefetch(es)",
+              file=sys.stderr, flush=True)
+    if disagg is not None:
+        # disagg acceptance: KV actually crossed the engine boundary,
+        # the decode pool's latency held flat against the unified
+        # reference while the heavy-tail prefills ran, every stream is
+        # bit-identical to unified serving, neither pool leaked a
+        # block, and the prefill pool scaled on its OWN signal while
+        # the decode pool held its fixed size.
+        counters = report["counters"]
+        if counters.get("daemon_handoffs", 0) < 1:
+            print("[goodput_gate] FAIL: no request was ever handed "
+                  "off (daemon_handoffs delta 0) — the pools never "
+                  "exchanged work and the run proved nothing",
+                  file=sys.stderr, flush=True)
+            rc = 1
+        if counters.get("handoff_bytes", 0) < 1:
+            print("[goodput_gate] FAIL: no KV byte crossed the engine "
+                  "boundary (handoff_bytes delta 0)",
+                  file=sys.stderr, flush=True)
+            rc = 1
+        if counters.get("daemon_scale_outs", 0) < 1:
+            print("[goodput_gate] FAIL: the prefill pool never scaled "
+                  "out (daemon_scale_outs delta 0) — the heavy-tail "
+                  "prefills never drove the pool's queue-wait burn",
+                  file=sys.stderr, flush=True)
+            rc = 1
+        roles = [r.get("role") for r in
+                 (run["fleet"] or {}).get("replica", [])
+                 if not r.get("retired")]
+        n_decode = sum(1 for r in roles if r == "decode")
+        if n_decode != 1:
+            print(f"[goodput_gate] FAIL: the fixed decode pool ended "
+                  f"at {n_decode} replica(s), not 1 — pool scaling "
+                  f"was not independent (roles: {roles})",
+                  file=sys.stderr, flush=True)
+            rc = 1
+        if (disagg["itl_budget_ms"] is not None
+                and disagg["disagg_itl_p99_ms"] is not None
+                and disagg["disagg_itl_p99_ms"]
+                > disagg["itl_budget_ms"]):
+            print(f"[goodput_gate] FAIL: decode ITL p99 "
+                  f"{disagg['disagg_itl_p99_ms']}ms is not flat vs "
+                  f"the unified reference "
+                  f"{disagg['reference_itl_p99_ms']}ms (budget "
+                  f"{disagg['itl_budget_ms']}ms)",
+                  file=sys.stderr, flush=True)
+            rc = 1
+        if overall["attainment"] != 1.0:
+            print(f"[goodput_gate] FAIL: attainment "
+                  f"{overall['attainment']} != 1.0 across the handoffs",
+                  file=sys.stderr, flush=True)
+            rc = 1
+        incomplete = [r for r in results
+                      if not r["cancelled"] and not r["ok"]][:3]
+        if incomplete:
+            print(f"[goodput_gate] FAIL: non-cancelled request(s) did "
+                  f"not complete across the handoff, e.g. {incomplete}",
+                  file=sys.stderr, flush=True)
+            rc = 1
+        torn = [r for r in results
+                if r["ok"] and r.get("stream_ok") is False][:3]
+        if torn:
+            print(f"[goodput_gate] FAIL: streamed chunks do not "
+                  f"reassemble to the terminal output (lost/duplicated "
+                  f"tokens), e.g. {torn}", file=sys.stderr, flush=True)
+            rc = 1
+        if disagg["mismatches"]:
+            print(f"[goodput_gate] FAIL: {len(disagg['mismatches'])} "
+                  f"stream(s) diverged from unified serving, e.g. "
+                  f"{disagg['mismatches'][:3]}",
+                  file=sys.stderr, flush=True)
+            rc = 1
+        leaked = {k: v for k, v in disagg["leaked_blocks"].items()
+                  if v != 0}
+        if leaked:
+            print(f"[goodput_gate] FAIL: leaked KV blocks after "
+                  f"quiesce: {leaked}", file=sys.stderr, flush=True)
+            rc = 1
+        print(f"[goodput_gate] disagg: {disagg['compared']} streams "
+              f"bit-compared vs unified, "
+              f"{counters.get('daemon_handoffs', 0)} handoff(s) / "
+              f"{counters.get('handoff_bytes', 0)} byte(s), ITL p99 "
+              f"{disagg['reference_itl_p99_ms']}ms -> "
+              f"{disagg['disagg_itl_p99_ms']}ms, "
+              f"{counters.get('daemon_scale_outs', 0)} prefill "
+              f"scale-out(s), decode pool fixed at {n_decode}",
               file=sys.stderr, flush=True)
     if run["roll"] is not None:
         roll = run["roll"]

@@ -1,0 +1,80 @@
+#!/bin/bash
+# Shared scaffolding for the per-round on-chip evidence queues
+# (tools/onchip_queue_r*.sh).  Factored out in round 20: every queue
+# since r10 hand-copied the same cd/log-dir/stage()/ratchet/re-sign
+# boilerplate; one drifting copy per round is how the r14 queue lost
+# its status timestamps.  This file deliberately does NOT match the
+# tools/onchip_queue*.sh lint glob (tests/test_faults.py), so it may
+# hold helpers while every queue script stays subject to the
+# source-relay_lib/no-local-wait_relay checks.
+#
+# Claim discipline (docs/tpu_runs.md): TPU-claiming processes are
+# WAITED on, never killed -- a killed claim wedges the relay for every
+# later process.  wait_relay comes from tools/relay_lib.sh (the ONE
+# copy); queue scripts get it transitively by sourcing this lib.
+#
+# Usage from a queue script:
+#   . "$(dirname "$0")/onchip_lib.sh"    # sources relay_lib.sh
+#   onchip_init                          # cd repo, L=results/logs, stamp
+#   host_stage <name> <cmd...>           # ungated: host-only evidence
+#   stage <name> <cmd...>                # relay-gated: on-chip evidence
+#   ratchet <rows.jsonl> <date-label>    # regression verdict + ratchet
+#   resign                               # re-sign mutated artifacts
+#   onchip_done                          # final status stamp
+
+cd /root/repo || exit 1
+L=results/logs
+
+. "$(dirname "$0")/relay_lib.sh"
+
+onchip_init() {
+  mkdir -p "$L"
+  date > "$L/queue.status"
+}
+
+# host_stage <name> <cmd...> -- NO relay gate: host-only tiers (CPU
+# backend, forced virtual devices) must land their evidence even with
+# the relay down.  Same log/status shape as stage() so queue.status
+# reads uniformly.
+host_stage() {
+  name=$1; shift
+  echo "== $name start $(date)" >> "$L/queue.status"
+  "$@" > "$L/$name.log" 2>&1
+  echo "== $name rc=$? $(date)" >> "$L/queue.status"
+}
+
+# stage <name> <cmd...> -- relay-gated: waits for the TPU relay before
+# claiming the chip; a skipped stage is recorded, never retried blind.
+stage() {
+  name=$1; shift
+  echo "== $name wait-relay $(date)" >> "$L/queue.status"
+  if ! wait_relay; then
+    echo "== $name SKIPPED (relay unreachable) $(date)" >> "$L/queue.status"
+    return 1
+  fi
+  echo "== $name start $(date)" >> "$L/queue.status"
+  "$@" > "$L/$name.log" 2>&1
+  echo "== $name rc=$? $(date)" >> "$L/queue.status"
+}
+
+# ratchet <rows.jsonl> <date-label> -- mechanical regression verdict +
+# baseline ratchet in ONE pass (host-only JSON diff, never gated).
+ratchet() {
+  rows=$1; label=$2
+  python tools/check_regression.py "$rows" --update --date "$label" \
+      > "$L/regression_$(basename "$rows" .jsonl).log" 2>&1
+  echo "== regression+ratchet($(basename "$rows")) rc=$? $(date)" \
+      >> "$L/queue.status"
+}
+
+# resign -- stages above rewrite signed artifacts (baselines.json under
+# --update; pallas_tpu_parity.json); signatures must track them or
+# tests/test_signing.py reds.  Host-only, never gated.
+resign() {
+  python tools/sign_artifacts.py sign > "$L/resign.log" 2>&1
+  echo "== resign rc=$? $(date)" >> "$L/queue.status"
+}
+
+onchip_done() {
+  echo "QUEUE DONE $(date)" >> "$L/queue.status"
+}

@@ -1,0 +1,59 @@
+#!/bin/bash
+# Round-20 sequential on-chip evidence queue (single chip -- no
+# contention).  First round built on tools/onchip_lib.sh (which sources
+# relay_lib.sh -- the one wait_relay copy; claim discipline per
+# docs/tpu_runs.md: TPU-claiming processes are WAITED on, never
+# killed).
+#
+# Round-20 ordering: the DISAGGREGATED-FLEET evidence lands FIRST and
+# is HOST-ONLY (CPU backend), so a wedged relay cannot block the
+# round's headline evidence:
+#   * disagg_gate: tools/goodput_gate.py --disagg -- unified vs
+#     prefill/decode-pooled daemon A/B over the heavy-tail disagg
+#     trace: bit-identical streams, ITL p99 within the noise band,
+#     attainment 1.0, daemon_handoffs/handoff_bytes advancing, >= 1
+#     prefill-pool scale event with the decode pool untouched, zero
+#     leaked KV blocks from the per-replica block census.
+#   * disagg_tests: tests/test_disagg.py + the handoff chaos drill in
+#     tests/test_faults.py + the mesh(2,4)-both-ends handoff recert.
+#   * handoff_bench: bench.py bench_handoff_overhead -- the
+#     export/import/resubmit A/B against a unified engine, ratcheting
+#     the signed handoff_overhead_e2e_tokens_per_s row (< 3% budget).
+# Only then the relay-gated tail (r19 ordering preserved).
+
+. "$(dirname "$0")/onchip_lib.sh"   # sources relay_lib.sh
+onchip_init
+
+# -- disaggregated-fleet tier: HOST-ONLY, no relay gate
+host_stage disagg_gate env JAX_PLATFORMS=cpu \
+    python tools/goodput_gate.py --spawn-daemon --spec disagg --disagg \
+    --replicas 1 --spill-blocks 512 \
+    --out results/goodput_disagg_r20.json
+host_stage disagg_tests env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_disagg.py \
+    "tests/test_faults.py::test_handoff_crash_replays_from_journaled_prompt" \
+    tests/test_mesh_serving.py -q -m 'not slow' -p no:cacheprovider
+host_stage handoff_bench env JAX_PLATFORMS=cpu python -c "
+import json
+from tpulab.bench import bench_handoff_overhead
+print(json.dumps(bench_handoff_overhead()))"
+# the gate prints its baselines rows to stdout (the stage log); the
+# bench prints its single row the same way -- merge, newest-unique
+grep -h '"metric"' "$L/disagg_gate.log" "$L/handoff_bench.log" \
+    2>/dev/null | awk '!seen[$0]++' > results/disagg_rows_r20.jsonl || true
+ratchet results/disagg_rows_r20.jsonl \
+    "round 20 (onchip_queue_r20, disaggregated-fleet tier)"
+
+# -- the relay-gated tail, round-19 ordering preserved
+stage serving_int    python tools/serving_tpu.py
+stage bench_r20      python bench.py --skip-probe
+grep -h '"metric"' "$L/bench_r20.log" 2>/dev/null \
+    | awk '!seen[$0]++' > results/bench_r20.jsonl || true
+stage parity         python tools/pallas_tpu_parity.py
+stage flash_train    python tools/flash_train_proof.py
+stage mfu_probe      python tools/train_mfu_probe.py
+stage ref_harness2   python tools/run_reference_harness.py --backend tpu --lab lab2 --k-times 5
+stage ref_harness3   python tools/run_reference_harness.py --backend tpu --lab lab3 --k-times 5
+ratchet results/bench_r20.jsonl "round 20 (onchip_queue_r20)"
+resign
+onchip_done

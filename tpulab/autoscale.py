@@ -61,13 +61,20 @@ class Signals:
     replicas; ``queue_wait_p99_s`` is the history-window p99 (None
     when the window holds no queue-wait samples yet); ``shed_rate``
     is sheds/s over the window; ``alerts_firing`` counts FIRING
-    pressure alerts (the burn-rate rules the daemon feeds in)."""
+    pressure alerts (the burn-rate rules the daemon feeds in).
+
+    ``latency_p99_s`` (round 20, disaggregated pools) carries a
+    pool-specific latency percentile — the daemon feeds ITL p99 for a
+    decode pool, leaves it None for prefill/unified pools (whose
+    pressure signal stays queue-wait).  Only policies constructed
+    with ``latency_high_s`` act on it."""
 
     active_replicas: int
     load_per_replica: float = 0.0
     queue_wait_p99_s: Optional[float] = None
     shed_rate: float = 0.0
     alerts_firing: int = 0
+    latency_p99_s: Optional[float] = None
 
 
 class AutoscalePolicy:
@@ -95,6 +102,7 @@ class AutoscalePolicy:
     def __init__(self, min_replicas: int, max_replicas: int, *,
                  load_high: float = 4.0, load_low: float = 1.0,
                  queue_wait_high_s: float = 0.5,
+                 latency_high_s: Optional[float] = None,
                  out_after: int = 2, in_after: int = 4,
                  out_cooldown_s: float = 2.0, in_cooldown_s: float = 6.0):
         if min_replicas < 1:
@@ -114,6 +122,14 @@ class AutoscalePolicy:
         self.load_high = float(load_high)
         self.load_low = float(load_low)
         self.queue_wait_high_s = float(queue_wait_high_s)
+        if latency_high_s is not None and latency_high_s <= 0:
+            raise ValueError(
+                f"latency_high_s must be > 0, got {latency_high_s}")
+        #: optional pool-latency threshold (round 20): a decode pool's
+        #: policy arms this with the ITL burn mark; None (the default,
+        #: and every pre-round-20 caller) ignores Signals.latency_p99_s
+        self.latency_high_s = (None if latency_high_s is None
+                               else float(latency_high_s))
         self.out_after = int(out_after)
         self.in_after = int(in_after)
         self.out_cooldown_s = float(out_cooldown_s)
@@ -136,6 +152,10 @@ class AutoscalePolicy:
         if (sig.queue_wait_p99_s is not None
                 and sig.queue_wait_p99_s >= self.queue_wait_high_s):
             return True
+        if (self.latency_high_s is not None
+                and sig.latency_p99_s is not None
+                and sig.latency_p99_s >= self.latency_high_s):
+            return True
         return sig.load_per_replica >= self.load_high
 
     def underloaded(self, sig: Signals) -> bool:
@@ -143,6 +163,13 @@ class AutoscalePolicy:
             return False
         if (sig.queue_wait_p99_s is not None
                 and sig.queue_wait_p99_s >= 0.5 * self.queue_wait_high_s):
+            return False
+        if (self.latency_high_s is not None
+                and sig.latency_p99_s is not None
+                and sig.latency_p99_s >= 0.5 * self.latency_high_s):
+            # same half-mark hysteresis as queue-wait: a pool whose
+            # latency sits between half and full threshold is
+            # ambiguous, not shrinkable
             return False
         return sig.load_per_replica <= self.load_low
 

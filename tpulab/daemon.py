@@ -303,6 +303,25 @@ BROWNOUT_TOKEN_CAP = int(
 AUTOSCALE_WINDOW_S = float(
     os.environ.get("TPULAB_DAEMON_AUTOSCALE_WINDOW_S", "15"))
 
+#: disaggregated serving (round 20): ``--pool-spec`` / this env assigns
+#: pool ROLES to the fleet's replicas instead of the uniform unified
+#: fleet.  Syntax: comma-separated ``role=N`` (fixed) or
+#: ``role=MIN..MAX`` (independently autoscaled pool) with role in
+#: {prefill, decode, unified}, e.g. ``prefill=1..2,decode=1``.  A
+#: prefill replica admits new requests and exports their KV at the
+#: PREFILLING->DECODING edge; a decode replica imports those blocks
+#: through its spill tier's admission prefetch and serves the decode.
+#: Requires ``--prefix-index radix --spill-blocks > 0`` (the handoff
+#: rides the digest-keyed host-block wire format); "" (default) keeps
+#: the pre-round-20 unified fleet bit-identically.
+POOL_SPEC = os.environ.get("TPULAB_DAEMON_POOL_SPEC", "")
+
+#: decode-pool ITL burn mark (seconds): the decode pool's autoscale
+#: policy treats a window ITL p99 at/above this as overload evidence
+#: (queue-wait burn stays the prefill/unified pools' signal)
+POOL_ITL_HIGH_S = float(
+    os.environ.get("TPULAB_DAEMON_POOL_ITL_HIGH_S", "0.5"))
+
 #: fault-tolerance counters (process-global registry, in every
 #: ``metrics`` scrape): engine step loops quarantined+rebuilt, requests
 #: replayed into a rebuilt engine, and requests shed with retry-after
@@ -388,6 +407,35 @@ _G_BROWNOUT_LEVEL = _obs.gauge(
     "daemon_brownout_level",
     "current brownout ladder level (0 = healthy, 4 = every rung "
     "engaged), worst across armed fleets")
+#: disaggregated-serving counters/gauges (round 20): every cross-engine
+#: KV handoff is counted with its wire bytes, and the per-pool gauges
+#: make each pool's serving vs target replica count scrapeable
+_C_HANDOFFS = _obs.counter(
+    "daemon_handoffs",
+    "requests handed off prefill-engine -> decode-engine at the "
+    "PREFILLING->DECODING edge (KV blocks exported, imported through "
+    "the peer's spill tier, stream resumed there)")
+_C_HANDOFF_BYTES = _obs.counter(
+    "handoff_bytes",
+    "encoded KV payload bytes accepted by decode-side spill tiers "
+    "across all handoffs (the wire size in the configured spill "
+    "dtype, quantization included)")
+_G_POOL_PREFILL_REPLICAS = _obs.gauge(
+    "pool_prefill_replicas",
+    "serving (non-retired) replicas currently in the prefill pool "
+    "(0 = no disaggregated fleet armed)")
+_G_POOL_PREFILL_TARGET = _obs.gauge(
+    "pool_prefill_target",
+    "the prefill pool's autoscale target replica count (its floor "
+    "when the pool is fixed-size)")
+_G_POOL_DECODE_REPLICAS = _obs.gauge(
+    "pool_decode_replicas",
+    "serving (non-retired) replicas currently in the decode pool "
+    "(0 = no disaggregated fleet armed)")
+_G_POOL_DECODE_TARGET = _obs.gauge(
+    "pool_decode_target",
+    "the decode pool's autoscale target replica count (its floor "
+    "when the pool is fixed-size)")
 
 
 def _record_postmortem(reason: str, engine, err) -> None:
@@ -430,6 +478,24 @@ class RebuildingError(ShedError):
         # skip ShedError.__init__'s "shed " prefix
         RuntimeError.__init__(
             self, f"rebuilding retry_after_ms={self.retry_after_ms} ({why})")
+
+
+class PoolRebuildingError(RebuildingError):
+    """Pool-scoped park timed out (round 20, disaggregated serving):
+    the fleet has placeable replicas, but every replica of the POOL the
+    request's phase needs (e.g. the prefill pool for a new admission)
+    is draining/quarantined/rebuilding.  Rendered as ``rebuilding
+    pool=<role> retry_after_ms=<int>`` — the same retry-after contract
+    (tpulab.loadgen.SHED_RE tolerates the pool tag), with the starved
+    pool named so a client/operator can tell a one-pool brownout from a
+    whole-fleet park."""
+
+    def __init__(self, retry_after_ms: int, role: str, why: str):
+        self.retry_after_ms = int(retry_after_ms)
+        self.role = role
+        RuntimeError.__init__(
+            self, f"rebuilding pool={role} "
+                  f"retry_after_ms={self.retry_after_ms} ({why})")
 
 
 #: serializes the remaining host-orchestrated single-stream strategy
@@ -1102,17 +1168,24 @@ class _Replica:
 
     ``cond``-guarded: engine, tickets, stepper_alive, dead.
     ``fleet.cv``-guarded: health, draining, drain_pending, generation,
-    restarts, parked."""
+    restarts, parked.  ``role`` is fixed at slot creation and survives
+    rebuild/retire/revive — a slot never changes pools."""
 
-    def __init__(self, fleet, index, engine, tok):
+    def __init__(self, fleet, index, engine, tok,
+                 role: str = _router.ROLE_UNIFIED):
         self.fleet = fleet
         self.index = index
         self.scope = f"replica{index}"
+        self.role = role
         self.cond = threading.Condition()
         self.engine = engine
         self.tok = tok
         engine.replica_index = index
         engine.fault_scope = self.scope
+        if role == _router.ROLE_PREFILL:
+            # a prefill-pool engine parks finished prefills for export
+            # instead of decoding them (round 20 handoff)
+            engine.handoff_at_boundary = True
         self.tickets: dict = {}       # engine req_id -> _Ticket
         self.stepper_alive = False
         #: True between a failure harvest and the rebuild's engine
@@ -1184,6 +1257,12 @@ class _Fleet:
         self.autoscaler = None
         self.brownout = None
         self.scaling = False          # one reconcile op in flight (cv)
+        # round 20 (disaggregated serving): pool table keyed by role —
+        # {"min": int, "max": int, "policy": AutoscalePolicy|None} per
+        # role from --pool-spec.  Empty on a unified fleet (every fleet
+        # before round 20): placement stays phase-blind and all the
+        # handoff machinery stays inert.
+        self.pools: dict = {}
         if AUTOSCALE_MAX >= 1:
             from tpulab import autoscale as _autoscale
 
@@ -1192,8 +1271,9 @@ class _Fleet:
             self.brownout = _autoscale.BrownoutLadder(
                 token_cap=BROWNOUT_TOKEN_CAP)
 
-    def add(self, engine, tok) -> "_Replica":
-        r = _Replica(self, len(self.replicas), engine, tok)
+    def add(self, engine, tok,
+            role: str = _router.ROLE_UNIFIED) -> "_Replica":
+        r = _Replica(self, len(self.replicas), engine, tok, role=role)
         self.replicas.append(r)
         if self.tok is None:
             self.tok = tok
@@ -1202,26 +1282,91 @@ class _Fleet:
     # round 17: the elastic surface.  Thin delegations so the policy
     # loop (and tests) drive fleet shape through the fleet object; the
     # mechanics (locking, migration, release) live on _FleetService.
-    def add_replica(self) -> int:
+    def add_replica(self, role: Optional[str] = None) -> int:
         """Scale-out: spawn + warm a fresh replica (or revive a
         retired slot through the rebuild lifecycle, replaying any
         stragglers a preemption parked there) and place it into
-        service.  Blocking — run it from a reconcile thread."""
-        return _FLEET_SERVICE.scale_out(self)
+        service.  ``role`` pins the new capacity to one pool on a
+        disaggregated fleet.  Blocking — run it from a reconcile
+        thread."""
+        return _FLEET_SERVICE.scale_out(self, role=role)
 
     def retire_replica(self, index: Optional[int] = None,
-                       deadline_s: Optional[float] = None):
+                       deadline_s: Optional[float] = None,
+                       role: Optional[str] = None):
         """Scale-in: drain the least-loaded replica (or ``index``),
         migrate its in-flight requests to peers (PR-8 path, greedy
         streams bit-identical), release its engine.  Returns the
         retired index, or None when nothing is retirable (floor of
         one serving replica)."""
         return _FLEET_SERVICE.scale_in(self, index,
-                                       deadline_s=deadline_s)
+                                       deadline_s=deadline_s,
+                                       role=role)
 
 
-def _make_fleet(builder, n: int, key=None, stamp=None) -> _Fleet:
+def _parse_pool_spec(spec: str) -> list:
+    """Parse a ``--pool-spec`` string into ``[(role, min, max), ...]``.
+
+    Syntax: comma-separated ``role=N`` (fixed size) or ``role=MIN..MAX``
+    (independently autoscaled between the bounds), roles from
+    ``router.ROLES`` — e.g. ``prefill=1..2,decode=1``.  Order is the
+    replica-index assignment order.  Raises ValueError on an unknown
+    role, a duplicate role, or a non-positive/inverted range."""
+    pools = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        role, eq, rng = part.partition("=")
+        role = role.strip()
+        if not eq or role not in _router.ROLES:
+            raise ValueError(
+                f"pool spec {part!r}: expected role=N or role=MIN..MAX "
+                f"with role in {_router.ROLES}")
+        if any(r == role for r, _, _ in pools):
+            raise ValueError(f"pool spec: duplicate role {role!r}")
+        try:
+            if ".." in rng:
+                lo, hi = rng.split("..", 1)
+                mn, mx = int(lo), int(hi)
+            else:
+                mn = mx = int(rng)
+        except ValueError:
+            raise ValueError(
+                f"pool spec {part!r}: bounds must be integers") from None
+        if mn < 1 or mx < mn:
+            raise ValueError(
+                f"pool spec {part!r}: need 1 <= MIN <= MAX")
+        pools.append((role, mn, mx))
+    if not pools:
+        raise ValueError("pool spec is empty")
+    return pools
+
+
+def _make_fleet(builder, n: int, key=None, stamp=None,
+                pools=None) -> _Fleet:
     fleet = _Fleet(builder, key=key, stamp=stamp)
+    if pools is None and POOL_SPEC:
+        pools = _parse_pool_spec(POOL_SPEC)
+    if pools:
+        # disaggregated fleet: MIN replicas per pool in spec order;
+        # each ranged pool gets its OWN policy off its own burn signal
+        # (queue-wait p99 for prefill — admission pressure; ITL p99
+        # for decode — the latency the pool exists to protect)
+        from tpulab import autoscale as _autoscale
+        for role, mn, mx in pools:
+            pol = None
+            if mx > mn:
+                pol = _autoscale.AutoscalePolicy(
+                    mn, mx,
+                    latency_high_s=(POOL_ITL_HIGH_S
+                                    if role == _router.ROLE_DECODE
+                                    else None))
+            fleet.pools[role] = {"min": mn, "max": mx, "policy": pol}
+            for _ in range(mn):
+                eng, tok = builder()
+                fleet.add(eng, tok, role=role)
+        return fleet
     for _ in range(max(1, int(n))):
         eng, tok = builder()
         fleet.add(eng, tok)
@@ -1249,9 +1394,9 @@ class _FleetService:
         views = []
         with fleet.cv:
             cand = [(r, r.health.placeable and not r.draining,
-                     r.health.state == _router.SUSPECT)
+                     r.health.state == _router.SUSPECT, r.role)
                     for r in fleet.replicas if r.index not in exclude]
-        for r, placeable, suspect in cand:
+        for r, placeable, suspect, role in cand:
             if not placeable:
                 continue
             with r.cond:
@@ -1267,13 +1412,24 @@ class _FleetService:
                     # the entry IS being matched)
                     affinity = len(eng._lookup_prefix(prompt)[0])
             views.append(_router.ReplicaView(
-                r.index, True, suspect, load, affinity))
+                r.index, True, suspect, load, affinity, role=role))
         return views
 
-    def _place(self, fleet: _Fleet, prompt,
-               exclude=frozenset()) -> Optional[_Replica]:
-        idx = _router.choose_replica(self._views(fleet, prompt, exclude))
+    def _place(self, fleet: _Fleet, prompt, exclude=frozenset(),
+               phase: Optional[str] = None) -> Optional[_Replica]:
+        idx = _router.choose_replica(
+            self._views(fleet, prompt, exclude), phase=phase)
         return None if idx is None else fleet.replicas[idx]
+
+    @staticmethod
+    def _entry_phase(fleet: _Fleet) -> Optional[str]:
+        """Placement phase for a request ENTERING the fleet — a fresh
+        submit or any replay/migration, both of which start with a
+        prefill: the prefill pool on a disaggregated fleet (the work
+        hands off at the phase boundary like any other admission, so
+        decode replicas never run long prefills), phase-blind
+        otherwise."""
+        return _router.ROLE_PREFILL if fleet.pools else None
 
     # ---------------------------------------------------------- submission
     def _ensure_stepper_locked(self, replica: _Replica) -> None:
@@ -1336,13 +1492,21 @@ class _FleetService:
         a fleet with NO placeable replica (rolling restart's worst
         case) parks up to ``REBUILD_PARK_S`` on the fleet condition,
         then answers the parseable ``rebuilding retry_after_ms=N``
-        frame clients retry on."""
+        frame clients retry on.  On a disaggregated fleet admissions
+        place into the PREFILL pool, and a park that times out with
+        the fleet's OTHER pools still placeable answers the
+        pool-scoped ``rebuilding pool=<role> retry_after_ms=N``
+        frame instead — same client retry contract, sharper
+        operator signal."""
         deadline = time.monotonic() + REBUILD_PARK_S
+        phase = self._entry_phase(fleet)
         full: set = set()
         while True:
-            replica = self._place(fleet, prompt, exclude | full)
+            replica = self._place(fleet, prompt, exclude | full,
+                                  phase=phase)
             if replica is None:
-                if self._place(fleet, prompt, exclude) is not None:
+                if self._place(fleet, prompt, exclude,
+                               phase=phase) is not None:
                     # placeable replicas exist but every queue is at
                     # its bound: backpressure, exactly like the
                     # single-engine QueueFullError shed
@@ -1352,12 +1516,25 @@ class _FleetService:
                     raise ShedError(
                         _GEN_SERVICE._retry_after_ms(),
                         "every placeable replica is at max_pending")
+                # pool-scoped starvation: the needed pool has zero
+                # placeable replicas while the rest of the fleet is
+                # fine (phase-blind placement would still land)
+                pool_only = (phase is not None and self._place(
+                    fleet, prompt, exclude) is not None)
                 if not park:
+                    if pool_only:
+                        raise PoolRebuildingError(
+                            _GEN_SERVICE._retry_after_ms(), phase,
+                            "no placeable replica in pool")
                     raise RebuildingError(
                         _GEN_SERVICE._retry_after_ms(),
                         "no placeable replica")
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
+                    if pool_only:
+                        raise PoolRebuildingError(
+                            _GEN_SERVICE._retry_after_ms(), phase,
+                            "pool draining/rebuilding")
                     raise RebuildingError(
                         _GEN_SERVICE._retry_after_ms(),
                         "no placeable replica (fleet "
@@ -1414,6 +1591,7 @@ class _FleetService:
                             replica, rule.arg or 2000.0)
                         return
                 published = []
+                handoffs = []
                 with replica.cond:
                     if _faults.ACTIVE:
                         _faults.fire("daemon.step", replica.scope)
@@ -1433,6 +1611,18 @@ class _FleetService:
                         tkt = replica.tickets.pop(rid_e, None)
                         if tkt is not None:
                             published.append((tkt, out))
+                    if getattr(eng, "handoff_ready", None):
+                        # round 20: the tick parked finished prefills
+                        # for export — pull the KV payloads (d2h) and
+                        # detach the tickets while the engine mutex is
+                        # held; the decode-side placement/import runs
+                        # OUTSIDE it (fleet.cv is a leaf, and the
+                        # import takes the TARGET replica's condition)
+                        for hreq, payload in eng.export_handoff():
+                            htkt = replica.tickets.pop(
+                                hreq.req_id, None)
+                            if htkt is not None:
+                                handoffs.append((htkt, payload))
                     dt = time.monotonic() - t0
                     stall = eng.counters["stall_ticks"]
                     stalled = stall != last_stall
@@ -1461,6 +1651,8 @@ class _FleetService:
                     for tkt, out in published:
                         self._finish_locked(tkt, out)
                     fleet.cv.notify_all()
+                for htkt, payload in handoffs:
+                    self._handoff_one(fleet, replica, htkt, payload)
             print(f"[serve] replica{replica.index} wave done: "
                   + _counters_line(row), flush=True)
             self._maybe_drain_rebuild(replica)
@@ -1499,6 +1691,10 @@ class _FleetService:
             eng.pending.clear()
             eng.active = [None] * eng.slots
             eng._inflight.clear()  # dead device buffers
+            if getattr(eng, "handoff_ready", None):
+                # parked handoff slots are harvested as survivors via
+                # active above — the export queue entries are stale
+                eng.handoff_ready.clear()
             tickets = dict(replica.tickets)
             replica.tickets = {}
             replica.stepper_alive = False
@@ -1563,10 +1759,16 @@ class _FleetService:
 
     def _migrate(self, fleet: _Fleet, tkt: _Ticket, exclude) -> bool:
         """Resubmit one harvested request on the best healthy peer;
-        False when no peer is placeable (caller parks)."""
+        False when no peer is placeable (caller parks).  On a
+        disaggregated fleet every migration re-enters at the PREFILL
+        pool (a migrated request starts with a re-prefill of its
+        committed prefix, and that work belongs to the prefill pool —
+        it hands off at the boundary like any fresh admission)."""
         tried = set(exclude)
+        phase = self._entry_phase(fleet)
         while True:
-            target = self._place(fleet, tkt.req.prompt, tried)
+            target = self._place(fleet, tkt.req.prompt, tried,
+                                 phase=phase)
             if target is None:
                 return False
             if self._resubmit_on(target, tkt, migrated=True):
@@ -1574,14 +1776,23 @@ class _FleetService:
             tried.add(target.index)
 
     def _resubmit_on(self, replica: _Replica, tkt: _Ticket,
-                     migrated: bool) -> bool:
+                     migrated: bool, handoff: bool = False,
+                     payload=None) -> bool:
         """Resume a harvested request on ``replica`` via
         ``PagedEngine.resubmit(fresh_id=True)`` (the peer's id space is
         independent of the failed engine's).  Greedy streams stay
         bit-identical to a fault-free run and sampled streams resume
         their per-slot key chain — resubmit's own contract, now applied
         across engines.  Returns False if the replica can't take it
-        (died/unplaceable in the meantime)."""
+        (died/unplaceable in the meantime).
+
+        ``handoff=True`` marks the round-20 prefill→decode handoff (the
+        request's NORMAL path on a disaggregated fleet, not a failure:
+        counted under ``daemon_handoffs``, no replay/migrate charge);
+        ``payload`` is the exported digest-keyed KV block list, seeded
+        into the target's host-spill tier under its condition right
+        before the resubmit so admission's spill prefetch restores the
+        prefix instead of recomputing it."""
         import numpy as np
 
         fleet = replica.fleet
@@ -1618,6 +1829,9 @@ class _FleetService:
                 # peer without spec capability: degrade to plain ticks
                 # — greedy streams are identical either way
                 req.spec = "off"
+            nbytes = 0
+            if payload:
+                nbytes = eng.import_handoff(payload)
             try:
                 rid_e = eng.resubmit(req, fresh_id=True)
             except ValueError:
@@ -1639,7 +1853,12 @@ class _FleetService:
         with fleet.cv:
             tkt.replica = replica
             tkt.parked = False
-            if migrated:
+            if handoff:
+                _C_HANDOFFS.inc()
+                if nbytes:
+                    _C_HANDOFF_BYTES.inc(nbytes)
+                _obs.event("daemon.handoff", tkt.req.rid)
+            elif migrated:
                 tkt.req.migrations += 1
                 _C_MIGRATIONS.inc()
                 _obs.event("daemon.migrate", tkt.req.rid)
@@ -1648,6 +1867,80 @@ class _FleetService:
                 _obs.event("daemon.replay", tkt.req.rid)
             fleet.cv.notify_all()
         return True
+
+    def _handoff_one(self, fleet: _Fleet, replica: _Replica,
+                     tkt: _Ticket, payload) -> None:
+        """Complete one prefill→decode handoff collected from
+        ``replica``'s stepper: fire the ``daemon.handoff`` chaos site,
+        seed the exported KV payload into the best decode-pool
+        replica's host-spill tier, and resume the stream there through
+        the resubmit path (no replay-budget charge — a handoff is the
+        request's normal path on a disaggregated fleet).
+
+        On an injected crash — or a decode pool with no importable
+        target — the payload is DROPPED and the request replays from
+        its journaled prompt through the ordinary migration path,
+        charging the replay budget exactly like a replica failure:
+        zero leaked blocks on either engine (the export already
+        released the prefill side's blocks; the import never
+        landed)."""
+        import numpy as np
+
+        req = tkt.req
+        with fleet.cv:
+            if tkt.cancelled:
+                return
+            if req.cancelled:
+                # early-stopped between park and export: complete with
+                # the tokens it has (the export skipped the d2h)
+                self._finish_locked(tkt, np.asarray(req.out, np.int32))
+                fleet.cv.notify_all()
+                return
+        try:
+            if _faults.ACTIVE:
+                _faults.fire("daemon.handoff", replica.scope)
+            tried = {replica.index}
+            while True:
+                target = self._place(fleet, req.prompt, tried,
+                                     phase=_router.ROLE_DECODE)
+                if target is None:
+                    break
+                try:
+                    ok = self._resubmit_on(target, tkt, migrated=False,
+                                           handoff=True,
+                                           payload=payload)
+                except Exception:  # noqa: BLE001 — a bad import on
+                    # one target must not crash the PREFILL stepper
+                    # that collected the handoff: try the next peer,
+                    # fall through to the replay path when none take
+                    traceback.print_exc()
+                    ok = False
+                if ok:
+                    return
+                tried.add(target.index)
+        except _faults.InjectedFault as err:
+            print(f"[serve] handoff of rid={req.rid} crashed ({err}); "
+                  f"replaying from the journaled prompt", flush=True)
+        # the KV payload is lost (crash) or unplaceable (decode pool
+        # draining/rebuilding): replay from the prompt the ticket
+        # still journals, charging the replay budget so a flapping
+        # handoff path surfaces its failure instead of looping
+        with fleet.cv:
+            if tkt.cancelled:
+                return
+            tkt.retries += 1
+            over = tkt.retries > REPLAY_BUDGET
+            if over:
+                self._finish_error_locked(tkt, RuntimeError(
+                    f"handoff replay budget exhausted for "
+                    f"rid={req.rid}"))
+                fleet.cv.notify_all()
+                return
+        if not self._migrate(fleet, tkt, set()):
+            with fleet.cv:
+                tkt.parked = True
+                tkt.replica = None
+                replica.parked.append(tkt)
 
     def _rebuild(self, replica: _Replica) -> None:
         """Background rebuild of a quarantined/drained replica from the
@@ -1671,6 +1964,8 @@ class _FleetService:
             return
         eng.replica_index = replica.index
         eng.fault_scope = replica.scope
+        if replica.role == _router.ROLE_PREFILL:
+            eng.handoff_at_boundary = True  # the slot's role survives
         with replica.cond:
             replica.engine = eng
             replica.tok = tok
@@ -1702,16 +1997,20 @@ class _FleetService:
               f"replayed)", flush=True)
 
     # ------------------------------------------------------- elastic fleet
-    def scale_out(self, fleet: _Fleet) -> Optional[int]:
+    def scale_out(self, fleet: _Fleet,
+                  role: Optional[str] = None) -> Optional[int]:
         """Add serving capacity: revive a retired slot through the
         rebuild lifecycle (replaying any stragglers a preemption
         parked there) when one exists, else spawn + append a fresh
-        replica.  Blocking (a cold build); the autoscale loop runs it
-        from a reconcile thread, never the sampler tick itself."""
+        replica.  ``role`` pins the capacity to one pool on a
+        disaggregated fleet (only a matching retired slot revives; a
+        fresh spawn joins that pool).  Blocking (a cold build); the
+        autoscale loop runs it from a reconcile thread, never the
+        sampler tick itself."""
         slot = None
         with fleet.cv:
             for r in fleet.replicas:
-                if r.retired:
+                if r.retired and (role is None or r.role == role):
                     slot = r
                     r.retired = False
                     r.draining = False
@@ -1725,7 +2024,8 @@ class _FleetService:
                 return None
             eng, tok = fleet.builder()
             with fleet.cv:
-                slot = fleet.add(eng, tok)
+                slot = fleet.add(eng, tok,
+                                 role=role or _router.ROLE_UNIFIED)
                 fleet.cv.notify_all()
         _C_SCALE_OUTS.inc()
         _obs.event("daemon.scale_out", slot.index)
@@ -1734,21 +2034,30 @@ class _FleetService:
         return slot.index
 
     def scale_in(self, fleet: _Fleet, index: Optional[int] = None, *,
-                 deadline_s: Optional[float] = None) -> Optional[int]:
+                 deadline_s: Optional[float] = None,
+                 role: Optional[str] = None) -> Optional[int]:
         """Retire one replica: ``index`` when given, else the least-
         loaded placeable one (ties to the HIGHEST index — replica 0
         stays the fleet's stable anchor).  Refuses to drop below one
-        serving replica.  Returns the retired index, or None when
-        nothing is retirable."""
+        serving replica fleet-wide, and — with ``role`` given — below
+        the pool's configured MIN (each pool keeps its floor so the
+        other pool's idle period can never starve this one's phase).
+        Returns the retired index, or None when nothing is
+        retirable."""
         with fleet.cv:
             serving = [r for r in fleet.replicas if not r.retired]
             if len(serving) <= 1:
                 return None
+            if role is not None:
+                floor = max(1, fleet.pools.get(role, {}).get("min", 1))
+                if sum(1 for r in serving if r.role == role) <= floor:
+                    return None
             if index is not None:
                 cand = [r for r in serving if r.index == index]
             else:
                 cand = [r for r in serving
-                        if r.health.placeable and not r.draining]
+                        if r.health.placeable and not r.draining
+                        and (role is None or r.role == role)]
         if index is None:
             # loads read under each replica's own condition AFTER the
             # fleet snapshot (the fleet.cv -> replica.cond order is
@@ -1824,6 +2133,8 @@ class _FleetService:
             eng.active = [None] * eng.slots
             eng._inflight.clear()  # in-flight device work: recomputed
             # on the peer from the committed prefix (bit-identical)
+            if getattr(eng, "handoff_ready", None):
+                eng.handoff_ready.clear()  # harvested via active above
             tickets = dict(replica.tickets)
             replica.tickets = {}
             replica.dead = True
@@ -1953,6 +2264,7 @@ class _FleetService:
         fleet = replica.fleet
         with fleet.cv:
             row = {"replica": replica.index,
+                   "role": replica.role,
                    "health": replica.health.state,
                    "suspects": replica.health.suspects,
                    "crashes": replica.health.crashes,
@@ -1986,6 +2298,12 @@ class _FleetService:
             out["autoscale"] = fleet.autoscaler.snapshot()
         if fleet.brownout is not None:
             out["brownout"] = fleet.brownout.snapshot()
+        if fleet.pools:
+            out["pools"] = {
+                role: {"min": p["min"], "max": p["max"],
+                       "autoscale": (None if p["policy"] is None
+                                     else p["policy"].snapshot())}
+                for role, p in fleet.pools.items()}
         return out
 
     # -------------------------------------------------------------- hedging
@@ -2315,8 +2633,8 @@ def _build_engine(path, attn: str, kv_dtype: str, tp: int,
         # hierarchical cache policy (daemon-wide, --prefix-index /
         # --spill-blocks / --spill-dtype): radix partial-hit index and
         # the host-RAM spill tier — certified on sharded pools in
-        # round 19, so mesh engines get the same policy (the engine
-        # itself still rejects the uncertified int4 host format there)
+        # round 19 (native/int8) and round 20 (int4), so mesh engines
+        # get the full policy surface
         prefix_index=PREFIX_INDEX,
         spill_blocks=SPILL_BLOCKS,
         spill_dtype=SPILL_DTYPE,
@@ -3313,17 +3631,26 @@ _PRESSURE_ALERTS = ("queue_wait_burn_fast", "ttft_burn_fast",
                     "goodput_shed_burn")
 
 
-def _fleet_signals(fleet: _Fleet) -> "object":
+def _fleet_signals(fleet: _Fleet,
+                   role: Optional[str] = None) -> "object":
     """Snapshot one :class:`tpulab.autoscale.Signals` for a fleet:
     serving-replica count + summed load under the proper lock order
     (fleet snapshot under fleet.cv, THEN loads under each replica's own
     condition), plus the history-window pressure evidence shared by
-    every fleet (the ring is process-global)."""
+    every fleet (the ring is process-global).
+
+    ``role`` scopes the snapshot to one pool of a disaggregated fleet
+    (round 20), and selects that pool's OWN burn signal: the prefill
+    pool scales on queue-wait p99 (admission pressure is prefill
+    work), the decode pool on ITL p99 (the latency the pool exists to
+    protect) — each pool is blind to the other's signal so a prefill
+    burst can never scale the decode pool or vice versa."""
     from tpulab import autoscale as _autoscale
     from tpulab.obs import alerts as _alerts
 
     with fleet.cv:
-        live = [r for r in fleet.replicas if not r.retired]
+        live = [r for r in fleet.replicas if not r.retired
+                and (role is None or r.role == role)]
         n = len(live)
     load = 0
     for r in live:
@@ -3334,12 +3661,17 @@ def _fleet_signals(fleet: _Fleet) -> "object":
             load += len(eng.pending) + sum(
                 1 for a in eng.active if a is not None)
     qp99 = None
+    itl99 = None
     shed_rate = 0.0
     if _sampler_active():
         w = _obs.HISTORY.window(AUTOSCALE_WINDOW_S)
         if w is not None:
-            if w.count("queue_wait_seconds") > 0:
+            if (role != _router.ROLE_DECODE
+                    and w.count("queue_wait_seconds") > 0):
                 qp99 = w.percentile("queue_wait_seconds", 0.99)
+            if (role == _router.ROLE_DECODE
+                    and w.count("itl_seconds") > 0):
+                itl99 = w.percentile("itl_seconds", 0.99)
             shed_rate = w.rate("daemon_shed_requests")
     firing = 0
     for name in _PRESSURE_ALERTS:
@@ -3351,29 +3683,68 @@ def _fleet_signals(fleet: _Fleet) -> "object":
         load_per_replica=load / max(1, n),
         queue_wait_p99_s=qp99,
         shed_rate=shed_rate,
-        alerts_firing=firing)
+        alerts_firing=firing,
+        latency_p99_s=itl99)
 
 
-def _reconcile_fleet(fleet: _Fleet, target: int) -> None:
+def _reconcile_fleet(fleet: _Fleet, target: int,
+                     role: Optional[str] = None) -> None:
     """One reconcile step toward ``target`` (a daemon thread, one op
     in flight per fleet): scale OUT when provisioned < target — a
     preempted slot revives this way too, since preemption drops the
     provisioned count below target with no cooldown in the way — and
-    scale IN when above."""
+    scale IN when above.  ``role`` scopes both the count and the op
+    to one pool of a disaggregated fleet."""
     try:
         with fleet.cv:
             provisioned = sum(
-                1 for r in fleet.replicas if not r.retired)
+                1 for r in fleet.replicas if not r.retired
+                and (role is None or r.role == role))
         if provisioned < target:
-            fleet.add_replica()
+            fleet.add_replica(role=role)
         elif provisioned > target:
-            fleet.retire_replica()
+            fleet.retire_replica(role=role)
     except Exception:
         traceback.print_exc()
     finally:
         with fleet.cv:
             fleet.scaling = False
             fleet.cv.notify_all()
+
+
+def _pool_autoscale_tick(fleet: _Fleet, now: float) -> None:
+    """One sampler tick of the round-20 per-pool control loop: refresh
+    the ``pool_*`` gauges, fold each ranged pool's Signals into ITS
+    policy, and kick at most one reconcile op for the fleet (the
+    ``fleet.scaling`` latch is fleet-wide — pools take turns, which is
+    fine: a reconcile is one add/retire and the next tick re-checks).
+    Fixed-size pools (``role=N``) publish gauges but never scale."""
+    for role, pool in fleet.pools.items():
+        with fleet.cv:
+            n_live = sum(1 for r in fleet.replicas
+                         if not r.retired and r.role == role)
+        if role == _router.ROLE_PREFILL:
+            _G_POOL_PREFILL_REPLICAS.set(float(n_live))
+        elif role == _router.ROLE_DECODE:
+            _G_POOL_DECODE_REPLICAS.set(float(n_live))
+        pol = pool["policy"]
+        if pol is None:
+            continue
+        sig = _fleet_signals(fleet, role=role)
+        target = pol.observe(now, sig)
+        if role == _router.ROLE_PREFILL:
+            _G_POOL_PREFILL_TARGET.set(float(target))
+        elif role == _router.ROLE_DECODE:
+            _G_POOL_DECODE_TARGET.set(float(target))
+        with fleet.cv:
+            provisioned = sum(1 for r in fleet.replicas
+                              if not r.retired and r.role == role)
+            if not fleet.scaling and provisioned != target:
+                fleet.scaling = True
+                threading.Thread(
+                    target=_reconcile_fleet,
+                    args=(fleet, target, role),
+                    daemon=True).start()
 
 
 def _autoscale_tick() -> None:
@@ -3389,6 +3760,14 @@ def _autoscale_tick() -> None:
     max_level = 0
     armed = False
     for fleet in fleets:
+        if fleet.pools:
+            # round 20: a disaggregated fleet's pools scale
+            # INDEPENDENTLY, each off its own policy + burn signal
+            # (queue-wait for prefill, ITL for decode); the fleet-wide
+            # autoscaler/brownout ladder is never armed alongside
+            # pools (--pool-spec and --autoscale-max are exclusive)
+            _pool_autoscale_tick(fleet, now)
+            continue
         pol = fleet.autoscaler
         if pol is None:
             continue
@@ -3843,7 +4222,7 @@ def serve(socket_path: str, *, max_requests: Optional[int] = None) -> None:
 def main(argv=None) -> int:
     global PREFILL_CHUNK, REPLICAS, HEDGE_MS, METRICS_INTERVAL_S, \
         _JOURNAL, AUTOSCALE_MIN, AUTOSCALE_MAX, PREFIX_INDEX, \
-        SPILL_BLOCKS, SPILL_DTYPE, MESH_SPEC
+        SPILL_BLOCKS, SPILL_DTYPE, MESH_SPEC, POOL_SPEC
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--socket", default=os.environ.get("TPULAB_DAEMON_SOCKET", "/tmp/tpulab.sock"))
     ap.add_argument("--max-requests", type=int, default=None, help="exit after N requests (tests)")
@@ -3899,6 +4278,22 @@ def main(argv=None) -> int:
                          "each warm fleet between --autoscale-min and N "
                          "replicas (default TPULAB_DAEMON_AUTOSCALE_MAX "
                          "or 0 = disarmed, fixed --replicas fleet)")
+    ap.add_argument("--pool-spec", default=POOL_SPEC, metavar="SPEC",
+                    help="disaggregated serving pools (round 20): "
+                         "comma-separated role=N or role=MIN..MAX with "
+                         "roles prefill/decode/unified, e.g. "
+                         "'prefill=1..2,decode=1'.  Admissions place "
+                         "into the prefill pool; at the prefill/decode "
+                         "boundary the KV blocks hand off to a decode "
+                         "replica through the digest-keyed host-spill "
+                         "format (streams bit-identical to unified "
+                         "serving); ranged pools autoscale "
+                         "INDEPENDENTLY (queue-wait burn for prefill, "
+                         "ITL burn for decode).  Requires "
+                         "--prefix-index radix and --spill-blocks > 0; "
+                         "exclusive with --autoscale-max; overrides "
+                         "--replicas (default TPULAB_DAEMON_POOL_SPEC "
+                         "or '' = unified fleet)")
     ap.add_argument("--prefix-index", choices=("dict", "radix"),
                     default=PREFIX_INDEX,
                     help="prefix-cache structure for the serving "
@@ -3960,12 +4355,9 @@ def main(argv=None) -> int:
             mesh_b, mesh_m = parse_mesh_spec(args.mesh)
         except ValueError as e:
             ap.error(f"--mesh: {e}")
-        # mirror the engine's uncertified-combination refusals at the
-        # knob, before any client pays a cold build to find out
-        if mesh_b * mesh_m > 1 and args.spill_blocks \
-                and args.spill_dtype == "int4":
-            ap.error("--spill-dtype int4 is uncertified on mesh "
-                     "serving (use native or int8)")
+        # (the int4 host-spill format was certified on sharded pools
+        # in round 20 — no uncertified spill/mesh combination is left
+        # to refuse at the knob)
         args.mesh = f"{mesh_b}x{mesh_m}" if mesh_b * mesh_m > 1 else ""
     # elastic-fleet bounds: reject misconfiguration HERE with a
     # parseable argparse error (exit 2, message on stderr) instead of
@@ -3988,6 +4380,27 @@ def main(argv=None) -> int:
         if args.metrics_interval == 0:
             ap.error("--autoscale-max requires the sampler: "
                      "--metrics-interval must be > 0")
+    if args.pool_spec:
+        # disaggregated-fleet misconfiguration rejected HERE with a
+        # parseable argparse error, same discipline as the elastic
+        # bounds above
+        try:
+            pools = _parse_pool_spec(args.pool_spec)
+        except ValueError as e:
+            ap.error(f"--pool-spec: {e}")
+        if args.autoscale_max >= 1:
+            ap.error("--pool-spec and --autoscale-max are exclusive "
+                     "(each pool carries its own autoscale bounds)")
+        if args.prefix_index != "radix" or not args.spill_blocks:
+            ap.error("--pool-spec requires --prefix-index radix and "
+                     "--spill-blocks > 0 (the prefill→decode KV "
+                     "handoff rides the digest-keyed host-spill "
+                     "format)")
+        if (any(mx > mn for _, mn, mx in pools)
+                and args.metrics_interval == 0):
+            ap.error("--pool-spec with ranged pools requires the "
+                     "sampler: --metrics-interval must be > 0")
+        args.replicas = sum(mn for _, mn, _ in pools)
     PREFILL_CHUNK = args.prefill_chunk
     REPLICAS = args.replicas
     HEDGE_MS = args.hedge_ms
@@ -3995,6 +4408,7 @@ def main(argv=None) -> int:
     SPILL_BLOCKS = args.spill_blocks
     SPILL_DTYPE = args.spill_dtype
     MESH_SPEC = args.mesh
+    POOL_SPEC = args.pool_spec
     METRICS_INTERVAL_S = args.metrics_interval
     AUTOSCALE_MIN = args.autoscale_min
     AUTOSCALE_MAX = args.autoscale_max

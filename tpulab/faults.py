@@ -46,6 +46,12 @@ Sites wired in this round (grep for ``_FAULTS``/``faults.fire``):
 ``replica.preempt``    the daemon stepper loop, alongside ``daemon.step``
                        (``preempt`` — a spot-preemption notice for that
                        replica; ``arg`` is the drain deadline in ms)
+``daemon.handoff``     the disaggregated fleet's prefill→decode handoff
+                       (round 20), between the prefill-side KV export
+                       and the decode-side admit (``raise`` — the
+                       supervisor drops the payload and replays from
+                       the journaled prompt, charging the replay
+                       budget; zero leaked blocks on either engine)
 =====================  =====================================================
 
 Fault kinds:

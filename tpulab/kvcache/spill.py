@@ -166,7 +166,11 @@ class HostSpillTier:
     def nbytes(self) -> int:
         return self._nbytes
 
-    def put(self, key: bytes, kraw, vraw) -> None:
+    def put(self, key: bytes, kraw, vraw) -> int:
+        """Insert (or refresh) one block; returns the entry's ENCODED
+        payload bytes — the round-20 handoff path charges its
+        ``handoff_bytes`` counter with exactly what crossed the wire
+        format, quantization included."""
         old = self._entries.pop(key, None)
         if old is not None:
             self._nbytes -= _entry_nbytes(old[0]) + _entry_nbytes(old[1])
@@ -176,7 +180,9 @@ class HostSpillTier:
             self.dropped += 1
         entry = (_encode(kraw, self.dtype), _encode(vraw, self.dtype))
         self._entries[key] = entry
-        self._nbytes += _entry_nbytes(entry[0]) + _entry_nbytes(entry[1])
+        nbytes = _entry_nbytes(entry[0]) + _entry_nbytes(entry[1])
+        self._nbytes += nbytes
+        return nbytes
 
     def get(self, key: bytes, *, pool_is_quantized: bool,
             pool_dtype) -> Optional[tuple]:

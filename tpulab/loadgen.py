@@ -64,10 +64,15 @@ from typing import Dict, List, Optional, Sequence, Tuple
 #: the retry-after ms: the two must stay distinguishable client-side
 #: too, or a rolling restart would masquerade as load shedding in
 #: goodput accounting (the same separation RebuildingError keeps
-#: server-side).  THE one copy of the client-side pattern —
-#: tools/obs_report.py imports it, so the consumers can never drift
-#: apart on the wire contract.
-SHED_RE = re.compile(r"(shed|rebuilding) retry_after_ms=(\d+)")
+#: server-side).  Round 20: a disaggregated fleet's pool-scoped park
+#: (``PoolRebuildingError``) tags the frame ``rebuilding pool=<role>
+#: retry_after_ms=N`` — the optional non-capturing pool tag keeps the
+#: group numbering stable, so a pool park parses exactly like a
+#: whole-fleet park (same arm, same retry).  THE one copy of the
+#: client-side pattern — tools/obs_report.py imports it, so the
+#: consumers can never drift apart on the wire contract.
+SHED_RE = re.compile(
+    r"(shed|rebuilding)(?: pool=[\w-]+)? retry_after_ms=(\d+)")
 
 #: deterministic filler vocabulary for prompt text (ASCII, so traces
 #: stay readable and JSON stays byte-stable)
@@ -223,6 +228,31 @@ SPECS: Dict[str, TraceSpec] = {
         steps_min=4, steps_max=12, p_followup=0.55, max_turns=4,
         think_ms=(120.0, 500.0), est_ms_per_token=20.0, p_cancel=0.0,
         system_prompt_len=96, n_system_prompts=4,
+        classes=(
+            SLOClass("interactive", weight=0.7, priority=2,
+                     deadline_ms=None, ttft_ms=30000.0, itl_ms=10000.0,
+                     e2e_ms=60000.0),
+            SLOClass("bulk", weight=0.3, priority=0, deadline_ms=None,
+                     ttft_ms=60000.0, itl_ms=15000.0, e2e_ms=120000.0),
+        )),
+    # the disaggregated-serving tier (tools/goodput_gate.py --disagg):
+    # a HEAVY-TAIL prompt mix — most arrivals are short interactive
+    # turns, but the lognormal tail regularly lands near-max prompts
+    # whose long prefills would steal decode ticks on a unified engine.
+    # On the pool-spec'd fleet those prefills saturate the PREFILL
+    # pool while the decode pool's ITL stays flat — the headline the
+    # gate scores.  Short output budgets keep many streams decoding
+    # concurrently with the long prefills; no cancels and no deadlines
+    # (the acceptance gate requires every handed-off stream
+    # bit-identical to the unified-serving goldens, so shedding and
+    # hang-ups must not be in play).
+    "disagg": TraceSpec(
+        name="disagg", seed=47, n_requests=48, arrival="poisson",
+        rate_rps=10.0, prompt_median=48, prompt_sigma=1.4,
+        prompt_min=16, prompt_max=448, steps_median=12,
+        steps_sigma=0.4, steps_min=6, steps_max=24, p_followup=0.25,
+        max_turns=2, think_ms=(120.0, 500.0), est_ms_per_token=20.0,
+        p_cancel=0.0,
         classes=(
             SLOClass("interactive", weight=0.7, priority=2,
                      deadline_ms=None, ttft_ms=30000.0, itl_ms=10000.0,

@@ -150,7 +150,8 @@ class EngineConfigError(ValueError):
     """A serving-knob combination the engine refuses to build — either
     nonsensical (indivisible head/slot sharding) or NOT YET CERTIFIED
     on this configuration (the pallas kernel or the dense-draft
-    proposer on a mesh, the int4 host spill format on sharded pools).
+    proposer on a mesh; the int4 host spill format was certified on
+    sharded pools in round 20).
     A ValueError subclass so pre-round-19 ``except ValueError`` callers
     and tests keep working; a distinct type so the daemon can tell a
     config refusal from a genuine bad argument.  Uncertified combos
@@ -991,12 +992,6 @@ class PagedEngine:
             raise ValueError(
                 f"spill_dtype={spill_dtype!r}; expected one of "
                 f"{_spill_mod.SPILL_DTYPES}")
-        if spill_blocks and spill_dtype == "int4" and mesh is not None:
-            # native and int8 host payloads are roundtrip-certified on
-            # sharded pools (round 19); the int4 nibble repack is not
-            raise EngineConfigError(
-                "spill_dtype='int4' is uncertified on mesh serving "
-                "(use 'native' or 'int8')")
         self.params = params
         self.cfg = cfg
         self.slots = slots
@@ -1224,6 +1219,15 @@ class PagedEngine:
         # schedules can target ONE replica out of N identical engines
         self.replica_index: Optional[int] = None
         self.fault_scope: Optional[str] = None
+        # disaggregated serving (round 20): a PREFILL-pool engine sets
+        # handoff_at_boundary — at the PREFILLING->DECODING edge the
+        # slot parks in phase "handoff" (inert to every dispatch path)
+        # instead of activating for decode, and the daemon drains
+        # ``handoff_ready`` through export_handoff() after each step.
+        # Requires the spill tier (the export rides its d2h program
+        # and digest-keyed host-block wire format).
+        self.handoff_at_boundary = False
+        self.handoff_ready: List[Tuple[int, _Request]] = []
         # compile/device observability (round 14): the engine is STEADY
         # once a step has dispatched device work without compiling —
         # later compiles inside a step are RECOMPILES (counter above +
@@ -1706,6 +1710,9 @@ class PagedEngine:
                 if req.spec == "draft":
                     self._draft_prefill_slot(s, req)
                 self._register_prefix(req.prompt, row)
+                if self.handoff_at_boundary:
+                    self._park_handoff(s, req)
+                    continue
                 req.phase = "decode"
                 if self.obs:
                     # dispatch-side prefill wall time (the synchronous
@@ -1929,6 +1936,9 @@ class PagedEngine:
         self.lengths[s] = req.pf_end
         self.last_tok[s] = req.prompt[-1]
         self._register_prefix(req.prompt, self.tables[s])
+        if self.handoff_at_boundary:
+            self._park_handoff(s, req)
+            return
         req.phase = "decode"
         if self.obs:
             # admission -> final chunk dispatched (host-side span of the
@@ -2130,6 +2140,77 @@ class PagedEngine:
         self.pending.append(req)
         return req.req_id
 
+    # ------------------------------------------------- KV handoff (round 20)
+    def _park_handoff(self, s: int, req: _Request):
+        """The PREFILLING->DECODING edge on a prefill-pool engine:
+        instead of activating the slot for decode, park it in phase
+        ``"handoff"`` — inert to every dispatch path (the decode
+        snapshot, ``_prefill_tick``, the spec and decode-waiting scans
+        all filter on exact phase strings) but still occupying
+        ``active[s]``, which keeps the engine non-idle until the daemon
+        drains :attr:`handoff_ready` after the step.  The DEVICE slot
+        stays inactive (neither path here pushed it), so zero decode
+        ticks ever run on this engine for the request."""
+        req.phase = "handoff"
+        if self.obs:
+            req.t_prefill_done = time.monotonic()
+            _H_PREFILL.observe(req.t_prefill_done - req.t_admit)
+            self._trace.event("engine.handoff_ready", req.rid)
+        self.handoff_ready.append((s, req))
+
+    def export_handoff(self) -> List[Tuple["_Request", List[tuple]]]:
+        """Drain the handoff-parked slots: D2H each request's FULL KV
+        blocks through the spill tier's jitted read program — keyed by
+        the same per-depth digest chain ``_prefetch_spill`` probes on
+        the decode side — then release the slot through the normal
+        deref path (the locally registered prefix keeps its own refs,
+        so future same-prefix placements still score affinity here).
+
+        Returns ``[(req, payload), ...]`` with payload a list of
+        ``(digest, kblk, vblk)`` host blocks in POOL representation
+        (exactly what ``_spill_out`` hands the host tier).  A cancelled
+        request exports an empty payload — the caller completes it
+        instead of resuming; a sub-block prompt also exports empty
+        (the decode side re-prefills the short tail, a plain
+        migration).  No drain barrier: the parked slots are invisible
+        to in-flight ticks, and reading the pools synchronizes on the
+        donation chain like any eviction-boundary spill."""
+        out: List[Tuple["_Request", List[tuple]]] = []
+        ready, self.handoff_ready = self.handoff_ready, []
+        for s, req in ready:
+            payload: List[tuple] = []
+            if not req.cancelled and self._spill is not None:
+                bs = self.block_size
+                prompt = np.ascontiguousarray(req.prompt, dtype=np.int32)
+                nb_full = (len(prompt) - 1) // bs
+                digs = _chain_digests(prompt[: nb_full * bs].tobytes(),
+                                      bs * 4)
+                for j in range(nb_full):
+                    b = int(self.tables[s, j])
+                    kblk, vblk = jax.device_get(_spill_read(
+                        self.kpool, self.vpool, np.int32(b)))
+                    payload.append((digs[j], kblk, vblk))
+                self._trace.event("engine.handoff_export", req.rid)
+            self._release_blocks(s, req)
+            self._clear_slot(s)
+            out.append((req, payload))
+        return out
+
+    def import_handoff(self, payload: List[tuple]) -> int:
+        """Decode-side import: land a peer's exported KV blocks in THIS
+        engine's host spill tier, keyed so the admission prefetch
+        (:meth:`_prefetch_spill`) restores them to HBM and prefill
+        recomputes only the sub-block tail.  Returns the ENCODED bytes
+        accepted (the daemon's ``handoff_bytes`` counter — quantized
+        spill dtypes charge their wire size, not the raw one)."""
+        if self._spill is None:
+            raise EngineConfigError(
+                "import_handoff requires spill_blocks > 0")
+        total = 0
+        for key, kblk, vblk in payload:
+            total += self._spill.put(key, kblk, vblk)
+        return total
+
     def _preempt_for_head(self, finished: List[int]) -> bool:
         """KV pressure: the head request cannot be admitted even after
         cache eviction — preempt the lowest-priority active slot whose
@@ -2150,6 +2231,7 @@ class PagedEngine:
             (r.priority, -r.t_admit, s)
             for s, r in enumerate(self.active)
             if r is not None and not r.cancelled
+            and r.phase != "handoff"
             and r.priority < head.priority
         ]
         if not victims:

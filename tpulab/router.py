@@ -186,23 +186,49 @@ class ReplicaHealth:
                 "alert_firing": self.alert_firing}
 
 
+#: pool roles (round 20, disaggregated serving): which lifecycle phase
+#: a replica serves.  ``unified`` replicas (the default — every fleet
+#: before round 20) take both phases; a ``prefill`` replica admits new
+#: requests and hands their KV off at the PREFILLING→DECODING edge; a
+#: ``decode`` replica only receives those handoffs (plus decode-phase
+#: migrations).  String-valued so roles serialize straight into the
+#: ``fleet`` JSON response, like the health states above.
+ROLE_PREFILL = "prefill"
+ROLE_DECODE = "decode"
+ROLE_UNIFIED = "unified"
+ROLES = (ROLE_PREFILL, ROLE_DECODE, ROLE_UNIFIED)
+
+
 @dataclass(frozen=True)
 class ReplicaView:
     """One replica's placement-relevant state, snapshotted by the
     daemon under its locks: ``load`` = queued + active requests,
     ``affinity`` = shared prompt-prefix blocks already resident in the
     replica's prefix cache.  ``placeable=False`` covers QUARANTINED /
-    REBUILDING health AND operator drain."""
+    REBUILDING health AND operator drain.  ``role`` is the pool role
+    (phase-aware placement filters on it; ``unified`` matches every
+    phase)."""
 
     index: int
     placeable: bool
     suspect: bool
     load: int
     affinity: int = 0
+    role: str = ROLE_UNIFIED
+
+
+def _role_serves(role: str, phase: Optional[str]) -> bool:
+    """Whether a replica with ``role`` may take work for ``phase``
+    (``None`` = phase-blind placement — the pre-round-20 behavior and
+    the unified fleet's fast path)."""
+    if phase is None or role == ROLE_UNIFIED:
+        return True
+    return role == phase
 
 
 def choose_replica(views: Sequence[ReplicaView],
-                   affinity_weight: float = 2.0) -> Optional[int]:
+                   affinity_weight: float = 2.0,
+                   phase: Optional[str] = None) -> Optional[int]:
     """Pick the replica index to place a request on, or None when no
     view is placeable (the caller parks or rejects).
 
@@ -212,11 +238,19 @@ def choose_replica(views: Sequence[ReplicaView],
     (prefix-affinity measured in blocks, load in requests — the weight
     says one resident shared block is worth eating two queued
     requests' wait); ties break least-loaded, then lowest index
-    (deterministic for tests and for an idle fleet)."""
+    (deterministic for tests and for an idle fleet).
+
+    ``phase`` extends the score to phase-aware placement (round 20):
+    ``"prefill"`` restricts candidates to prefill + unified replicas
+    (new admissions), ``"decode"`` to decode + unified (KV handoffs
+    and decode-phase migrations).  A phase with zero matching
+    placeable views returns None even when the OTHER pool has room —
+    the caller distinguishes "pool empty" from "fleet empty" for its
+    park frame."""
     best = None
     best_key = None
     for v in views:
-        if not v.placeable:
+        if not v.placeable or not _role_serves(v.role, phase):
             continue
         key = (v.suspect, -(affinity_weight * v.affinity - v.load),
                v.load, v.index)

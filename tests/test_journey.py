@@ -1,0 +1,396 @@
+"""Cross-engine request journeys (round 21): the stitching tier.
+
+Certified here:
+
+  * the mark store stitches the full disaggregated phase waterfall
+    (queue_wait → prefill_chunks → handoff export/transfer/import →
+    decode_queue → decode) with CONTIGUOUS shared-boundary timestamps,
+    the handoff phases summing to the recorded ``handoff_ms``, and the
+    payload bytes attributed to every handoff phase;
+  * unified journeys collapse to queue_wait → prefill_chunks → decode;
+  * the store is bounded: FIFO eviction by first mark, in-place
+    resize, capacity 0 disables recording entirely;
+  * histogram exemplars: at most one ``(rid, value)`` pair per bucket
+    (newest wins), written under the existing per-metric lock — the
+    torn-snapshot hammer proves a scrape racing rid-carrying observes
+    still sees consistent counts/sum AND intact exemplar tuples;
+  * a real ``obs=True`` engine produces a journey whose e2e/queue-wait
+    agree EXACTLY with the slow-log entry for the same rid (shared
+    timestamps, same rounding), and whose rid lands in a histogram
+    exemplar;
+  * the daemon's ``journey`` request (rid / tag / recent-N forms) and
+    the flight recorder's ``journeys`` bundle section;
+  * the shared renderers (``format_journey`` waterfall,
+    ``format_journeys`` listing, the fleet table's pool census via
+    ``router.pool_counts``, the slow-log line's pool/handoff fields);
+  * the trace-event catalog lint: every literal name passed to
+    ``tracer.event``/``span``/``begin`` under ``tpulab/`` appears in
+    docs/ARCHITECTURE.md (the mirror of the metric↔docs lint).
+"""
+
+import json
+import pathlib
+import re
+import threading
+
+import numpy as np
+import pytest
+
+from tpulab import obs, router
+from tpulab.models.labformer import LabformerConfig
+from tpulab.models.paged import PagedEngine
+from tpulab.obs import render
+from tpulab.obs.journey import (HANDOFF_PHASES, PHASES, JourneyStore,
+                                configure_journey)
+from tpulab.obs.registry import Registry
+from tpulab.obs.slowlog import SLOWLOG
+from tpulab.obs.tracer import next_rid
+
+CFG = LabformerConfig(d_model=32, n_heads=4, n_layers=2, d_ff=64, max_seq=128)
+
+ROOT = pathlib.Path(__file__).resolve().parent.parent
+
+
+@pytest.fixture(scope="module")
+def trained(trained_small, trained_small_cfg):
+    assert CFG == trained_small_cfg  # shared-model drift fails loudly
+    return trained_small
+
+
+def _cycle_prompt(p):
+    return (np.arange(p) % 7).astype(np.int32)
+
+
+def _mark_disagg_chain(store, rid, t0=100.0, nbytes=4096, tag="t"):
+    """One full disaggregated mark sequence with easy round numbers."""
+    store.mark(rid, "submit", t=t0, replica=0, pool="prefill", tag=tag)
+    store.mark(rid, "admit", t=t0 + 0.010, replica=0, pool="prefill")
+    store.mark(rid, "prefill_done", t=t0 + 0.050, replica=0,
+               pool="prefill")
+    store.mark(rid, "handoff_ready", t=t0 + 0.050, replica=0,
+               pool="prefill")
+    store.mark(rid, "handoff_export", t=t0 + 0.060, replica=0,
+               pool="prefill")
+    store.mark(rid, "handoff_import_begin", t=t0 + 0.070, replica=1,
+               pool="decode")
+    store.mark(rid, "handoff_import", t=t0 + 0.080, replica=1,
+               pool="decode", nbytes=nbytes)
+    store.mark(rid, "admit", t=t0 + 0.090, replica=1, pool="decode")
+    store.mark(rid, "retire", t=t0 + 0.200, replica=1, pool="decode")
+
+
+# ------------------------------------------------------- stitching
+def test_journey_store_stitches_disagg_waterfall():
+    s = JourneyStore(capacity=8)
+    _mark_disagg_chain(s, 7, nbytes=4096, tag="row:7")
+    j = s.snapshot(7)
+    assert j["rid"] == 7 and j["tag"] == "row:7" and j["completed"]
+    assert [p["phase"] for p in j["phases"]] == list(PHASES)
+    # contiguity by construction: each phase ends at the exact stamp
+    # the next starts from, and the waterfall is monotonic
+    for a, b in zip(j["phases"], j["phases"][1:]):
+        assert a["t1_ms"] == b["t0_ms"]
+    for p in j["phases"]:
+        assert p["ms"] >= 0 and p["t0_ms"] <= p["t1_ms"]
+    by = {p["phase"]: p for p in j["phases"]}
+    assert by["queue_wait"]["ms"] == pytest.approx(10.0)
+    assert by["prefill_chunks"]["ms"] == pytest.approx(40.0)
+    # the handoff phases sum EXACTLY to the recorded handoff_ms (the
+    # same number the slow log and the handoff_bytes counter path see)
+    hsum = round(sum(p["ms"] for p in j["phases"]
+                     if p["phase"] in HANDOFF_PHASES), 3)
+    assert hsum == j["handoff_ms"] == pytest.approx(30.0)
+    assert j["handoff_bytes"] == 4096
+    for name in HANDOFF_PHASES:
+        assert by[name]["bytes"] == 4096
+    assert by["decode"]["ms"] == pytest.approx(110.0)
+    assert j["e2e_ms"] == pytest.approx(200.0)
+    assert j["pools"] == ["prefill", "decode"]
+    assert j["replicas"] == [0, 1]
+    # phase attribution: the handoff_transfer phase belongs to the
+    # RECEIVING side (its closing mark), the export to the sender
+    assert by["handoff_export"]["pool"] == "prefill"
+    assert by["handoff_import"]["pool"] == "decode"
+
+
+def test_journey_store_unified_fallback():
+    s = JourneyStore(capacity=8)
+    s.mark(3, "submit", t=10.0, replica=0, tag="u")
+    s.mark(3, "admit", t=10.020, replica=0)
+    s.mark(3, "prefill_done", t=10.060, replica=0)
+    s.mark(3, "retire", t=10.100, replica=0)
+    j = s.snapshot(3)
+    assert [p["phase"] for p in j["phases"]] == [
+        "queue_wait", "prefill_chunks", "decode"]
+    assert j["handoff_ms"] is None and j["handoff_bytes"] == 0
+    assert j["e2e_ms"] == pytest.approx(100.0)  # retire - submit
+    # in-flight journeys stitch what their marks support
+    s.mark(4, "submit", t=20.0)
+    assert s.snapshot(4)["phases"] == []
+    assert not s.snapshot(4)["completed"]
+    assert s.snapshot(99) is None
+
+
+def test_journey_store_bounds_resize_and_disable():
+    s = JourneyStore(capacity=2)
+    s.mark(1, "submit", t=1.0)
+    s.mark(2, "submit", t=2.0)
+    s.mark(2, "retire", t=2.5)
+    s.mark(3, "submit", t=3.0)  # evicts rid 1, which never retired
+    assert s.snapshot(1) is None
+    assert s.stats() == {"capacity": 2, "resident": 2, "completed": 1,
+                         "evicted_inflight": 1}
+    s.resize(1)  # in-place shrink evicts FIFO (rid 2, completed)
+    assert s.snapshot(2) is None and s.snapshot(3) is not None
+    with pytest.raises(ValueError, match=">= 0"):
+        s.resize(-1)
+    off = JourneyStore(0)
+    off.mark(9, "submit", t=1.0)
+    off.mark(9, "retire", t=2.0)
+    assert off.snapshot(9) is None
+    assert off.stats()["resident"] == 0 and off.stats()["completed"] == 0
+    s.clear()
+    assert s.stats()["resident"] == s.stats()["completed"] == 0
+
+
+def test_journey_find_tag_and_recent():
+    s = JourneyStore(capacity=8)
+    _mark_disagg_chain(s, 10, t0=50.0, tag="shared")
+    s.mark(11, "submit", t=60.0, tag="shared")  # retry reuses the tag
+    assert s.find_tag("shared")["rid"] == 11  # newest wins
+    assert s.find_tag("absent") is None
+    recent = s.recent(5)
+    assert [j["rid"] for j in recent] == [11, 10]  # newest first
+    assert [j["rid"] for j in s.recent(5, completed_only=True)] == [10]
+    assert [j["rid"] for j in s.recent(1)] == [11]
+
+
+# ------------------------------------------------------- exemplars
+def test_histogram_exemplars_one_per_bucket_newest_wins():
+    r = Registry()
+    h = r.histogram("ex_seconds", buckets=(0.01, 1.0))
+    h.observe(0.005)  # rid-less observe writes no exemplar
+    assert h.snapshot()["exemplars"] == [None, None, None]
+    h.observe(0.005, rid=1)
+    h.observe(0.007, rid=2)  # same bucket: newest wins
+    h.observe(0.5, rid=3)
+    snap = h.snapshot()
+    assert snap["exemplars"] == [(2, 0.007), (3, 0.5), None]
+    # copy-on-read: mutating the snapshot cannot corrupt the store
+    snap["exemplars"][0] = "garbage"
+    assert h.snapshot()["exemplars"][0] == (2, 0.007)
+    # render emits the OpenMetrics suffix; parse_prometheus recovers it
+    parsed = render.parse_prometheus(r.render())
+    assert parsed["ex_seconds"]["exemplars"] == {
+        0.01: (2, 0.007), 1.0: (3, 0.5)}
+
+
+def test_exemplar_torn_snapshot_hammer():
+    """A scrape racing rid-carrying observes must see a CONSISTENT
+    histogram — counts/sum invariants intact (the round-10 contract,
+    now exercised on the exemplar-writing path) and every exemplar
+    slot either None or an intact ``(rid, value)`` pair whose value is
+    the one this test ever observes (a torn exemplar write would
+    surface a mismatched tuple)."""
+    r = Registry()
+    h = r.histogram("torn_ex_seconds", buckets=(1.0,))
+    stop = threading.Event()
+    n = {"i": 0}
+
+    def hammer():
+        while not stop.is_set():
+            n["i"] += 1
+            h.observe(0.5, rid=n["i"])
+
+    t = threading.Thread(target=hammer, daemon=True)
+    t.start()
+    try:
+        for _ in range(300):
+            snap = h.snapshot()
+            assert sum(snap["counts"]) == snap["count"]
+            assert snap["sum"] == snap["count"] * 0.5
+            ex = snap["exemplars"]
+            assert len(ex) == 2 and ex[1] is None
+            if ex[0] is not None:
+                rid, v = ex[0]
+                assert v == 0.5 and 1 <= rid <= n["i"] + 1
+    finally:
+        stop.set()
+        t.join()
+    assert h.snapshot()["exemplars"][0] is not None
+
+
+# ----------------------------------------------------- live engine
+def test_engine_journey_exemplar_and_slowlog_agree(trained):
+    """One request through a real ``obs=True`` engine: the stitched
+    journey, the slow-log entry, and the histogram exemplars must all
+    name the same rid — and the numbers that share timestamps
+    (e2e, queue wait) must agree EXACTLY, not approximately."""
+    eng = PagedEngine(trained, CFG, slots=2, n_blocks=32, block_size=8,
+                      max_seq=64, obs=True)
+    eng.submit(_cycle_prompt(4), max_new=6, tag="journey-live")
+    eng.run()
+    j = obs.JOURNEY.find_tag("journey-live")
+    assert j is not None and j["completed"]
+    assert [p["phase"] for p in j["phases"]] == [
+        "queue_wait", "prefill_chunks", "decode"]
+    for a, b in zip(j["phases"], j["phases"][1:]):
+        assert a["t1_ms"] == b["t0_ms"]
+    assert j["handoff_ms"] is None and j["pools"] == []
+    entry = SLOWLOG.find(j["rid"])
+    assert entry is not None and entry["tag"] == "journey-live"
+    assert entry["e2e_ms"] == j["e2e_ms"]
+    assert entry["queue_wait_ms"] == j["phases"][0]["ms"]
+    assert entry["pool"] is None  # bare engine: no pool role
+    assert entry["handoff_ms"] is None and entry["handoff_bytes"] == 0
+    # the per-request observes carried the rid: this request was the
+    # newest in whatever buckets it landed in, so its rid is resident
+    rids = set()
+    for name in ("queue_wait_seconds", "ttft_seconds", "e2e_seconds"):
+        for ex in obs.REGISTRY.get(name).snapshot()["exemplars"]:
+            if ex is not None:
+                rids.add(ex[0])
+    assert j["rid"] in rids
+    # and the tracer ring can replay the rid's event spine
+    names = [n for _, n, _ in obs.TRACER.rid_events(j["rid"])]
+    assert "journey.complete" in names
+
+
+# ------------------------------------------------- daemon + bundles
+def test_daemon_journey_handler_rid_tag_and_listing():
+    from tpulab.daemon import handle_request
+
+    rid = next_rid()
+    _mark_disagg_chain(obs.JOURNEY, rid, t0=500.0, tag=f"jreq:{rid}")
+    got = json.loads(handle_request(
+        {"lab": "journey", "config": {"rid": rid}}, b""))
+    assert got["journey"]["rid"] == rid
+    assert [p["phase"] for p in got["journey"]["phases"]] == list(PHASES)
+    got = json.loads(handle_request(
+        {"lab": "journey", "config": {"tag": f"jreq:{rid}"}}, b""))
+    assert got["journey"]["rid"] == rid
+    got = json.loads(handle_request(
+        {"lab": "journey", "config": {"n": 4, "completed": True}}, b""))
+    assert any(j["rid"] == rid for j in got["journeys"])
+    assert all(j["completed"] for j in got["journeys"])
+    assert got["stats"]["capacity"] == obs.JOURNEY.capacity
+    got = json.loads(handle_request(
+        {"lab": "journey", "config": {"rid": 1 << 60}}, b""))
+    assert got["journey"] is None
+
+
+def test_configure_journey_resizes_global_in_place():
+    store = obs.JOURNEY
+    prior = store.capacity
+    try:
+        configure_journey(3)
+        assert obs.JOURNEY is store and store.capacity == 3
+        assert store.stats()["resident"] <= 3
+    finally:
+        configure_journey(prior)
+
+
+def test_flightrec_bundle_carries_journeys(tmp_path):
+    from tpulab.obs import flightrec
+
+    rid = next_rid()
+    _mark_disagg_chain(obs.JOURNEY, rid, t0=700.0, tag="crashing")
+    flightrec.configure_flightrec(tmp_path)
+    try:
+        path = flightrec.record_postmortem("journey-test", engine=None)
+        assert path is not None
+        bundle = json.loads(path.read_text())
+        assert any(j["rid"] == rid for j in bundle["journeys"])
+    finally:
+        flightrec.configure_flightrec(None)
+
+
+# ------------------------------------------------------- rendering
+def test_format_journey_waterfall_and_listing():
+    s = JourneyStore(capacity=4)
+    _mark_disagg_chain(s, 21, nbytes=2048, tag="render-me")
+    j = s.snapshot(21)
+    out = render.format_journey(j)
+    assert "journey rid=21 tag=render-me complete" in out
+    assert "pools=prefill>decode" in out
+    assert "handoff=30.0ms/2048B" in out
+    for name in PHASES:
+        assert name in out
+    assert "2048B" in out and "█" in out
+    assert render.format_journey(None).startswith("journey: not found")
+    listing = render.format_journeys(
+        {"journeys": s.recent(4), "stats": s.stats()})
+    assert "journeys: 1 shown, 1 completed" in listing
+    assert "rid=21" in listing and "dom=decode:110.0ms" in listing
+    assert render.format_journeys(None) == "journeys: none recorded"
+
+
+def test_pool_counts_and_fleet_table_roles():
+    assert router.pool_counts(
+        ["prefill", "prefill", "decode", None, ""]) == {
+            "prefill": 2, "decode": 1, "unified": 2}
+    fleet = {
+        "replicas": 3,
+        "pools": {"prefill": {"min": 1, "max": 2},
+                  "decode": {"min": 1, "max": 1}},
+        "replica": [
+            {"replica": 0, "health": "healthy", "role": "prefill",
+             "pending": 0, "active": 1, "requests_done": 4},
+            {"replica": 1, "health": "healthy", "role": "prefill",
+             "pending": 2, "active": 2, "requests_done": 1},
+            {"replica": 2, "health": "healthy", "role": "decode",
+             "pending": 0, "active": 3, "requests_done": 5},
+        ]}
+    out = render.format_fleet(fleet, {})
+    assert "pools: decode=1[1..1] prefill=2[1..2]" in out
+    assert "replica0 healthy     prefill" in out
+    assert "replica2 healthy     decode" in out
+    # a unified fleet renders WITHOUT the role column or pools line
+    for r in fleet["replica"]:
+        r["role"] = "unified"
+    fleet.pop("pools")
+    out = render.format_fleet(fleet, {})
+    assert "pools:" not in out and "unified" not in out
+
+
+def test_format_slowlog_pool_and_handoff_fields():
+    entry = {"rid": 5, "tag": "t", "e2e_ms": 12.0, "ttft_ms": 3.0,
+             "itl_max_ms": 1.0, "itl_max_at_token": 2,
+             "queue_wait_ms": 0.5, "prefill_chunks": 1, "tokens": 8,
+             "pool": "decode", "handoff_ms": 4.25, "handoff_bytes": 512}
+    out = render.format_slowlog({"worst": [entry], "recorded": 1})
+    assert "pool=decode" in out and "handoff=4.25ms/512B" in out
+    # pre-round-21 entries (no pool/handoff keys) render unchanged
+    for k in ("pool", "handoff_ms", "handoff_bytes"):
+        entry.pop(k)
+    out = render.format_slowlog({"worst": [entry], "recorded": 1})
+    assert "pool=" not in out and "handoff=" not in out
+
+
+# ------------------------------------------------------ catalog lint
+_EVT_RE = re.compile(r'\.(?:event|span|begin)\(\s*(f?)"([^"]+)"')
+
+
+def test_trace_event_catalog_lint():
+    """Every literal name passed to ``tracer.event``/``span``/``begin``
+    anywhere under tpulab/ must appear in the docs/ARCHITECTURE.md
+    trace-event catalog (mirror of the metric↔docs lint in
+    test_obs.py).  F-string names (``daemon.brownout.{direction}``)
+    lint their literal prefix."""
+    names = set()
+    for path in (ROOT / "tpulab").rglob("*.py"):
+        for m in _EVT_RE.finditer(path.read_text()):
+            name = m.group(2)
+            if m.group(1):  # f-string: lint the stable prefix
+                name = name.split("{", 1)[0]
+            names.add(name)
+    # the scan found the live emitters (guards against a refactor
+    # silently renaming the call pattern out from under the lint)
+    assert {"engine.submit", "engine.retire", "daemon.handoff",
+            "handoff.transfer", "journey.complete",
+            "engine.handoff_ready", "daemon.brownout."} <= names
+    docs = (ROOT / "docs" / "ARCHITECTURE.md").read_text()
+    missing = sorted(n for n in names if n not in docs)
+    assert not missing, (
+        f"trace events emitted but undocumented in "
+        f"docs/ARCHITECTURE.md: {missing}")

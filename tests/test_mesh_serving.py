@@ -379,6 +379,57 @@ def test_handoff_between_mesh_engines_bit_identical(trained, mesh24):
             len(eng.free), sorted(cached), eng.n_usable_blocks)
 
 
+def test_handoff_journey_stitched_across_mesh_engines(trained, mesh24):
+    """Round 21: the same mesh(2x4)-both-ends handoff, with the
+    journey tier armed — one rid's marks, dropped by TWO sharded
+    engines plus the (here hand-driven) daemon import site, stitch
+    into the full seven-phase disaggregated waterfall with shared
+    boundary timestamps, the handoff phases summing to ``handoff_ms``
+    and carrying the real payload byte count."""
+    from tpulab import obs
+    from tpulab.obs.journey import HANDOFF_PHASES, PHASES
+
+    kw = dict(slots=2, n_blocks=16, block_size=8, max_seq=72,
+              prefix_index="radix", spill_blocks=16, mesh=mesh24,
+              obs=True)
+    engp = PagedEngine(trained, CFG, **kw)
+    engd = PagedEngine(trained, CFG, **kw)
+    engp.pool_role = "prefill"  # daemon-stamped in production
+    engd.pool_role = "decode"
+    engp.handoff_at_boundary = True
+    engp.submit(_cycle_prompt(17), max_new=8, tag="mesh-journey")
+    while not engp.handoff_ready:
+        engp.step()
+    (req, payload), = engp.export_handoff()
+    # the daemon's import site (tpulab/daemon.py _resubmit_on),
+    # hand-driven: begin mark, import, end mark with measured bytes
+    obs.JOURNEY.mark(req.rid, "handoff_import_begin", pool="decode")
+    nbytes = engd.import_handoff(payload)
+    assert nbytes > 0
+    obs.JOURNEY.mark(req.rid, "handoff_import", pool="decode",
+                     nbytes=nbytes)
+    engd.resubmit(req, fresh_id=True)
+    engd.run()
+    j = obs.JOURNEY.snapshot(req.rid)
+    assert j is not None and j["completed"]
+    assert j["tag"] == "mesh-journey"
+    assert [p["phase"] for p in j["phases"]] == list(PHASES)
+    for a, b in zip(j["phases"], j["phases"][1:]):
+        assert a["t1_ms"] == b["t0_ms"]  # contiguous across engines
+    for p in j["phases"]:
+        assert p["ms"] >= 0
+    assert j["pools"] == ["prefill", "decode"]
+    assert j["handoff_bytes"] == nbytes
+    hsum = round(sum(p["ms"] for p in j["phases"]
+                     if p["phase"] in HANDOFF_PHASES), 3)
+    assert abs(hsum - j["handoff_ms"]) <= 0.01
+    # phase-side attribution: prefill phases ran in the prefill pool,
+    # decode phases in the decode pool
+    by = {p["phase"]: p for p in j["phases"]}
+    assert by["prefill_chunks"]["pool"] == "prefill"
+    assert by["decode"]["pool"] == "decode"
+
+
 # ------------------------------------------------ config-error arms
 def test_engine_config_error_arms(trained, mesh24):
     """Every still-uncertified combination refuses LOUDLY with

@@ -160,7 +160,8 @@ def test_counter_and_gauge():
 
 _PROM_LINE = re.compile(
     r'^(# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]*( .*)?'
-    r'|[a-zA-Z_:][a-zA-Z0-9_:]*(_bucket\{le="[^"]+"\})? -?[0-9.e+\-inf]+)$')
+    r'|[a-zA-Z_:][a-zA-Z0-9_:]*(_bucket\{le="[^"]+"\})? -?[0-9.e+\-inf]+'
+    r'( # \{rid="\d+"\} -?[0-9.e+\-inf]+)?)$')
 
 
 def test_prometheus_exposition_parses_line_by_line():
@@ -182,6 +183,15 @@ def test_prometheus_exposition_parses_line_by_line():
     assert "lat_seconds_count 3" in text
     assert "c_total 7" in text
     assert "g_now -1.25" in text
+    # round 21: exemplar-free output is byte-identical to the above;
+    # an rid-carrying observe adds the OpenMetrics exemplar suffix to
+    # exactly its bucket line, and the line still lints
+    h.observe(0.0002, rid=42)
+    text = r.render()
+    for line in text.splitlines():
+        assert _PROM_LINE.match(line), line
+    assert 'lat_seconds_bucket{le="0.001"} 2 # {rid="42"} 0.0002' in text
+    assert 'lat_seconds_bucket{le="1"} 3\n' in text  # no exemplar here
 
 
 def test_snapshot_is_copy_on_read_never_torn():

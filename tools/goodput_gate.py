@@ -87,6 +87,7 @@ ROOT = pathlib.Path(__file__).resolve().parent.parent
 sys.path.insert(0, str(ROOT))
 
 from tpulab import loadgen  # noqa: E402
+from tpulab.obs.journey import HANDOFF_PHASES  # noqa: E402
 from tpulab.obs.registry import percentile_from_buckets  # noqa: E402
 
 
@@ -414,6 +415,11 @@ def run_replay(args, rep, trace, *, extra_env=None, extra_args=None,
     the after-scrape, so convergence-phase counter movement lands in
     the deltas.  Returns every capture the report needs."""
     daemon_proc = None
+    extra_args = list(extra_args or ())
+    if getattr(args, "attribute", False):
+        # arm a deep journey store: every trace row's journey must
+        # still be resident when the attribution pass queries by tag
+        extra_args += ["--journeys", "4096"]
     if args.spawn_daemon:
         daemon_proc = _spawn_daemon(
             args.socket, max(args.slowlog, 16), 1 << 16,
@@ -445,6 +451,9 @@ def run_replay(args, rep, trace, *, extra_env=None, extra_args=None,
             fleet = json.loads(rep.request(args.socket, "fleet"))
         except Exception:
             fleet = None
+        journeys = None
+        if getattr(args, "attribute", False):
+            journeys = capture_journeys(rep, args.socket, results, after)
         roll = None
         if rolling:
             roll = rolling_restart(rep, args.socket, args.replicas, log)
@@ -452,7 +461,146 @@ def run_replay(args, rep, trace, *, extra_env=None, extra_args=None,
         _reap(daemon_proc)
     return {"results": results, "wall_s": wall_s, "before": before,
             "after": after, "slow": slow, "fleet": fleet, "roll": roll,
-            "settled": settled}
+            "settled": settled, "journeys": journeys}
+
+
+def capture_journeys(rep, sock: str, results, after) -> dict:
+    """Attribution captures taken while the replay daemon is still
+    alive (``--attribute``): one stitched journey per trace row —
+    joined on the wire tag, which the daemon threads into the journey
+    store — the store stats, and every histogram exemplar from the
+    after-scrape resolved back to a live journey rid.  The acceptance
+    pass consumes these after the daemon is gone."""
+    by_tag: dict = {}
+    for r in results:
+        tag = r.get("tag")
+        if not tag or tag in by_tag:
+            continue
+        try:
+            resp = json.loads(rep.request(sock, "journey", {"tag": tag}))
+            by_tag[tag] = resp.get("journey")
+        except Exception:
+            by_tag[tag] = None
+    exemplars = []
+    resolved_rids: dict = {}
+    for mname, m in sorted((after or {}).items()):
+        for le, (rid, v) in sorted((m.get("exemplars") or {}).items()):
+            if rid not in resolved_rids:
+                try:
+                    resp = json.loads(
+                        rep.request(sock, "journey", {"rid": rid}))
+                    resolved_rids[rid] = resp.get("journey") is not None
+                except Exception:
+                    resolved_rids[rid] = False
+            exemplars.append(
+                {"metric": mname,
+                 "le": "+Inf" if le == float("inf") else le,
+                 "rid": rid, "value": v,
+                 "resolved": resolved_rids[rid]})
+    try:
+        stats = json.loads(
+            rep.request(sock, "journey", {"n": 0})).get("stats")
+    except Exception:
+        stats = None
+    return {"by_tag": by_tag, "exemplars": exemplars, "stats": stats}
+
+
+def build_attribution(results, trace, jcap: dict, counters: dict,
+                      slowlog) -> dict:
+    """Per-phase SLO attribution (``--attribute``): fold the captured
+    journeys into per-request phase breakdowns, verify each journey's
+    internal invariants (contiguous + monotonic waterfall, handoff
+    phases summing to the recorded ``handoff_ms``, agreement with the
+    slowlog's entry for the same rid), and classify every SLO miss by
+    its dominant phase.  Returns the report section; ``problems`` is
+    the list of invariant violations the acceptance pass fails on."""
+    classes = {c["name"]: c for c in trace.classes}
+    by_tag = jcap.get("by_tag") or {}
+    slow_by_rid = {e.get("rid"): e for e in (slowlog or [])}
+    rows, misses, problems = [], [], []
+    dominant: dict = {}
+    handed = 0
+    bytes_sum = 0
+    for r in results:
+        if (r.get("cancelled") or r.get("shed") or r.get("rebuilding")
+                or not r.get("ok")):
+            continue
+        tag = r.get("tag")
+        j = by_tag.get(tag)
+        if not j:
+            problems.append(f"{tag}: completed request has no resident "
+                            f"journey")
+            continue
+        if not j.get("completed"):
+            problems.append(f"{tag}: journey never saw its retire mark")
+        phases = j.get("phases") or []
+        if not phases:
+            problems.append(f"{tag}: journey stitched zero phases")
+            continue
+        for a, b in zip(phases, phases[1:]):
+            if a["t1_ms"] != b["t0_ms"]:
+                problems.append(
+                    f"{tag}: waterfall not contiguous — {a['phase']} "
+                    f"ends at {a['t1_ms']}ms but {b['phase']} starts "
+                    f"at {b['t0_ms']}ms")
+        for p in phases:
+            if p["ms"] < 0 or p["t1_ms"] < p["t0_ms"]:
+                problems.append(f"{tag}: non-monotonic phase "
+                                f"{p['phase']} ({p['ms']}ms)")
+        hsum = round(sum(p["ms"] for p in phases
+                         if p["phase"] in HANDOFF_PHASES), 3)
+        if j.get("handoff_ms") is not None:
+            handed += 1
+            bytes_sum += int(j.get("handoff_bytes") or 0)
+            if abs(hsum - j["handoff_ms"]) > 0.01:
+                problems.append(
+                    f"{tag}: handoff phases sum to {hsum}ms but the "
+                    f"journey recorded handoff_ms={j['handoff_ms']}")
+            sl = slow_by_rid.get(j.get("rid"))
+            if (sl is not None and sl.get("handoff_ms") is not None
+                    and abs(sl["handoff_ms"] - j["handoff_ms"]) > 0.01):
+                problems.append(
+                    f"{tag}: slowlog handoff_ms={sl['handoff_ms']} "
+                    f"disagrees with journey {j['handoff_ms']}")
+        dom = max(phases, key=lambda p: p["ms"])
+        dominant[dom["phase"]] = dominant.get(dom["phase"], 0) + 1
+        c = classes[r["cls"]]
+        failed = []
+        if r["ttft_ms"] is None or r["ttft_ms"] > c["ttft_ms"]:
+            failed.append("ttft")
+        if r["itl_max_ms"] > c["itl_ms"]:
+            failed.append("itl")
+        if r["e2e_ms"] is None or r["e2e_ms"] > c["e2e_ms"]:
+            failed.append("e2e")
+        row = {"tag": tag, "rid": j["rid"], "cls": r["cls"],
+               "e2e_ms": j.get("e2e_ms"),
+               "dominant_phase": dom["phase"], "dominant_ms": dom["ms"],
+               "handoff_ms": j.get("handoff_ms"),
+               "handoff_bytes": j.get("handoff_bytes"),
+               "pools": j.get("pools"),
+               "phases": {p["phase"]: p["ms"] for p in phases}}
+        rows.append(row)
+        if failed:
+            misses.append(dict(row, failed=failed))
+    misses_by_phase: dict = {}
+    for m in misses:
+        misses_by_phase[m["dominant_phase"]] = (
+            misses_by_phase.get(m["dominant_phase"], 0) + 1)
+    exemplars = jcap.get("exemplars") or []
+    return {
+        "requests": rows,
+        "misses": misses,
+        "misses_by_phase": misses_by_phase,
+        "dominant_by_phase": dominant,
+        "handed_off": handed,
+        "handoff_bytes_sum": bytes_sum,
+        "counter_daemon_handoffs": counters.get("daemon_handoffs", 0),
+        "counter_handoff_bytes": counters.get("handoff_bytes", 0),
+        "exemplars": exemplars,
+        "exemplars_resolved": sum(1 for e in exemplars if e["resolved"]),
+        "journey_stats": jcap.get("stats"),
+        "problems": problems,
+    }
 
 
 def run_kill_replay(args, rep, trace, ref_wall_s: float,
@@ -650,6 +798,21 @@ def main(argv=None) -> int:
                          "daemon in the --disagg scenario (the default "
                          "gives the prefill pool scale-out headroom "
                          "and pins the decode pool)")
+    ap.add_argument("--attribute", action="store_true",
+                    help="per-phase SLO attribution (round 21): arm a "
+                         "deep journey store in the spawned daemon, "
+                         "join every trace row to its stitched "
+                         "cross-engine journey by wire tag, and gate "
+                         "on the journey invariants — every completed "
+                         "request has ONE journey whose phase "
+                         "waterfall is contiguous and monotonic "
+                         "across both pools, whose handoff phases sum "
+                         "to its recorded handoff_ms, whose bytes "
+                         "match the daemon_handoffs/handoff_bytes "
+                         "counter deltas exactly (--disagg), and at "
+                         "least one histogram exemplar resolves to a "
+                         "live journey rid; every SLO miss is broken "
+                         "down by its dominant phase in the report")
     ap.add_argument("--kill-at", type=float, default=0.4, metavar="F",
                     help="when to SIGKILL, as a fraction of the "
                          "reference replay's wall time (default 0.4)")
@@ -709,6 +872,13 @@ def main(argv=None) -> int:
         ap.error("--disagg is its own scenario: run --chaos/"
                  "--kill-daemon/--autoscale/--prefix-cache as "
                  "separate invocations")
+    if args.attribute and not args.spawn_daemon:
+        ap.error("--attribute needs --spawn-daemon (the attribution "
+                 "pass queries the journey store of the daemon the "
+                 "gate owns, before tearing it down)")
+    if args.attribute and args.kill_daemon:
+        ap.error("--attribute and --kill-daemon are incompatible: the "
+                 "SIGKILL restart resets the journey store mid-window")
     if args.kill_daemon:
         if not args.spawn_daemon:
             ap.error("--kill-daemon needs --spawn-daemon (the gate "
@@ -944,6 +1114,12 @@ def main(argv=None) -> int:
         report["prefix_cache"] = prefix_cache
     if disagg is not None:
         report["disagg"] = disagg
+    attribution = None
+    if args.attribute and run.get("journeys") is not None:
+        attribution = build_attribution(
+            results, trace, run["journeys"], report["counters"],
+            report["slowlog"])
+        report["attribution"] = attribution
     if run["roll"] is not None:
         report["rolling_restart"] = run["roll"]
     if args.out:
@@ -1280,6 +1456,65 @@ def main(argv=None) -> int:
               f"{counters.get('daemon_scale_outs', 0)} prefill "
               f"scale-out(s), decode pool fixed at {n_decode}",
               file=sys.stderr, flush=True)
+    if args.attribute:
+        # attribution acceptance: every completed request yielded one
+        # journey whose waterfall holds its invariants, the journeys'
+        # handoff accounting matches the daemon counters EXACTLY, and
+        # the scraped histograms carry at least one exemplar that
+        # resolves back to a real journey
+        if attribution is None:
+            print("[goodput_gate] FAIL: --attribute produced no "
+                  "journey capture", file=sys.stderr, flush=True)
+            rc = 1
+        else:
+            at = attribution
+            if at["problems"]:
+                for p in at["problems"][:5]:
+                    print(f"[goodput_gate] FAIL: journey invariant: "
+                          f"{p}", file=sys.stderr, flush=True)
+                if len(at["problems"]) > 5:
+                    print(f"[goodput_gate] FAIL: ... and "
+                          f"{len(at['problems']) - 5} more journey "
+                          f"invariant violation(s)",
+                          file=sys.stderr, flush=True)
+                rc = 1
+            if disagg is not None:
+                if at["handed_off"] != at["counter_daemon_handoffs"]:
+                    print(f"[goodput_gate] FAIL: {at['handed_off']} "
+                          f"journey(s) crossed the handoff edge but "
+                          f"daemon_handoffs moved by "
+                          f"{at['counter_daemon_handoffs']}",
+                          file=sys.stderr, flush=True)
+                    rc = 1
+                if at["handoff_bytes_sum"] != at["counter_handoff_bytes"]:
+                    print(f"[goodput_gate] FAIL: journey handoff bytes "
+                          f"sum to {at['handoff_bytes_sum']} but "
+                          f"handoff_bytes moved by "
+                          f"{at['counter_handoff_bytes']}",
+                          file=sys.stderr, flush=True)
+                    rc = 1
+            if at["exemplars_resolved"] < 1:
+                print("[goodput_gate] FAIL: no histogram exemplar "
+                      "resolves to a live journey rid (scraped "
+                      f"{len(at['exemplars'])} exemplar(s))",
+                      file=sys.stderr, flush=True)
+                rc = 1
+            dom = ", ".join(f"{k}={v}" for k, v in
+                            sorted(at["dominant_by_phase"].items(),
+                                   key=lambda kv: -kv[1]))
+            miss = (", ".join(
+                f"{k}={v}" for k, v in
+                sorted(at["misses_by_phase"].items(),
+                       key=lambda kv: -kv[1]))
+                or "none")
+            print(f"[goodput_gate] attribute: "
+                  f"{len(at['requests'])} journey(s) verified, "
+                  f"{at['handed_off']} handed off "
+                  f"({at['handoff_bytes_sum']} bytes == counters), "
+                  f"{at['exemplars_resolved']}/{len(at['exemplars'])} "
+                  f"exemplar(s) resolved, dominant phases: {dom}; "
+                  f"SLO misses by phase: {miss}",
+                  file=sys.stderr, flush=True)
     if run["roll"] is not None:
         roll = run["roll"]
         bad_roll = roll["shed"] + roll["rebuilding"] + roll["errors"]

@@ -13,7 +13,9 @@ entirely from the daemon's observability requests (tpulab/daemon.py):
     the requested rate series (tokens/s, requests/s, ticks/s);
   * ``alerts``   — the rule-engine state table, firing first (SLO burn
     rates, recompile/occupancy tripwires, staleness);
-  * ``slowlog``  — the worst-N requests by e2e, rid-linked to traces.
+  * ``slowlog``  — the worst-N requests by e2e, rid-linked to traces;
+  * ``journey``  — the newest cross-engine request journeys (round
+    21): pools crossed, dominant phase, handoff cost per request.
 
 All rendering is the SHARED module ``tpulab/obs/render.py`` — the same
 functions ``tools/obs_report.py`` uses for its one-shot summary, so the
@@ -64,7 +66,8 @@ _CLEAR = "\x1b[H\x1b[2J"
 
 
 def fetch(sock: str, *, window_s: float = 30.0,
-          series: tuple = DEFAULT_SERIES, slowlog_n: int = 5) -> dict:
+          series: tuple = DEFAULT_SERIES, slowlog_n: int = 5,
+          journeys_n: int = 4) -> dict:
     """One round of scrapes; every surface degrades independently
     (``None`` on failure) so a daemon mid-restart still renders."""
     out: dict = {}
@@ -84,6 +87,7 @@ def fetch(sock: str, *, window_s: float = 30.0,
          {"seconds": window_s, "series": list(series)})
     grab("alerts", "alerts")
     grab("slowlog", "slowlog", {"n": slowlog_n})
+    grab("journeys", "journey", {"n": journeys_n})
     return out
 
 
@@ -106,6 +110,7 @@ def render_frame(scr: dict, *, all_rules: bool = False,
         R.format_history(scr.get("history")),
         R.format_alerts(scr.get("alerts"), all_rules=all_rules),
         R.format_slowlog(scr.get("slowlog")),
+        R.format_journeys(scr.get("journeys")),
     ]
     if scr.get("errors"):
         parts.append("scrape errors: " + "; ".join(scr["errors"]))
@@ -123,6 +128,8 @@ def main(argv=None) -> int:
                     help="comma-separated rate series to sparkline")
     ap.add_argument("--slowlog", type=int, default=5, metavar="N",
                     help="worst-N slow requests per frame")
+    ap.add_argument("--journeys", type=int, default=4, metavar="N",
+                    help="newest-N request journeys per frame")
     ap.add_argument("--frames", type=int, default=0, metavar="N",
                     help="stop after N frames (0 = until ^C)")
     ap.add_argument("--once", action="store_true",
@@ -137,7 +144,8 @@ def main(argv=None) -> int:
     try:
         while True:
             scr = fetch(args.socket, window_s=args.window,
-                        series=series, slowlog_n=args.slowlog)
+                        series=series, slowlog_n=args.slowlog,
+                        journeys_n=args.journeys)
             frame = render_frame(scr, all_rules=args.all_rules,
                                  title=args.socket)
             if args.once:

@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Capture ONE real cross-pool request journey from a live disagg daemon.
+
+Round-21 evidence tool: spawns a private prefill/decode-pooled daemon
+(``--pool-spec prefill=1,decode=1`` — every request hands off), drives a
+single streamed generate through it, then asks the daemon's ``journey``
+request for the stitched record and writes it — together with the
+handoff counters it must agree with — to ``--out``
+(``results/obs_journey_r21.json`` is the committed capture).
+
+The capture is self-checking: it fails loudly unless the journey is
+complete, spans both pools, carries the full 7-phase disagg waterfall
+with contiguous monotonic phases, and its handoff bytes equal the
+daemon's ``handoff_bytes`` counter delta for the run (exactly one
+handoff, so the delta IS the payload).
+
+Usage::
+
+    python tools/obs_journey_capture.py --out results/obs_journey_r21.json
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import signal
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+from tpulab.obs.journey import HANDOFF_PHASES, PHASES  # noqa: E402
+
+import importlib.util as _ilu  # noqa: E402
+
+_spec = _ilu.spec_from_file_location(
+    "obs_report", pathlib.Path(__file__).resolve().parent / "obs_report.py")
+obs_report = _ilu.module_from_spec(_spec)
+_spec.loader.exec_module(obs_report)
+
+TAG = "journey-r21-capture"
+
+
+def _spawn(sock: str) -> subprocess.Popen:
+    if os.path.exists(sock):
+        os.unlink(sock)
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "tpulab.daemon", "--socket", sock,
+         "--replicas", "1", "--pool-spec", "prefill=1,decode=1",
+         "--prefix-index", "radix", "--spill-blocks", "512"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise RuntimeError(f"daemon exited rc={proc.returncode}")
+        if os.path.exists(sock):
+            return proc
+        time.sleep(0.1)
+    proc.kill()
+    proc.wait()
+    raise RuntimeError("daemon socket never appeared")
+
+
+def _reap(proc) -> None:
+    if proc is None or proc.poll() is not None:
+        if proc is not None:
+            proc.wait()
+        return
+    proc.send_signal(signal.SIGTERM)
+    try:
+        proc.wait(timeout=30)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
+
+
+def _counters(metrics: dict) -> dict:
+    return {k: v.get("value", 0) for k, v in metrics.items()
+            if v.get("type") == "counter"}
+
+
+def capture(sock: str) -> dict:
+    prompt = ("The observability tier stitches one causal journey per "
+              "request across every engine that touched it. " * 3)
+    # warm both pools first so the committed journey measures serving,
+    # not prefill/decode compile (the counters below are deltas, so the
+    # warmup's own handoff stays out of the evidence)
+    obs_report.request(sock, "generate",
+                       {"steps": 8, "stream": True}, prompt.encode())
+    before = _counters(obs_report.parse_prometheus(
+        obs_report.request(sock, "metrics").decode()))
+    out = obs_report.request(
+        sock, "generate",
+        {"steps": 24, "stream": True, "tag": TAG}, prompt.encode())
+    assert out, "generate returned no output"
+    j = json.loads(obs_report.request(
+        sock, "journey", {"tag": TAG}).decode())["journey"]
+    assert j is not None, f"no journey recorded for tag {TAG!r}"
+    after = _counters(obs_report.parse_prometheus(
+        obs_report.request(sock, "metrics").decode()))
+
+    # self-check: this is evidence, not a screenshot
+    assert j["completed"], j
+    phases = [p["phase"] for p in j["phases"]]
+    assert phases == list(PHASES), phases
+    for a, b in zip(j["phases"], j["phases"][1:]):
+        assert a["t1_ms"] == b["t0_ms"], (a, b)
+    for p in j["phases"]:
+        assert p["ms"] >= 0 and p["t1_ms"] >= p["t0_ms"], p
+    assert j["pools"] == ["prefill", "decode"], j["pools"]
+    hsum = round(sum(p["ms"] for p in j["phases"]
+                     if p["phase"] in HANDOFF_PHASES), 3)
+    assert abs(hsum - j["handoff_ms"]) <= 0.01, (hsum, j["handoff_ms"])
+    dh = after.get("daemon_handoffs", 0) - before.get("daemon_handoffs", 0)
+    db = after.get("handoff_bytes", 0) - before.get("handoff_bytes", 0)
+    assert dh == 1, f"expected exactly one handoff, counter moved {dh}"
+    assert j["handoff_bytes"] == db, (j["handoff_bytes"], db)
+
+    return {
+        "round": 21,
+        "tool": "tools/obs_journey_capture.py",
+        "daemon": {"pool_spec": "prefill=1,decode=1", "replicas_per_pool": 1},
+        "request": {"tag": TAG, "steps": 24,
+                    "prompt_bytes": len(prompt.encode())},
+        "counters_delta": {"daemon_handoffs": dh, "handoff_bytes": db},
+        "journey": j,
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--socket", default="/tmp/tpulab_journey_capture.sock")
+    ap.add_argument("--out", default="results/obs_journey_r21.json")
+    args = ap.parse_args(argv)
+
+    proc = _spawn(args.socket)
+    try:
+        doc = capture(args.socket)
+    finally:
+        _reap(proc)
+    pathlib.Path(args.out).parent.mkdir(parents=True, exist_ok=True)
+    pathlib.Path(args.out).write_text(json.dumps(doc, indent=1) + "\n")
+    j = doc["journey"]
+    print(f"[journey_capture] rid={j['rid']} e2e={j['e2e_ms']}ms "
+          f"handoff={j['handoff_ms']}ms/{j['handoff_bytes']}B "
+          f"pools={'>'.join(j['pools'])} -> {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -73,7 +73,8 @@ from tpulab.loadgen import SHED_RE as _SHED_RE  # noqa: E402
 # capture scripts) keep working.
 from tpulab.obs.render import (LATENCY_METRICS as _LATENCY_METRICS,  # noqa: E402,F401
                                format_alerts, format_fleet,
-                               format_history, format_latency_table,
+                               format_history, format_journey,
+                               format_journeys, format_latency_table,
                                format_slowlog, histogram_percentile,
                                parse_prometheus, summarize)
 
@@ -302,6 +303,17 @@ def main(argv=None) -> int:
                     help="also print the daemon's worst-N slow-log "
                          "entries (per-request span summaries; each "
                          "rid links to the trace_dump events)")
+    ap.add_argument("--journey", default=None, metavar="RID|TAG",
+                    help="print ONE request's stitched cross-engine "
+                         "journey as a phase waterfall (queue -> "
+                         "prefill -> handoff export/transfer/import -> "
+                         "decode), looked up by server rid (integer) "
+                         "or wire tag; rids come from slowlog entries, "
+                         "trace events, and histogram exemplars")
+    ap.add_argument("--journeys", type=int, default=0, metavar="N",
+                    help="also print the N newest request journeys "
+                         "(one line each: pools crossed, dominant "
+                         "phase, handoff cost)")
     ap.add_argument("--alerts", action="store_true",
                     help="also print the daemon's alert state table "
                          "(tpulab.obs.alerts — SLO burn rates, "
@@ -355,6 +367,18 @@ def main(argv=None) -> int:
     if args.slowlog:
         slow = json.loads(request(args.socket, "slowlog",
                                   {"n": args.slowlog}))
+    journey = None
+    if args.journey is not None:
+        # integer -> server rid lookup; anything else -> wire tag
+        try:
+            cfg = {"rid": int(args.journey)}
+        except ValueError:
+            cfg = {"tag": args.journey}
+        journey = json.loads(request(args.socket, "journey", cfg))
+    journeys = None
+    if args.journeys:
+        journeys = json.loads(request(args.socket, "journey",
+                                      {"n": args.journeys}))
     alerts = None
     if args.alerts:
         alerts = json.loads(request(args.socket, "alerts"))
@@ -381,6 +405,10 @@ def main(argv=None) -> int:
             out["fleet"] = fleet
         if slow is not None:
             out["slowlog"] = slow.get("worst", [])
+        if journey is not None:
+            out["journey"] = journey.get("journey")
+        if journeys is not None:
+            out["journeys"] = journeys
         if alerts is not None:
             out["alerts"] = alerts
         if hist is not None:
@@ -402,6 +430,10 @@ def main(argv=None) -> int:
         print(format_alerts(alerts))
     if slow is not None:
         print(format_slowlog(slow))
+    if journey is not None:
+        print(format_journey(journey.get("journey")))
+    if journeys is not None:
+        print(format_journeys(journeys))
     if roof is not None:
         print(format_roofline(roof))
     if pm is not None:

@@ -1,0 +1,66 @@
+#!/bin/bash
+# Round-21 sequential on-chip evidence queue (single chip -- no
+# contention).  Built on tools/onchip_lib.sh (which sources
+# relay_lib.sh -- the one wait_relay copy; claim discipline per
+# docs/tpu_runs.md: TPU-claiming processes are WAITED on, never
+# killed).
+#
+# Round-21 ordering: the JOURNEY/ATTRIBUTION evidence lands FIRST and
+# is HOST-ONLY (CPU backend), so a wedged relay cannot block the
+# round's headline evidence:
+#   * journey_gate: tools/goodput_gate.py --disagg --attribute -- the
+#     r20 disagg A/B plus per-request journey acceptance: every
+#     completed request one stitched journey with a contiguous
+#     monotonic phase waterfall across both pools, handoff phases
+#     summing to handoff_ms, journey bytes == the
+#     daemon_handoffs/handoff_bytes counter deltas EXACTLY, >= 1
+#     histogram exemplar resolving to a live journey, SLO misses
+#     attributed by dominant phase.
+#   * journey_capture: tools/obs_journey_capture.py -- drives ONE
+#     real handed-off request through a live disagg daemon and
+#     commits its stitched journey (results/obs_journey_r21.json).
+#   * journey_tests: tests/test_journey.py + the exemplar lint in
+#     tests/test_obs.py + the mesh(2,4)-both-ends journey recert.
+#   * journey_bench: bench.py bench_journey_overhead -- tracer +
+#     journey store + exemplars armed vs fully dark, ratcheting the
+#     signed journey_overhead_4slots_ticks_per_s row (< 3% budget).
+# Only then the relay-gated tail (r20 ordering preserved).
+
+. "$(dirname "$0")/onchip_lib.sh"   # sources relay_lib.sh
+onchip_init
+
+# -- journey/attribution tier: HOST-ONLY, no relay gate
+host_stage journey_gate env JAX_PLATFORMS=cpu \
+    python tools/goodput_gate.py --spawn-daemon --spec disagg --disagg \
+    --attribute --replicas 1 --spill-blocks 512 \
+    --out results/goodput_disagg_attr_r21.json
+host_stage journey_capture env JAX_PLATFORMS=cpu \
+    python tools/obs_journey_capture.py --out results/obs_journey_r21.json
+host_stage journey_tests env JAX_PLATFORMS=cpu \
+    python -m pytest tests/test_journey.py tests/test_obs.py \
+    "tests/test_mesh_serving.py::test_handoff_journey_stitched_across_mesh_engines" \
+    -q -m 'not slow' -p no:cacheprovider
+host_stage journey_bench env JAX_PLATFORMS=cpu python -c "
+import json
+from tpulab.bench import bench_journey_overhead
+print(json.dumps(bench_journey_overhead()))"
+# the gate prints its baselines rows to stdout (the stage log); the
+# bench prints its single row the same way -- merge, newest-unique
+grep -h '"metric"' "$L/journey_gate.log" "$L/journey_bench.log" \
+    2>/dev/null | awk '!seen[$0]++' > results/journey_rows_r21.jsonl || true
+ratchet results/journey_rows_r21.jsonl \
+    "round 21 (onchip_queue_r21, journey/attribution tier)"
+
+# -- the relay-gated tail, round-20 ordering preserved
+stage serving_int    python tools/serving_tpu.py
+stage bench_r21      python bench.py --skip-probe
+grep -h '"metric"' "$L/bench_r21.log" 2>/dev/null \
+    | awk '!seen[$0]++' > results/bench_r21.jsonl || true
+stage parity         python tools/pallas_tpu_parity.py
+stage flash_train    python tools/flash_train_proof.py
+stage mfu_probe      python tools/train_mfu_probe.py
+stage ref_harness2   python tools/run_reference_harness.py --backend tpu --lab lab2 --k-times 5
+stage ref_harness3   python tools/run_reference_harness.py --backend tpu --lab lab3 --k-times 5
+ratchet results/bench_r21.jsonl "round 21 (onchip_queue_r21)"
+resign
+onchip_done

@@ -1182,6 +1182,10 @@ class _Replica:
         self.tok = tok
         engine.replica_index = index
         engine.fault_scope = self.scope
+        # round 21: the engine stamps its pool role onto journey marks
+        # and slow-log entries — the fleet is the only party that
+        # knows which pool a slot serves
+        engine.pool_role = role
         if role == _router.ROLE_PREFILL:
             # a prefill-pool engine parks finished prefills for export
             # instead of decoding them (round 20 handoff)
@@ -1830,7 +1834,32 @@ class _FleetService:
                 # — greedy streams are identical either way
                 req.spec = "off"
             nbytes = 0
-            if payload:
+            if handoff:
+                # journey (round 21): the cross-pool transfer window
+                # closes when the decode engine starts importing; the
+                # import phase closes when the spill puts return.  The
+                # payload size is measured ONCE here — the same nbytes
+                # the handoff_bytes counter ingests below — so journey
+                # bytes and the counter can be compared exactly.
+                _obs.JOURNEY.mark(req.rid, "handoff_import_begin",
+                                  replica=replica.index,
+                                  pool=replica.role)
+                if payload:
+                    nbytes = eng.import_handoff(payload)
+                t_imp = time.monotonic()
+                _obs.JOURNEY.mark(req.rid, "handoff_import", t=t_imp,
+                                  replica=replica.index,
+                                  pool=replica.role, nbytes=nbytes)
+                req.handoff_bytes += nbytes
+                if req.t_prefill_done:
+                    # park -> import-complete wall time: the slow-log
+                    # handoff_ms field, and BY CONSTRUCTION the sum of
+                    # the journey's three handoff phases (they span
+                    # [handoff_ready .. handoff_import], and the ready
+                    # mark reuses t_prefill_done)
+                    req.handoff_ms = round(
+                        (t_imp - req.t_prefill_done) * 1e3, 3)
+            elif payload:
                 nbytes = eng.import_handoff(payload)
             try:
                 rid_e = eng.resubmit(req, fresh_id=True)
@@ -1862,9 +1891,15 @@ class _FleetService:
                 tkt.req.migrations += 1
                 _C_MIGRATIONS.inc()
                 _obs.event("daemon.migrate", tkt.req.rid)
+                _obs.JOURNEY.mark(tkt.req.rid, "migrate",
+                                  replica=replica.index,
+                                  pool=replica.role)
             else:
                 _C_REPLAYS.inc()
                 _obs.event("daemon.replay", tkt.req.rid)
+                _obs.JOURNEY.mark(tkt.req.rid, "replay",
+                                  replica=replica.index,
+                                  pool=replica.role)
             fleet.cv.notify_all()
         return True
 
@@ -1899,6 +1934,9 @@ class _FleetService:
         try:
             if _faults.ACTIVE:
                 _faults.fire("daemon.handoff", replica.scope)
+            # the payload left the prefill engine (export released its
+            # blocks) and is now in flight toward a decode placement
+            _obs.event("handoff.transfer", req.rid)
             tried = {replica.index}
             while True:
                 target = self._place(fleet, req.prompt, tried,
@@ -1964,8 +2002,9 @@ class _FleetService:
             return
         eng.replica_index = replica.index
         eng.fault_scope = replica.scope
+        eng.pool_role = replica.role        # the slot's role survives
         if replica.role == _router.ROLE_PREFILL:
-            eng.handoff_at_boundary = True  # the slot's role survives
+            eng.handoff_at_boundary = True
         with replica.cond:
             replica.engine = eng
             replica.tok = tok
@@ -3558,6 +3597,39 @@ def _handle_slowlog(header: dict) -> bytes:
     ).encode("utf-8")
 
 
+def _handle_journey(header: dict) -> bytes:
+    """``journey`` request (round 21): stitched cross-engine request
+    journeys from :data:`tpulab.obs.JOURNEY` — the phase waterfall
+    (queue_wait → prefill → handoff export/transfer/import →
+    decode_queue → decode) with per-phase wall time, handoff bytes,
+    and replica/pool, assembled from the marks every engine and the
+    fleet layer dropped for the rid.  Config:
+
+    * ``rid`` — one journey by server rid (the id slow-log entries,
+      trace events, and histogram exemplars carry);
+    * ``tag`` — one journey by the caller's wire tag (the loadgen
+      journal key — newest match wins);
+    * neither — the ``n`` newest journeys (default 8), plus store
+      stats.  ``completed`` restricts the listing to retired requests.
+
+    Size the store with ``--journeys`` (0 disables)."""
+    from tpulab import obs
+
+    config = header.get("config") or {}
+    if config.get("rid") is not None:
+        j = obs.JOURNEY.snapshot(int(config["rid"]))
+        return json.dumps({"journey": j}).encode("utf-8")
+    if config.get("tag"):
+        j = obs.JOURNEY.find_tag(str(config["tag"]))
+        return json.dumps({"journey": j}).encode("utf-8")
+    n = int(config.get("n", 8))
+    return json.dumps({
+        "journeys": obs.JOURNEY.recent(
+            n, completed_only=bool(config.get("completed"))),
+        "stats": obs.JOURNEY.stats(),
+    }).encode("utf-8")
+
+
 # ---------------------------------------------------------------- sampler
 #
 # Round 15: the TIME dimension.  One background sampler per daemon
@@ -3984,6 +4056,8 @@ def handle_request(header: dict, payload: bytes,
         return _handle_postmortem(header)
     if header.get("lab") == "slowlog":
         return _handle_slowlog(header)
+    if header.get("lab") == "journey":
+        return _handle_journey(header)
     if header.get("lab") == "history":
         return _handle_history(header)
     if header.get("lab") == "alerts":
@@ -4330,6 +4404,11 @@ def main(argv=None) -> int:
                          "disables).  Read with a 'slowlog' request — "
                          "each entry's rid links to its trace_dump "
                          "events")
+    ap.add_argument("--journeys", type=int, default=None, metavar="N",
+                    help="cross-engine request-journey store: keep the "
+                         "newest N requests' stitched phase waterfalls "
+                         "(default 256; 0 disables).  Read with a "
+                         "'journey' request by rid, tag, or recency")
     args = ap.parse_args(argv)
     if args.replicas < 1:
         ap.error("--replicas must be >= 1")
@@ -4341,6 +4420,8 @@ def main(argv=None) -> int:
         ap.error("--trace-buffer must be >= 0")
     if args.slowlog is not None and args.slowlog < 0:
         ap.error("--slowlog must be >= 0")
+    if args.journeys is not None and args.journeys < 0:
+        ap.error("--journeys must be >= 0")
     if args.metrics_interval < 0:
         ap.error("--metrics-interval must be >= 0 (0 disables)")
     if args.spill_blocks < 0:
@@ -4420,6 +4501,10 @@ def main(argv=None) -> int:
         from tpulab import obs
 
         obs.configure_slowlog(args.slowlog)
+    if args.journeys is not None:
+        from tpulab import obs
+
+        obs.configure_journey(args.journeys)
     if _faults.configure_from_env():
         # chaos runs against a REAL daemon: arm the injector from
         # TPULAB_FAULTS (JSON schedule) — absent means inert

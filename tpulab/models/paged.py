@@ -117,6 +117,7 @@ from tpulab import faults as _faults
 from tpulab.kvcache import spill as _spill_mod
 from tpulab.kvcache.radix import RadixPrefixIndex as _RadixPrefixIndex
 from tpulab.obs import compilestats as _cstats
+from tpulab.obs import journey as _obs_journey
 from tpulab.obs import tracer as _obs_tracer
 from tpulab.obs.registry import gauge as _obs_gauge
 from tpulab.obs.registry import histogram as _obs_histogram
@@ -837,6 +838,13 @@ class _Request:
     t_prefill_done: float = 0.0
     itl_max: float = 0.0        # worst inter-token gap (seconds)...
     itl_max_at: int = 0         # ...and the token index it ended at
+    # round 21 cross-pool handoff attribution, set by the DAEMON when
+    # it imports this request's KV onto the decode engine: payload
+    # bytes (the same number the handoff_bytes counter ingests) and
+    # park→import-complete wall time.  None/0 for requests that never
+    # crossed pools — the slow-log entry renders them only when set.
+    handoff_ms: Optional[float] = None
+    handoff_bytes: int = 0
 
     def total_positions(self) -> int:
         """Positions this request can ever occupy: prompt + remaining
@@ -848,12 +856,14 @@ class _Request:
         return len(self.prompt) + self.max_new - self.n_resumed
 
 
-def _span_summary(req: _Request, now: float) -> Dict:
+def _span_summary(req: _Request, now: float,
+                  pool: Optional[str] = None) -> Dict:
     """Compact per-request span summary for the slow log (milliseconds,
     host timestamps only — built ONCE at retirement, never per tick).
     Zero timestamps (a span that never happened: no token before a
     cancel, no interleaved prefill) render as None rather than a bogus
-    submit-relative delta."""
+    submit-relative delta.  ``pool`` is the retiring engine's pool role
+    (round 21) — for a handed-off request that is the DECODE pool."""
     ms = 1e3
     return {
         "rid": req.rid,
@@ -880,6 +890,12 @@ def _span_summary(req: _Request, now: float) -> Dict:
         "replica_first_token": req.first_replica,
         "replica_hops": list(req.hops),
         "migrations": req.migrations,
+        # cross-pool attribution (round 21): which pool retired the
+        # request, and — when the daemon handed its KV across pools —
+        # what the handoff cost in wall time and payload bytes
+        "pool": pool,
+        "handoff_ms": req.handoff_ms,
+        "handoff_bytes": req.handoff_bytes,
         "priority": req.priority,
         "cancelled": bool(req.cancelled),
     }
@@ -1212,6 +1228,13 @@ class PagedEngine:
         # once here so the hot paths never branch on the flag for spans
         self.obs = bool(obs)
         self._trace = _obs_tracer.TRACER if self.obs else _obs_tracer.NULL
+        # round 21: the cross-engine journey store — bound once like
+        # the trace handle (obs=False engines get the disabled twin,
+        # whose mark() returns before taking any lock).  Marks are
+        # per lifecycle EDGE (submit/admit/park/retire — never per
+        # token), so the journey tier rides inside the same <3%
+        # obs_overhead budget the tracer and histograms share.
+        self._journey = _obs_journey.JOURNEY if self.obs else _obs_journey.NULL
         # fleet identity (set by the daemon's router layer, None for a
         # bare engine): ``replica_index`` stamps requests' slow-log
         # replica attribution; ``fault_scope`` scopes this engine's
@@ -1219,6 +1242,11 @@ class PagedEngine:
         # schedules can target ONE replica out of N identical engines
         self.replica_index: Optional[int] = None
         self.fault_scope: Optional[str] = None
+        # which pool this engine serves ("prefill"/"decode"/"unified"),
+        # stamped by the daemon next to replica_index; journey marks
+        # and slow-log entries carry it (round 21) — None for a bare
+        # engine outside any fleet
+        self.pool_role: Optional[str] = None
         # disaggregated serving (round 20): a PREFILL-pool engine sets
         # handoff_at_boundary — at the PREFILLING->DECODING edge the
         # slot parks in phase "handoff" (inert to every dispatch path)
@@ -1453,6 +1481,13 @@ class PagedEngine:
             req.hops.append(self.replica_index)
         if self.obs:
             self._trace.event("engine.submit", req.rid)
+            # journey anchor mark: the same t_submit the latency
+            # histograms measure from.  A handed-off request's SECOND
+            # submit (resubmit on the decode engine) never lands here —
+            # resubmit() re-queues without re-entering submit().
+            self._journey.mark(req.rid, "submit", t=req.t_submit,
+                               replica=self.replica_index,
+                               pool=self.pool_role, tag=req.tag)
         self.pending.append(req)
         return req_id
 
@@ -1657,8 +1692,15 @@ class PagedEngine:
             self.counters["admissions"] += 1
             req.t_admit = time.monotonic()
             if self.obs:
-                _H_QUEUE_WAIT.observe(req.t_admit - req.t_submit)
+                _H_QUEUE_WAIT.observe(req.t_admit - req.t_submit,
+                                      rid=req.rid)
                 self._trace.event("engine.admit", req.rid)
+                # shares req.t_admit with the histogram observation, so
+                # the journey's queue_wait phase and the queue_wait
+                # histogram agree exactly
+                self._journey.mark(req.rid, "admit", t=req.t_admit,
+                                   replica=self.replica_index,
+                                   pool=self.pool_role)
             fresh = [self.free.pop() for _ in range(need_new)]
             for b in fresh:
                 self.block_refs[b] += 1
@@ -1718,7 +1760,12 @@ class PagedEngine:
                     # dispatch-side prefill wall time (the synchronous
                     # path runs every chunk inline right here)
                     req.t_prefill_done = time.monotonic()
-                    _H_PREFILL.observe(req.t_prefill_done - req.t_admit)
+                    _H_PREFILL.observe(req.t_prefill_done - req.t_admit,
+                                       rid=req.rid)
+                    self._journey.mark(req.rid, "prefill_done",
+                                       t=req.t_prefill_done,
+                                       replica=self.replica_index,
+                                       pool=self.pool_role)
                 self._push_slot(s, True)
 
     def _register_prefix(self, prompt: np.ndarray, row: np.ndarray):
@@ -1945,7 +1992,12 @@ class PagedEngine:
             # interleaved prefill; the chunks themselves ride the async
             # dispatch stream)
             req.t_prefill_done = time.monotonic()
-            _H_PREFILL.observe(req.t_prefill_done - req.t_admit)
+            _H_PREFILL.observe(req.t_prefill_done - req.t_admit,
+                               rid=req.rid)
+            self._journey.mark(req.rid, "prefill_done",
+                               t=req.t_prefill_done,
+                               replica=self.replica_index,
+                               pool=self.pool_role)
         self._push_slot(s, True)
 
     def _prefill_tick(self) -> List[int]:
@@ -2003,11 +2055,11 @@ class PagedEngine:
                 # is exactly what a streaming client experiences
                 req.t_first = now
                 req.first_replica = self.replica_index
-                _H_TTFT.observe(now - req.t_submit)
+                _H_TTFT.observe(now - req.t_submit, rid=req.rid)
                 self._trace.event("engine.first_token", req.rid)
             elif req.t_last:
                 itl = now - req.t_last
-                _H_ITL.observe(itl)
+                _H_ITL.observe(itl, rid=req.rid)
                 if itl > req.itl_max:
                     # the worst inter-token gap AND the token index it
                     # ended at: the slow-log's "here is the tick where
@@ -2038,9 +2090,14 @@ class PagedEngine:
         already released mid-decode."""
         if self.obs:
             now = time.monotonic()
-            _H_E2E.observe(now - req.t_submit)
+            _H_E2E.observe(now - req.t_submit, rid=req.rid)
             self._trace.event("engine.retire", req.rid)
-            _SLOWLOG.record(_span_summary(req, now))
+            _SLOWLOG.record(_span_summary(req, now, self.pool_role))
+            # retire closes the journey (same ``now`` as the e2e
+            # observation and the slow-log entry, so all three agree)
+            self._journey.mark(req.rid, "retire", t=now,
+                               replica=self.replica_index,
+                               pool=self.pool_role)
         self._release_blocks(s, req)
         self._clear_slot(s)
         self._done[req.req_id] = np.asarray(req.out, np.int32)
@@ -2154,8 +2211,17 @@ class PagedEngine:
         req.phase = "handoff"
         if self.obs:
             req.t_prefill_done = time.monotonic()
-            _H_PREFILL.observe(req.t_prefill_done - req.t_admit)
+            _H_PREFILL.observe(req.t_prefill_done - req.t_admit,
+                               rid=req.rid)
             self._trace.event("engine.handoff_ready", req.rid)
+            # opens the handoff_export journey phase: prefill is done,
+            # the request now waits for the daemon's post-step drain
+            # (export d2h + transfer + import close it, marked by
+            # export_handoff below and the daemon's import site)
+            self._journey.mark(req.rid, "handoff_ready",
+                               t=req.t_prefill_done,
+                               replica=self.replica_index,
+                               pool=self.pool_role)
         self.handoff_ready.append((s, req))
 
     def export_handoff(self) -> List[Tuple["_Request", List[tuple]]]:
@@ -2193,6 +2259,13 @@ class PagedEngine:
                 self._trace.event("engine.handoff_export", req.rid)
             self._release_blocks(s, req)
             self._clear_slot(s)
+            if self.obs:
+                # export complete: the payload leaves this engine; the
+                # handoff_transfer journey phase runs from here until
+                # the decode engine's import begins (daemon-marked)
+                self._journey.mark(req.rid, "handoff_export",
+                                   replica=self.replica_index,
+                                   pool=self.pool_role)
             out.append((req, payload))
         return out
 

@@ -12,6 +12,15 @@ Two stdlib-only primitives the whole stack records into:
 * :mod:`tpulab.obs.slowlog` — bounded worst-N per-request span
   summaries (the daemon's ``slowlog`` request), rid-linked to the
   tracer's event stream.
+* :mod:`tpulab.obs.journey` — round 21: the cross-engine request
+  journey store.  Engines and the daemon drop rid-keyed phase marks;
+  the store stitches them at read time into ONE causal record per
+  request with a contiguous phase waterfall (queue_wait → prefill →
+  handoff export/transfer/import → decode_queue → decode), each phase
+  carrying wall-time, handoff bytes, and the replica/pool it ran on
+  (the daemon's ``journey`` request).  Histogram *exemplars* in the
+  registry link each latency bucket to the newest rid that landed
+  there, so a p99 resolves to a concrete journey.
 
 The round-15 time dimension sits directly on the registry:
 
@@ -65,6 +74,8 @@ from tpulab.obs.history import (HISTORY, MetricsHistory, Sampler, Window,
                                 fraction_le)
 from tpulab.obs.flightrec import (configure_flightrec, latest_postmortem,
                                   record_postmortem)
+from tpulab.obs.journey import (JOURNEY, HANDOFF_PHASES, JourneyStore,
+                                PHASES, configure_journey)
 from tpulab.obs.profiler import EventLog, annotate, maybe_trace
 from tpulab.obs.registry import (DEFAULT_BUCKETS, REGISTRY, Counter, Gauge,
                                  Histogram, Registry, counter, gauge,
@@ -76,11 +87,14 @@ from tpulab.obs.tracer import (DEFAULT_CAPACITY, NULL, TRACER, Tracer,
 
 __all__ = [
     "ALERTS", "COMPILESTATS", "DEFAULT_BUCKETS", "DEFAULT_CAPACITY",
-    "HISTORY", "REGISTRY", "SLOWLOG", "AlertManager", "BurnRateRule",
+    "HANDOFF_PHASES", "HISTORY", "JOURNEY", "PHASES", "REGISTRY",
+    "SLOWLOG", "AlertManager", "BurnRateRule",
     "CompileStats", "Counter", "EventLog", "Gauge", "Histogram",
-    "MetricsHistory", "NULL", "RecompileError", "Registry", "Rule",
+    "JourneyStore", "MetricsHistory", "NULL", "RecompileError",
+    "Registry", "Rule",
     "Sampler", "SlowLog", "TRACER", "ThresholdRule", "Tracer", "Window",
     "annotate", "configure_flightrec", "configure_history",
+    "configure_journey",
     "configure_slowlog", "configure_tracer", "counter", "counts_delta",
     "default_rules", "event", "fraction_le", "gauge", "histogram",
     "install_default_rules", "instrument", "latest_postmortem",

@@ -22,6 +22,9 @@ Bundle schema (version 1)::
       "compile_stats": COMPILESTATS.snapshot(),
       "metrics": REGISTRY.snapshot(),      # every counter/gauge/histogram
       "slowlog": SLOWLOG worst-N,
+      "journeys": [...stitched cross-engine journeys (round 21) for
+                   the dying engine's in-flight rids — the request the
+                   crash killed explains itself across pools...],
       "alerts": [...alert rows FIRING at the time of death...],
       "trace": {"events": [...last-N chrome events...],
                 "recorded": int, "dropped": int},
@@ -103,6 +106,34 @@ def _engine_section(engine) -> Optional[Dict[str, Any]]:
     return out
 
 
+def _journey_section(engine, n: int) -> List[Dict[str, Any]]:
+    """Stitched journeys (tpulab.obs.journey) for the dying engine's
+    in-flight requests — pending queue + active slots — so the bundle
+    carries each killed request's FULL cross-engine story (a handed-off
+    request's prefill ran on another replica; per-engine state alone
+    cannot explain it).  Falls back to the store's ``n`` newest when
+    the engine is absent/unreadable.  Guarded like the alerts section:
+    a broken journey store must not break crash recording."""
+    try:
+        from tpulab.obs.journey import JOURNEY
+
+        rids = []
+        if engine is not None:
+            for req in list(getattr(engine, "pending", None) or []):
+                rids.append(getattr(req, "rid", 0))
+            for req in list(getattr(engine, "active", None) or []):
+                if req is not None:
+                    rids.append(getattr(req, "rid", 0))
+        out = []
+        for rid in dict.fromkeys(r for r in rids if r):
+            j = JOURNEY.snapshot(rid)
+            if j is not None:
+                out.append(j)
+        return out if out else JOURNEY.recent(n)
+    except Exception:  # noqa: BLE001
+        return []
+
+
 def record_postmortem(reason: str, *, engine=None, err=None,
                       trace_events: int = 1024, slow_n: int = 8,
                       extra: Optional[Dict] = None
@@ -142,6 +173,7 @@ def record_postmortem(reason: str, *, engine=None, err=None,
             "compile_stats": _jsonable(COMPILESTATS.snapshot()),
             "metrics": _jsonable(REGISTRY.snapshot()),
             "slowlog": _jsonable(SLOWLOG.snapshot(slow_n)),
+            "journeys": _jsonable(_journey_section(engine, slow_n)),
             "alerts": _jsonable(firing),
             "trace": {
                 "events": _jsonable(events),

@@ -147,7 +147,7 @@ class Histogram:
     """
 
     __slots__ = ("name", "help", "bounds", "_lock", "_counts", "_sum",
-                 "_count")
+                 "_count", "_exemplars")
 
     def __init__(self, name: str, help: str = "",
                  buckets: Optional[Iterable[float]] = None):
@@ -164,13 +164,28 @@ class Histogram:
         self._counts = [0] * (len(bounds) + 1)  # last = +Inf overflow
         self._sum = 0.0
         self._count = 0
+        # round 21 exemplars: at most ONE (rid, value) pair per bucket
+        # — the newest observation that landed there.  Bounded by
+        # construction (len(bounds)+1 slots), written under the same
+        # lock as the counts, copied whole by snapshot(): a p99 bucket
+        # therefore always points at a concrete, recent request whose
+        # journey (tpulab.obs.journey) explains the latency.
+        self._exemplars: list = [None] * (len(bounds) + 1)
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, rid: Optional[int] = None) -> None:
         i = bisect_left(self.bounds, v)
-        with self._lock:
-            self._counts[i] += 1
-            self._sum += v
-            self._count += 1
+        if rid is None:
+            with self._lock:
+                self._counts[i] += 1
+                self._sum += v
+                self._count += 1
+        else:
+            ex = (rid, v)
+            with self._lock:
+                self._counts[i] += 1
+                self._sum += v
+                self._count += 1
+                self._exemplars[i] = ex
 
     @property
     def count(self) -> int:
@@ -189,7 +204,8 @@ class Histogram:
         with self._lock:
             return {"type": "histogram", "help": self.help,
                     "bounds": self.bounds, "counts": list(self._counts),
-                    "sum": self._sum, "count": self._count}
+                    "sum": self._sum, "count": self._count,
+                    "exemplars": list(self._exemplars)}
 
 
 class Registry:
@@ -262,13 +278,27 @@ class Registry:
                 out.append(f"# HELP {name} {snap['help']}")
             out.append(f"# TYPE {name} {snap['type']}")
             if snap["type"] == "histogram":
+                # bucket exemplars use the OpenMetrics convention — a
+                # trailing ``# {rid="N"} value`` — layered onto the
+                # 0.0.4 text format; every in-repo parser
+                # (tpulab.obs.render.parse_prometheus) understands the
+                # suffix, and exemplar-free output is byte-identical
+                # to pre-round-21 exposition
+                ex = snap.get("exemplars") or [None] * len(snap["counts"])
                 cum = 0
-                for b, c in zip(snap["bounds"], snap["counts"]):
+                for b, c, e in zip(snap["bounds"], snap["counts"], ex):
                     cum += c
-                    out.append(
-                        f'{name}_bucket{{le="{b:.10g}"}} {cum}')
+                    line = f'{name}_bucket{{le="{b:.10g}"}} {cum}'
+                    if e is not None:
+                        line += f' # {{rid="{e[0]}"}} {e[1]:.10g}'
+                    out.append(line)
                 cum += snap["counts"][-1]
-                out.append(f'{name}_bucket{{le="+Inf"}} {cum}')
+                line = f'{name}_bucket{{le="+Inf"}} {cum}'
+                if ex[-1] is not None:
+                    out.append(line + f' # {{rid="{ex[-1][0]}"}} '
+                                      f'{ex[-1][1]:.10g}')
+                else:
+                    out.append(line)
                 out.append(f"{name}_sum {snap['sum']:.10g}")
                 out.append(f"{name}_count {snap['count']}")
             else:

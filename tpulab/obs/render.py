@@ -19,15 +19,19 @@ from __future__ import annotations
 import re
 from typing import Dict, List, Optional, Sequence
 
+from tpulab import router as _router
 from tpulab.obs.registry import percentile_from_buckets
 
 #: histograms the latency summary table reports, in display order
 LATENCY_METRICS = ("ttft_seconds", "itl_seconds", "e2e_seconds",
                    "queue_wait_seconds", "prefill_seconds")
 
+#: bucket line, optionally carrying an OpenMetrics-style exemplar
+#: suffix (round 21): ``name_bucket{le="x"} N # {rid="R"} V``
 _BUCKET_RE = re.compile(
     r'^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)_bucket\{le="(?P<le>[^"]+)"\}'
-    r"\s+(?P<v>\S+)$")
+    r"\s+(?P<v>\S+)"
+    r'(?:\s+#\s+\{rid="(?P<rid>[^"]+)"\}\s+(?P<ev>\S+))?$')
 _PLAIN_RE = re.compile(
     r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)\s+(?P<v>\S+)$")
 
@@ -55,6 +59,10 @@ def parse_prometheus(text: str) -> dict:
             h = out.setdefault(m["name"], {"type": "histogram"})
             le = float("inf") if m["le"] == "+Inf" else float(m["le"])
             h.setdefault("buckets", []).append((le, int(float(m["v"]))))
+            if m["rid"] is not None:
+                # exemplars key by the bucket's le bound: (rid, value)
+                h.setdefault("exemplars", {})[le] = (
+                    int(m["rid"]), float(m["ev"]))
             continue
         m = _PLAIN_RE.match(line)
         if not m:
@@ -165,7 +173,25 @@ def format_fleet(fleet: Optional[dict],
             f"{' [' + ' > '.join(rungs) + ']' if rungs else ''} "
             f"(engages={brown.get('engages', 0)} "
             f"releases={brown.get('releases', 0)})")
-    for r in fleet.get("replica", []):
+    replicas = fleet.get("replica", [])
+    # the disaggregation surface (rounds 20/21): per-pool serving
+    # counts next to each pool's configured [min..max] band, and the
+    # replica rows below carry their role.  A unified fleet (no pools,
+    # every role "unified"/absent) renders exactly as before.
+    pools = fleet.get("pools")
+    pooled = bool(pools) or any(
+        r.get("role") not in (None, "unified") for r in replicas)
+    if pooled:
+        counts = _router.pool_counts(
+            r.get("role") for r in replicas if not r.get("retired"))
+        parts = []
+        for role in sorted(set(counts) | set(pools or {})):
+            p = (pools or {}).get(role) or {}
+            band = (f"[{p['min']}..{p['max']}]"
+                    if "min" in p and "max" in p else "")
+            parts.append(f"{role}={counts.get(role, 0)}{band}")
+        lines.append("  pools: " + " ".join(parts))
+    for r in replicas:
         def v(key, default="-"):
             x = r.get(key)
             return default if x is None else x
@@ -177,12 +203,74 @@ def format_fleet(fleet: Optional[dict],
             flags.append("retired")
         elif r.get("dead"):
             flags.append("dead")
+        role = f"{str(v('role', '?')):<8} " if pooled else ""
         lines.append(
             f"  replica{v('replica')} {str(v('health', '?')):<11} "
+            f"{role}"
             f"{' '.join(flags) + ' ' if flags else ''}"
             f"pending={v('pending')} active={v('active')} "
             f"done={v('requests_done')} gen={v('generation', 0)} "
             f"restarts={v('restarts', 0)} parked={v('parked', 0)}")
+    return "\n".join(lines)
+
+
+def format_journey(journey: Optional[dict], width: int = 44) -> str:
+    """Waterfall view of ONE stitched journey (the daemon's ``journey``
+    response / :meth:`tpulab.obs.journey.JourneyStore.snapshot`): one
+    bar row per phase, positioned on the request's own [submit..retire]
+    timeline so the handoff gap is visible at a glance.  Pure dict→str
+    like every renderer here."""
+    if not journey:
+        return "journey: not found (evicted, or journeys disabled)"
+    head = (f"journey rid={journey.get('rid')} "
+            f"tag={journey.get('tag') or '-'} "
+            f"{'complete' if journey.get('completed') else 'IN-FLIGHT'} "
+            f"e2e={journey.get('e2e_ms') if journey.get('e2e_ms') is not None else '?'}ms "
+            f"pools={'>'.join(journey.get('pools') or []) or '-'} "
+            f"replicas={'>'.join(str(r) for r in journey.get('replicas') or []) or '-'}")
+    if journey.get("handoff_ms") is not None:
+        head += (f" handoff={journey['handoff_ms']}ms/"
+                 f"{journey.get('handoff_bytes', 0)}B")
+    phases = journey.get("phases") or []
+    if not phases:
+        return head + "\n  (no stitched phases — marks incomplete)"
+    span = max(p["t1_ms"] for p in phases) or 1.0
+    wname = max(len(p["phase"]) for p in phases)
+    lines = [head]
+    for p in phases:
+        a = int(round(width * p["t0_ms"] / span))
+        b = max(a + 1, int(round(width * p["t1_ms"] / span)))
+        bar = " " * a + "█" * (b - a) + " " * (width - b)
+        where = (f"r{p['replica']}" if p.get("replica") is not None
+                 else "-")
+        if p.get("pool"):
+            where += f"/{p['pool']}"
+        tail = f" {p['bytes']}B" if p.get("bytes") else ""
+        lines.append(f"  {p['phase']:<{wname}} |{bar}| "
+                     f"{p['ms']:>9.3f}ms {where}{tail}")
+    return "\n".join(lines)
+
+
+def format_journeys(resp: Optional[dict]) -> str:
+    """Compact multi-journey listing (the console's journeys panel):
+    one line per journey, newest first."""
+    if not resp or not resp.get("journeys"):
+        return "journeys: none recorded"
+    st = resp.get("stats") or {}
+    lines = [f"journeys: {len(resp['journeys'])} shown, "
+             f"{st.get('completed', 0)} completed, "
+             f"{st.get('resident', 0)}/{st.get('capacity', 0)} resident"]
+    for j in resp["journeys"]:
+        dom = max(j.get("phases") or [],
+                  key=lambda p: p["ms"], default=None)
+        lines.append(
+            f"  rid={j.get('rid')} tag={j.get('tag') or '-'} "
+            f"{'done' if j.get('completed') else 'live'} "
+            f"e2e={j.get('e2e_ms') if j.get('e2e_ms') is not None else '?'}ms "
+            f"pools={'>'.join(j.get('pools') or []) or '-'} "
+            f"dom={dom['phase'] + ':' + format(dom['ms'], '.1f') + 'ms' if dom else '-'}"
+            + (f" handoff={j['handoff_ms']}ms/{j.get('handoff_bytes', 0)}B"
+               if j.get("handoff_ms") is not None else ""))
     return "\n".join(lines)
 
 
@@ -198,6 +286,12 @@ def format_slowlog(slow: Optional[dict]) -> str:
                  + f" first_tok@r{e.get('replica_first_token')} "
                  f"migrations={e.get('migrations', 0)} "
                  if hops else "")
+        # round 21: the pool that retired the request and its handoff
+        # cost render only when present (pre-round-21 entries and
+        # unified fleets carry neither)
+        pool = f"pool={e['pool']} " if e.get("pool") else ""
+        hand = (f"handoff={e['handoff_ms']}ms/{e.get('handoff_bytes', 0)}B "
+                if e.get("handoff_ms") is not None else "")
         lines.append(
             f"  rid={e.get('rid')} tag={e.get('tag') or '-'} "
             f"e2e={e.get('e2e_ms')}ms ttft={e.get('ttft_ms')}ms "
@@ -205,6 +299,7 @@ def format_slowlog(slow: Optional[dict]) -> str:
             f"@tok{e.get('itl_max_at_token')} "
             f"queue={e.get('queue_wait_ms')}ms "
             f"chunks={e.get('prefill_chunks')} "
+            f"{pool}{hand}"
             f"{where}"
             f"tokens={e.get('tokens')}")
     return "\n".join(lines)

@@ -109,6 +109,17 @@ class SlowLog:
         return {"worst": [dict(e) for _, _, e in items],
                 "recorded": recorded, "capacity": self.capacity}
 
+    def find(self, rid: int) -> Optional[Dict]:
+        """The retained entry for ``rid``, or None.  Round 21: the
+        journey tier and its consistency tests join a slow-log span
+        summary to the stitched journey sharing the rid — this is the
+        lookup half of that join (O(capacity) scan; read path only)."""
+        with self._lock:
+            for _, _, e in self._heap:
+                if e.get("rid") == rid:
+                    return dict(e)
+        return None
+
 
 #: the process-global slow log the engines record into and the daemon's
 #: ``slowlog`` request renders

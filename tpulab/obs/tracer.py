@@ -217,6 +217,27 @@ class Tracer:
             },
         }
 
+    def rid_events(self, rid: int) -> list:
+        """Every retained event whose arg links it to ``rid`` — the
+        raw material the journey tier (round 21) stitches and the
+        flight recorder embeds next to a dying request's journey.
+        Matches both scalar-arg events (``event(name, rid)`` — the
+        per-request convention) and rich events carrying
+        ``{"rid": rid, ...}``.  Chronological ``(t_ns, name, arg)``
+        tuples; copy-on-read like :meth:`chrome_trace`."""
+        with self._lock:
+            entries = [e for e in self._buf if e is not None]
+            ids = list(self._ids)
+        out = []
+        for t, kind, nid, tid, arg in entries:
+            if nid >= len(ids):
+                continue
+            if arg == rid or (isinstance(arg, dict)
+                              and arg.get("rid") == rid):
+                out.append((t, ids[nid], arg))
+        out.sort(key=lambda e: e[0])
+        return out
+
 
 #: the process-global tracer the engine/daemon/trainer record into; a
 #: disabled twin (NULL) lets callers branch once at construction time

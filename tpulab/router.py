@@ -217,6 +217,20 @@ class ReplicaView:
     role: str = ROLE_UNIFIED
 
 
+def pool_counts(roles) -> dict:
+    """Serving census per pool role: ``{"prefill": 2, "decode": 1}``
+    from an iterable of role strings (``None``/empty count as
+    ``unified`` — the pre-round-20 default).  Pure like everything in
+    this module; the round-21 fleet table (``tpulab.obs.render``)
+    renders it next to each pool's configured band, and tests exercise
+    it without a fleet."""
+    out: dict = {}
+    for role in roles:
+        role = role or ROLE_UNIFIED
+        out[role] = out.get(role, 0) + 1
+    return out
+
+
 def _role_serves(role: str, phase: Optional[str]) -> bool:
     """Whether a replica with ``role`` may take work for ``phase``
     (``None`` = phase-blind placement — the pre-round-20 behavior and
